@@ -1,0 +1,24 @@
+"""nhd_tpu — a TPU-native topology-aware scheduling framework.
+
+A brand-new framework with the capabilities of Viasat/NHD (a custom
+Kubernetes scheduler for NUMA/PCIe/SMT/NIC-bandwidth/hugepage-aware pod
+placement; see /root/reference), re-designed so that the inner
+filter→score→bind loop is a batched constraint-satisfaction solve on TPU
+via JAX/XLA: all pending pods × all candidate nodes are evaluated at once
+as dense boolean masks over topology tensors, with node selection as a
+masked-argmax reduction and gang batches resolved in greedy rounds.
+
+Package layout:
+  core/      hardware + workload data model (host-side source of truth)
+  config/    libconfig parsing and the Triad config round-trip (plugin seam)
+  solver/    the matcher: serial oracle + batched JAX solver + sharding
+  ops/       Pallas/XLA kernels for the hot predicates
+  k8s/       cluster backend interface (fake in-memory + real kube client)
+  scheduler/ reconciliation event loop, claim/release, bind orchestration
+  rpc/       gRPC stats/introspection plane
+  utils/     logging and misc helpers
+"""
+
+__version__ = "0.1.0"
+
+NHD_SCHED_NAME = "nhd-scheduler"

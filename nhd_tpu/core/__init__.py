@@ -1,0 +1,39 @@
+from nhd_tpu.core.topology import (
+    Core,
+    CpuArch,
+    Gpu,
+    GpuKind,
+    MapMode,
+    NicDir,
+    NicPair,
+    NumaHint,
+    PodTopology,
+    ProcGroup,
+    SmtMode,
+    VlanInfo,
+)
+from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+from nhd_tpu.core.node import HostNode, NodeCpuCore, NodeGpu, NodeMemory, NodeNic
+
+__all__ = [
+    "Core",
+    "CpuArch",
+    "CpuRequest",
+    "Gpu",
+    "GpuKind",
+    "GroupRequest",
+    "HostNode",
+    "MapMode",
+    "NicDir",
+    "NicPair",
+    "NodeCpuCore",
+    "NodeGpu",
+    "NodeMemory",
+    "NodeNic",
+    "NumaHint",
+    "PodRequest",
+    "PodTopology",
+    "ProcGroup",
+    "SmtMode",
+    "VlanInfo",
+]

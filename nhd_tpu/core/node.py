@@ -1,0 +1,678 @@
+"""Host-side node hardware mirror: topology + allocation state.
+
+Functional equivalent of the reference's nhd/Node.py. A HostNode is built
+from NFD (node-feature-discovery) labels and tracks which cores/GPUs/NICs/
+hugepages are claimed. It stays the *source of truth*: the JAX solver's
+device arrays are a projection of this state (packed in
+nhd_tpu/solver/encode.py), re-derivable at any time — mirroring the
+reference's stance that durable state lives host-side (README.md:85-87).
+
+Label formats are kept reference-compatible (positional dotted labels,
+Node.py:327-454) so the same NFD extras feed both systems.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from itertools import chain
+from typing import Dict, List, Optional, Tuple
+
+from nhd_tpu.core.topology import (
+    GpuKind,
+    MapMode,
+    NicDir,
+    PodTopology,
+    SmtMode,
+)
+from nhd_tpu.utils import get_logger
+
+# Tunables (reference: Node.py:18-20,107)
+NIC_BW_AVAIL_PERCENT = 0.9          # schedulable fraction of NIC line rate
+SCHEDULABLE_NIC_SPEED_THRESH_MBPS = 11000  # NICs below this are invisible
+ENABLE_NIC_SHARING = False          # allow pods to share one NIC
+MIN_BUSY_SECS = 30.0                # GPU-pod per-node placement back-off
+
+MAINTENANCE_LABEL = "sigproc.viasat.io/maintenance"
+
+_CPU_CORES_LABEL = "feature.node.kubernetes.io/nfd-extras-cpu.num_cores"
+_CPU_SOCKETS_LABEL = "feature.node.kubernetes.io/nfd-extras-cpu.numSockets"
+_CPU_SMT_LABEL = "feature.node.kubernetes.io/cpu-hardware_multithreading"
+_CPU_ISOL_LABEL = "feature.node.kubernetes.io/nfd-extras-cpu.isolcpus"
+_NIC_LABEL_PREFIX = "feature.node.kubernetes.io/nfd-extras-nic"
+_SRIOV_LABEL_PREFIX = "feature.node.kubernetes.io/nfd-extras-sriov"
+_GPU_LABEL_PREFIX = "feature.node.kubernetes.io/nfd-extras-gpu"
+
+
+def parse_range_list(text: str) -> List[int]:
+    """Parse Linux cpuset-style range lists: ``0-3,8,10-11`` → sorted ints
+    (reference: Node.py:298-306)."""
+
+    def one(part: str):
+        ends = part.split("-")
+        return range(int(ends[0]), int(ends[-1]) + 1)
+
+    return sorted(set(chain.from_iterable(one(p) for p in text.split(","))))
+
+
+def format_mac(raw: str) -> str:
+    """NFD flattens MACs to bare hex; restore colon form, uppercased
+    (reference: NodeNic.FormatMac, Node.py:58-59)."""
+    return ":".join(a + b for a, b in zip(raw[::2], raw[1::2])).upper()
+
+
+@dataclass
+class NodeCpuCore:
+    """One logical CPU (reference: Node.py:23-34)."""
+
+    core: int
+    socket: int
+    sibling: int  # logical id of the SMT sibling, -1 when SMT is off
+    used: bool = False
+
+
+@dataclass
+class NodeNic:
+    """One schedulable NIC port (reference: Node.py:37-59)."""
+
+    ifname: str
+    mac: str
+    vendor: str
+    speed_gbps: float
+    numa_node: int
+    pciesw: int
+    card: int
+    port: int
+    idx: int = -1  # per-NUMA-node ordinal, set after all NICs are read
+    speed_used: List[float] = field(default_factory=lambda: [0.0, 0.0])  # rx, tx
+    pods_used: int = 0
+
+    def free_bw(self) -> Tuple[float, float]:
+        """Schedulable headroom per direction. With sharing disabled a NIC
+        serving any pod has zero headroom (reference: Node.py:283-296)."""
+        cap = self.speed_gbps * NIC_BW_AVAIL_PERCENT
+        if ENABLE_NIC_SHARING:
+            return (cap - self.speed_used[0], cap - self.speed_used[1])
+        return (0.0, 0.0) if self.pods_used > 0 else (cap, cap)
+
+
+@dataclass
+class NodeMemory:
+    """Hugepage accounting (reference: Node.py:62-71)."""
+
+    ttl_hugepages_gb: int = 0
+    free_hugepages_gb: int = 0
+    res_hugepages_gb: int = 0
+
+
+@dataclass
+class NodeGpu:
+    """One GPU device (reference: Node.py:74-97)."""
+
+    kind: GpuKind
+    device_id: int
+    numa_node: int
+    pciesw: int
+    used: bool = False
+
+
+class HostNode:
+    """Per-node topology + claim/release bookkeeping (reference: Node.py:100-853)."""
+
+    def __init__(self, name: str, active: bool = True):
+        self.logger = get_logger(__name__)
+        self.name = name
+        self.active = active
+        self.addr = ""
+        self.maintenance = False
+        self.groups: List[str] = ["default"]
+        self.cores: List[NodeCpuCore] = []
+        self.gpus: List[NodeGpu] = []
+        self.nics: List[NodeNic] = []
+        self.mem = NodeMemory()
+        self.sockets = 0
+        self.numa_nodes = 0
+        self.smt_enabled = False
+        self.cores_per_proc = 0
+        self.reserved_cores: List[int] = []
+        self.data_vlan = 0
+        self.gwip = "0.0.0.0/32"
+        self.pod_info: Dict[Tuple[str, str], PodTopology] = {}
+        self._busy_time = 0.0
+
+    # ------------------------------------------------------------------
+    # label parsing
+    # ------------------------------------------------------------------
+
+    def parse_labels(self, labels: Dict[str, str]) -> bool:
+        """Initialize all hardware state from node labels
+        (reference: Node.py:468-487, same stage order)."""
+        return (
+            self._init_groups(labels)
+            and self._init_maintenance(labels)
+            and self._init_cores(labels)
+            and self._init_nics(labels)
+            and self._init_gpus(labels)
+            and self._init_misc(labels)
+        )
+
+    def _init_groups(self, labels: Dict[str, str]) -> bool:
+        """NHD_GROUP label: dot-separated group list (reference: Node.py:312-321)."""
+        self.groups = labels["NHD_GROUP"].split(".") if "NHD_GROUP" in labels else ["default"]
+        return True
+
+    @staticmethod
+    def maintenance_from_labels(labels: Dict[str, str]) -> bool:
+        """Any maintenance label value other than 'not_scheduled' means the
+        node is in maintenance (reference: Node.py:134-142)."""
+        value = labels.get(MAINTENANCE_LABEL)
+        return value is not None and value.lower() != "not_scheduled"
+
+    def _init_maintenance(self, labels: Dict[str, str]) -> bool:
+        self.maintenance = HostNode.maintenance_from_labels(labels)
+        return True
+
+    def _init_cores(self, labels: Dict[str, str]) -> bool:
+        """Core/socket/SMT layout from NFD extras (reference: Node.py:327-374).
+
+        Logical numbering is the Linux convention the reference assumes:
+        physical cores 0..N-1, their SMT siblings N..2N-1, socket is the
+        row-major block (c % N) // (N / sockets).
+        """
+        if _CPU_CORES_LABEL not in labels or _CPU_SOCKETS_LABEL not in labels:
+            self.logger.error(f"node {self.name}: missing CPU labels")
+            return False
+
+        self.sockets = int(labels[_CPU_SOCKETS_LABEL])
+        phys_cores = int(labels[_CPU_CORES_LABEL])
+        self.smt_enabled = _CPU_SMT_LABEL in labels
+        self.numa_nodes = self.sockets  # Intel-style 1 NUMA/socket (Node.py:336)
+        self.cores_per_proc = phys_cores // self.sockets
+
+        n_logical = phys_cores * 2 if self.smt_enabled else phys_cores
+        self.cores = []
+        for c in range(n_logical):
+            socket = int((c % phys_cores) // (phys_cores / self.sockets))
+            sibling = -1
+            if self.smt_enabled:
+                sibling = c + phys_cores if c < phys_cores else c - phys_cores
+            self.cores.append(NodeCpuCore(c, socket, sibling))
+
+        if _CPU_ISOL_LABEL in labels:
+            # '_' separates multiple cpuset ranges inside one label value
+            # (reference: Node.py:352-370). Cores NOT isolated belong to the
+            # OS and are permanently reserved.
+            isolated: List[int] = []
+            for rng in labels[_CPU_ISOL_LABEL].split("_"):
+                isolated.extend(parse_range_list(rng))
+            non_isol = set(range(n_logical)) - set(isolated)
+            for c in non_isol:
+                self.cores[c].used = True
+                self.reserved_cores.append(c)
+        return True
+
+    def _init_nics(self, labels: Dict[str, str]) -> bool:
+        """NIC inventory from positional dotted labels (reference: Node.py:376-420):
+        ``feature.node.kubernetes.io/nfd-extras-nic.<ifname>.<vendor>.<mac>.<speed>Mbs.<numa>.<pcisw:hex>.<card:hex>.<port>``
+        (the io/ segment makes ifname the 5th dot-field, Node.py:392).
+        SR-IOV physical functions and slow/down links are excluded."""
+        pfs = [l.split(".")[5] for l in labels if _SRIOV_LABEL_PREFIX in l]
+
+        for label in labels:
+            if _NIC_LABEL_PREFIX not in label:
+                continue
+            p = label.split(".")
+            ifname, vendor, mac, speed = p[4], p[5], p[6], p[7]
+            numa_node, pciesw, card, port = int(p[8]), int(p[9], 16), int(p[10], 16), int(p[11])
+
+            if ifname in pfs:
+                continue  # PFs carry the VFs; not directly schedulable
+            if "Mbs" not in speed:
+                continue  # link down / speed unknown (reference: Node.py:399-401)
+            speed_mbps = int(speed[: speed.index("Mbs")])
+            if speed_mbps < SCHEDULABLE_NIC_SPEED_THRESH_MBPS:
+                continue
+
+            self.nics.append(
+                NodeNic(ifname, format_mac(mac), vendor, speed_mbps / 1e3,
+                        numa_node, pciesw, card, port)
+            )
+
+        # Per-NUMA ordinals, in label-encounter order (reference: Node.py:412-418).
+        if self.nics:
+            counters = [0] * (max(n.numa_node for n in self.nics) + 1)
+            for nic in self.nics:
+                nic.idx = counters[nic.numa_node]
+                counters[nic.numa_node] += 1
+        return True
+
+    def _init_gpus(self, labels: Dict[str, str]) -> bool:
+        """GPU inventory (reference: Node.py:422-432):
+        ``feature.node.kubernetes.io/nfd-extras-gpu.<device_id>.<model>.<numa>.<pcisw:hex>``."""
+        for label in labels:
+            if _GPU_LABEL_PREFIX not in label:
+                continue
+            p = label.split(".")
+            self.gpus.append(
+                NodeGpu(GpuKind.from_model_string(p[5]), int(p[4]), int(p[6]), int(p[7], 16))
+            )
+        return True
+
+    def _init_misc(self, labels: Dict[str, str]) -> bool:
+        """Site labels: data VLAN + default GW mandatory, reserved hugepages
+        optional (reference: Node.py:434-454)."""
+        if "DATA_PLANE_VLAN" not in labels or "DATA_DEFAULT_GW" not in labels:
+            self.logger.error(f"node {self.name}: missing VLAN/GW labels")
+            return False
+        self.data_vlan = int(labels["DATA_PLANE_VLAN"])
+        self.gwip = labels["DATA_DEFAULT_GW"]
+        if "RES_HUGEPAGES_GB" in labels:
+            self.mem.res_hugepages_gb = int(labels["RES_HUGEPAGES_GB"])
+        return True
+
+    def set_hugepages(self, alloc: int, free: int) -> bool:
+        """Capacity from the K8s allocatable numbers, minus the node's
+        reserved amount (reference: Node.py:489-493)."""
+        self.mem.ttl_hugepages_gb = alloc
+        self.mem.free_hugepages_gb = free - self.mem.res_hugepages_gb
+        return True
+
+    def set_groups(self, groups: str) -> None:
+        """Reference: Node.py:308-310."""
+        self.groups = groups.split(".")
+
+    # ------------------------------------------------------------------
+    # free-resource queries (consumed by the matcher)
+    # ------------------------------------------------------------------
+
+    def free_cpu_cores_per_numa(self) -> List[int]:
+        """Fully-free *physical* cores per NUMA node. On SMT nodes a physical
+        core counts only when both logical siblings are unused — no partial
+        multi-tenancy (reference: Node.py:250-264)."""
+        free = [0] * self.numa_nodes
+        for c in range(self.cores_per_proc * self.sockets):
+            core = self.cores[c]
+            if core.used:
+                continue
+            if self.smt_enabled and self.cores[core.sibling].used:
+                continue
+            free[core.socket] += 1
+        return free
+
+    def free_cpu_core_count(self) -> int:
+        """Reference: Node.py:229-236 (logical count with both-siblings-free rule)."""
+        if self.smt_enabled:
+            return sum(
+                1 for c in self.cores if not c.used and not self.cores[c.sibling].used
+            )
+        return sum(1 for c in self.cores if not c.used)
+
+    def free_gpus_per_numa(self) -> List[int]:
+        """Reference: Node.py:456-462."""
+        free = [0] * self.numa_nodes
+        for g in self.gpus:
+            if not g.used:
+                free[g.numa_node] += 1
+        return free
+
+    def free_gpu_count(self) -> int:
+        return sum(1 for g in self.gpus if not g.used)
+
+    def total_gpus(self) -> int:
+        return len(self.gpus)
+
+    def total_cpus(self) -> int:
+        return len(self.cores)
+
+    def free_gpus_per_pciesw(self) -> Dict[int, int]:
+        """Free GPU count per PCIe switch (reference: Node.py:266-273)."""
+        out: Dict[int, int] = {}
+        for g in self.gpus:
+            if not g.used:
+                out[g.pciesw] = out.get(g.pciesw, 0) + 1
+        return out
+
+    def nic_pciesw_per_numa(self) -> List[Dict[int, int]]:
+        """Per NUMA node: NIC ordinal → PCIe switch (reference: Node.py:275-281)."""
+        out: List[Dict[int, int]] = [{} for _ in range(self.numa_nodes)]
+        for n in self.nics:
+            out[n.numa_node][n.idx] = n.pciesw
+        return out
+
+    def free_nic_bw_per_numa(self) -> List[List[List[float]]]:
+        """Per NUMA node, per NIC ordinal: [rx, tx] schedulable headroom in
+        Gbps (reference: Node.py:283-296)."""
+        out: List[List[List[float]]] = [[] for _ in range(self.numa_nodes)]
+        for n in self.nics:
+            if n.numa_node >= self.numa_nodes:
+                self.logger.warning(
+                    f"node {self.name}: NIC {n.mac} on unexpected NUMA {n.numa_node}"
+                )
+                continue
+            out[n.numa_node].append(list(n.free_bw()))
+        return out
+
+    # ------------------------------------------------------------------
+    # lookups
+    # ------------------------------------------------------------------
+
+    def nic_by_mac(self, mac: str) -> Optional[NodeNic]:
+        return next((n for n in self.nics if n.mac == mac), None)
+
+    def nic_by_ifname(self, ifname: str) -> Optional[NodeNic]:
+        return next((n for n in self.nics if n.ifname == ifname), None)
+
+    def nic_by_numa_idx(self, numa: int, idx: int) -> Optional[NodeNic]:
+        """Reference: Node.py:657-661."""
+        return next(
+            (n for n in self.nics if n.idx == idx and n.numa_node == numa), None
+        )
+
+    def gpu_by_device_id(self, device_id: int) -> Optional[NodeGpu]:
+        return next((g for g in self.gpus if g.device_id == device_id), None)
+
+    def next_free_gpu(self, numa: int) -> Optional[NodeGpu]:
+        """Reference: Node.py:495-500."""
+        return next(
+            (g for g in self.gpus if g.numa_node == numa and not g.used), None
+        )
+
+    def free_pci_gpu_for_nic(self, nic: NodeNic) -> Optional[NodeGpu]:
+        """First free GPU sharing the NIC's PCIe switch (reference: Node.py:648-655)."""
+        return next(
+            (g for g in self.gpus if g.pciesw == nic.pciesw and not g.used), None
+        )
+
+    def free_cpu_batch(self, numa: int, num: int, smt: SmtMode) -> List[int]:
+        """Hand out ``num`` logical cores on ``numa`` in core order. SMT-ON
+        requests take sibling pairs together; SMT-OFF requests take one
+        logical core of an otherwise-free pair (reference: Node.py:502-519).
+
+        Deviation: cores handed out earlier in the same call are tracked, so
+        an over-ask returns a short list instead of duplicates (the
+        reference re-issues a pair's cores when demand exceeds free pairs,
+        defeating its caller's shortfall check) — and an SMT-OFF request
+        never receives both siblings of one physical core.
+        """
+        out: List[int] = []
+        taken: set = set()
+        for c in self.cores:
+            if num <= 0:
+                break
+            if c.socket != numa or c.used or c.core in taken:
+                continue
+            if self.smt_enabled:
+                if self.cores[c.sibling].used or c.sibling in taken:
+                    continue
+                if smt == SmtMode.ON and num >= 2:
+                    out.extend([c.core, c.sibling])
+                    taken.update((c.core, c.sibling))
+                    num -= 2
+                else:
+                    out.append(c.core)
+                    taken.update((c.core, c.sibling))
+                    num -= 1
+            else:
+                out.append(c.core)
+                taken.add(c.core)
+                num -= 1
+        return out
+
+    # ------------------------------------------------------------------
+    # claim / release
+    # ------------------------------------------------------------------
+
+    def reset_resources(self) -> None:
+        """Back to a blank slate, keeping OS-reserved cores claimed
+        (reference: Node.py:144-161)."""
+        for c in self.cores:
+            if c.core not in self.reserved_cores:
+                c.used = False
+        for g in self.gpus:
+            g.used = False
+        for n in self.nics:
+            n.pods_used = 0
+            n.speed_used = [0.0, 0.0]
+        self.mem.free_hugepages_gb = self.mem.ttl_hugepages_gb
+        self.pod_info.clear()
+
+    def claim_from_topology(self, top: PodTopology) -> bool:
+        """Mark every resource named in a (solved) topology as used — the
+        restart-replay path (reference: Node.py:530-585)."""
+        for pg in top.proc_groups:
+            for core in pg.misc_cores + pg.proc_cores:
+                if core.core >= len(self.cores):
+                    self.logger.error(
+                        f"node {self.name}: core {core.core} out of range"
+                    )
+                    return False
+                self.cores[core.core].used = True
+            for gpu in pg.gpus:
+                dev = self.gpu_by_device_id(gpu.device_id)
+                if dev is not None:
+                    dev.used = True
+                for core in gpu.cpu_cores:
+                    self.cores[core.core].used = True
+        for core in top.misc_cores:
+            self.cores[core.core].used = True
+        for pair in top.nic_pairs:
+            nic = self.nic_by_mac(pair.mac)
+            if nic is None:
+                self.logger.error(f"node {self.name}: no NIC with MAC {pair.mac}")
+                continue
+            nic.speed_used[0] += pair.rx_core.nic_speed
+            nic.speed_used[1] += pair.tx_core.nic_speed
+            nic.pods_used += 1
+        if top.hugepages_gb > 0:
+            self.mem.free_hugepages_gb -= top.hugepages_gb
+        return True
+
+    def release_from_topology(self, top: PodTopology) -> None:
+        """Inverse of claim_from_topology (reference: Node.py:587-636)."""
+        for pg in top.proc_groups:
+            for core in pg.misc_cores + pg.proc_cores:
+                self.cores[core.core].used = False
+            for gpu in pg.gpus:
+                dev = self.gpu_by_device_id(gpu.device_id)
+                if dev is not None:
+                    dev.used = False
+                for core in gpu.cpu_cores:
+                    self.cores[core.core].used = False
+        for core in top.misc_cores:
+            self.cores[core.core].used = False
+        for pair in top.nic_pairs:
+            nic = self.nic_by_mac(pair.mac)
+            if nic is None:
+                self.logger.error(f"node {self.name}: no NIC with MAC {pair.mac}")
+                continue
+            nic.speed_used[0] -= pair.rx_core.nic_speed
+            nic.speed_used[1] -= pair.tx_core.nic_speed
+            nic.pods_used -= 1
+        if top.hugepages_gb > 0:
+            self.mem.free_hugepages_gb += top.hugepages_gb
+
+    def claim_nic_pods(self, nic_indices: List[int]) -> None:
+        """Mark NICs as serving one more pod (reference: Node.py:644-646)."""
+        for i in nic_indices:
+            self.nics[i].pods_used += 1
+
+    def nad_names_from_indices(self, nic_indices: List[int]) -> List[str]:
+        """Interface names for the CNI NetworkAttachmentDefinition annotation
+        (reference: Node.py:638-642)."""
+        return [self.nics[i].ifname for i in nic_indices]
+
+    # ------------------------------------------------------------------
+    # physical assignment
+    # ------------------------------------------------------------------
+
+    def assign_physical_ids(self, mapping: Dict[str, tuple], top: PodTopology):
+        """Turn a NUMA/NIC mapping from the matcher into concrete core, GPU,
+        and NIC assignments, mutating both this node's state and ``top``
+        (reference: Node.py:663-841).
+
+        mapping = {'gpu': numa-per-group, 'cpu': numa-per-group + misc numa,
+                   'nic': (numa, nic_ordinal) per group}
+
+        Returns the list of (nic_index, speed, dir) tuples consumed; on any
+        shortfall raises AssignmentError after unwinding partial claims.
+        """
+        used_cpus: List[int] = []
+        used_gpus: List[int] = []
+        used_nics: List[Tuple[int, float, NicDir]] = []
+        hugepages_taken = False
+
+        try:
+            for pi, pg in enumerate(top.proc_groups):
+                if pg.vlan is not None:
+                    pg.vlan.vlan = self.data_vlan
+
+                numa = mapping["gpu"][pi]
+                want = pg.cpu_proc_request()
+                group_cpus = self.free_cpu_batch(numa, want, pg.proc_smt)
+                if len(group_cpus) != want:
+                    raise AssignmentError(
+                        f"wanted {want} proc cores on numa {numa}, got {len(group_cpus)}"
+                    )
+
+                nic_numa, nic_ord = mapping["nic"][pi]
+                nic = self.nic_by_numa_idx(nic_numa, nic_ord)
+                if nic is None and (pg.nic_bw_request() != (0, 0) or pg.gpus):
+                    raise AssignmentError(f"no NIC at numa {nic_numa} idx {nic_ord}")
+
+                cursor = 0
+                for gpu in pg.gpus:
+                    # Prefer a GPU sharing the NIC's PCIe switch even in NUMA
+                    # mode, to keep GPUDirect capacity for later pods
+                    # (reference: Node.py:688-716).
+                    dev = self.free_pci_gpu_for_nic(nic) if nic is not None else None
+                    if dev is None:
+                        if top.map_mode == MapMode.PCI:
+                            raise AssignmentError(
+                                f"no free GPU on PCIe switch of NIC {nic and nic.ifname}"
+                            )
+                        dev = self.next_free_gpu(numa)
+                    if dev is None:
+                        raise AssignmentError("mapping promised a GPU but none free")
+
+                    gpu.device_id = dev.device_id
+                    dev.used = True
+                    used_gpus.append(dev.device_id)
+                    for feeder in gpu.cpu_cores:
+                        feeder.core = group_cpus[cursor]
+                        self.cores[feeder.core].used = True
+                        used_cpus.append(feeder.core)
+                        cursor += 1
+
+                for core in pg.proc_cores:
+                    core.core = group_cpus[cursor]
+                    self.cores[core.core].used = True
+                    used_cpus.append(core.core)
+                    cursor += 1
+
+                    if core.nic_dir in (NicDir.RX, NicDir.TX):
+                        if nic is None:
+                            raise AssignmentError("NIC-serving core without a NIC")
+                        nic_index = self.nics.index(nic)
+                        dir_idx = 0 if core.nic_dir == NicDir.RX else 1
+                        nic.speed_used[dir_idx] += core.nic_speed
+                        used_nics.append((nic_index, core.nic_speed, core.nic_dir))
+
+                        pair = top.nic_pair_for_core(core)
+                        if pair is None:
+                            raise AssignmentError(
+                                f"core {core.name} not in any NIC pair"
+                            )
+                        pair.mac = nic.mac
+
+                if cursor != len(group_cpus):
+                    raise AssignmentError("leftover proc cores after assignment")
+
+                helpers = self.free_cpu_batch(numa, len(pg.misc_cores), pg.helper_smt)
+                if len(helpers) != len(pg.misc_cores):
+                    raise AssignmentError(
+                        f"wanted {len(pg.misc_cores)} helper cores, got {len(helpers)}"
+                    )
+                for helper, core_id in zip(pg.misc_cores, helpers):
+                    helper.core = core_id
+                    self.cores[core_id].used = True
+                    used_cpus.append(core_id)
+
+            top.set_data_default_gw(self.gwip)
+
+            if top.hugepages_gb > 0:
+                self.mem.free_hugepages_gb -= top.hugepages_gb
+                hugepages_taken = True
+
+            # Top-level misc cores use the final CPU-mapping slot
+            # (reference: Node.py:798-815; misc-as-last-element convention).
+            misc = self.free_cpu_batch(
+                mapping["cpu"][-1], len(top.misc_cores), top.misc_cores_smt
+            )
+            if len(misc) != len(top.misc_cores):
+                raise AssignmentError(
+                    f"wanted {len(top.misc_cores)} misc cores, got {len(misc)}"
+                )
+            for mc, core_id in zip(top.misc_cores, misc):
+                mc.core = core_id
+                self.cores[core_id].used = True
+                used_cpus.append(core_id)
+
+            if top.ctrl_vlan is not None:
+                top.ctrl_vlan.vlan = self.data_vlan
+
+        except AssignmentError:
+            # Unwind partial claims so the node is exactly as before. The
+            # reference's unwind (Node.py:825-837) carries two bookkeeping
+            # bugs (GPUs un-marked by device id used as a list index; NIC
+            # speed restored from the wrong operand) and leaks the hugepage
+            # deduction; this implements the intended semantics.
+            for c in used_cpus:
+                self.cores[c].used = False
+            for g in used_gpus:
+                dev = self.gpu_by_device_id(g)
+                if dev is not None:
+                    dev.used = False
+            for nic_index, speed, direction in used_nics:
+                dir_idx = 0 if direction == NicDir.RX else 1
+                self.nics[nic_index].speed_used[dir_idx] -= speed
+            if hugepages_taken:
+                self.mem.free_hugepages_gb += top.hugepages_gb
+            raise
+
+        return used_nics
+
+    # ------------------------------------------------------------------
+    # pod tracking + rate limiting
+    # ------------------------------------------------------------------
+
+    def add_scheduled_pod(self, pod: str, ns: str, top: PodTopology) -> None:
+        self.pod_info[(pod, ns)] = top
+
+    def remove_scheduled_pod(self, pod: str, ns: str) -> None:
+        self.pod_info.pop((pod, ns), None)
+
+    def pod_present(self, pod: str, ns: str) -> bool:
+        return (pod, ns) in self.pod_info
+
+    def total_pods(self) -> int:
+        return len(self.pod_info)
+
+    def set_busy(self, now: Optional[float] = None) -> None:
+        """Stamp a placement for the GPU-pod back-off (reference: Node.py:843-845)."""
+        self._busy_time = time.monotonic() if now is None else now
+
+    def is_busy(self, now: Optional[float] = None) -> bool:
+        """Reference: Node.py:847-850."""
+        return self.busy_seconds(now) < MIN_BUSY_SECS
+
+    def busy_seconds(self, now: Optional[float] = None) -> float:
+        t = time.monotonic() if now is None else now
+        return t - self._busy_time
+
+    def nic_used_speeds(self) -> List[List[float]]:
+        return [list(n.speed_used) for n in self.nics]
+
+
+class AssignmentError(RuntimeError):
+    """Raised when physical assignment cannot satisfy a promised mapping
+    (the reference signals this with IndexError, Node.py:687,825)."""

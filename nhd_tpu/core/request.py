@@ -1,0 +1,117 @@
+"""The schedulable essence of a pod: a small fixed-shape numeric request.
+
+The reference matcher re-derives these quantities on every call from the
+CfgTopology object graph (CfgTopology.py:199-232). Here they are extracted
+once into a flat dataclass that (a) the serial oracle consumes directly and
+(b) packs bit-for-bit into the dense pod-batch tensors of the JAX solver
+(nhd_tpu/solver/encode.py) — the single source of truth for "what does this
+pod ask for" on both paths.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import FrozenSet, List, Tuple
+
+from nhd_tpu.core.topology import MapMode, PodTopology, SmtMode
+
+
+@dataclass(frozen=True)
+class CpuRequest:
+    """A count of cores plus whether they may ride SMT siblings."""
+
+    count: int
+    smt: SmtMode
+
+    def physical_cores(self, node_smt: bool) -> int:
+        """Physical (sibling-pair) cores consumed on a node.
+
+        Reproduces the reference's load-bearing quirk (Matcher.py:179-201):
+        on SMT nodes an SMT-tolerant request packs two logical cores per
+        physical core (ceil division); an SMT-averse request burns one full
+        physical core per logical core. On non-SMT nodes count==physical.
+        """
+        if node_smt and self.smt == SmtMode.ON:
+            return math.ceil(self.count / 2.0)
+        return self.count
+
+
+@dataclass(frozen=True)
+class GroupRequest:
+    """Per-processing-group resource ask."""
+
+    proc: CpuRequest  # processing cores incl. GPU feeder cores
+    misc: CpuRequest  # helper cores
+    gpus: int
+    nic_rx_gbps: float
+    nic_tx_gbps: float
+
+    def cpu_physical(self, node_smt: bool) -> int:
+        """Group total physical cores: proc + helper, each under its own SMT
+        setting (reference: Matcher.py:179-194 sums both into one count)."""
+        return self.proc.physical_cores(node_smt) + self.misc.physical_cores(node_smt)
+
+    @property
+    def needs_nic(self) -> bool:
+        return self.nic_rx_gbps > 0 or self.nic_tx_gbps > 0
+
+
+@dataclass(frozen=True)
+class PodRequest:
+    """Flat, hashable pod resource request.
+
+    Hashability is load-bearing: gang batches of identical replicas (e.g. a
+    TriadSet scaling out) dedupe to one solver row via this hash.
+    """
+
+    groups: Tuple[GroupRequest, ...]
+    misc: CpuRequest
+    hugepages_gb: int
+    map_mode: MapMode
+    node_groups: FrozenSet[str] = frozenset({"default"})
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def needs_gpu(self) -> bool:
+        return any(g.gpus > 0 for g in self.groups)
+
+    def gpu_counts(self) -> List[int]:
+        return [g.gpus for g in self.groups]
+
+    def cpu_slot_counts(self, node_smt: bool) -> List[int]:
+        """Per-slot physical core totals: one slot per group plus the
+        top-level misc cores as the final slot — the reference's
+        misc-as-last-tuple-element convention (Matcher.py:179-201,345)."""
+        counts = [g.cpu_physical(node_smt) for g in self.groups]
+        counts.append(self.misc.physical_cores(node_smt))
+        return counts
+
+    def nic_bw(self) -> List[Tuple[float, float]]:
+        """Per-group (rx, tx) Gbps (reference: CfgTopology.py:219-232)."""
+        return [(g.nic_rx_gbps, g.nic_tx_gbps) for g in self.groups]
+
+    @staticmethod
+    def from_topology(
+        top: PodTopology, node_groups: FrozenSet[str] = frozenset({"default"})
+    ) -> "PodRequest":
+        groups = tuple(
+            GroupRequest(
+                proc=CpuRequest(pg.cpu_proc_request(), pg.proc_smt),
+                misc=CpuRequest(len(pg.misc_cores), pg.helper_smt),
+                gpus=len(pg.gpus),
+                nic_rx_gbps=pg.nic_bw_request()[0],
+                nic_tx_gbps=pg.nic_bw_request()[1],
+            )
+            for pg in top.proc_groups
+        )
+        return PodRequest(
+            groups=groups,
+            misc=CpuRequest(len(top.misc_cores), top.misc_cores_smt),
+            hugepages_gb=top.hugepages_gb,
+            map_mode=top.map_mode,
+            node_groups=node_groups,
+        )

@@ -1,0 +1,3 @@
+from nhd_tpu.solver.oracle import MatchResult, OracleMatcher, find_node
+
+__all__ = ["MatchResult", "OracleMatcher", "find_node"]

@@ -1,0 +1,44 @@
+"""Logger factory for the framework.
+
+Equivalent role to the reference's NHDCommon.GetLogger (NHDCommon.py:20-38):
+one logger per module, colored when attached to a TTY, INFO by default.
+Implemented on stdlib logging only (no colorlog dependency); level is
+overridable via the NHD_TPU_LOG_LEVEL environment variable.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FMT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+
+_COLORS = {
+    "DEBUG": "\033[36m",
+    "INFO": "\033[32m",
+    "WARNING": "\033[33m",
+    "ERROR": "\033[31m",
+    "CRITICAL": "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+
+class _TtyColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        color = _COLORS.get(record.levelname)
+        return f"{color}{msg}{_RESET}" if color else msg
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Return a configured logger for *name* (idempotent per name)."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        fmt_cls = _TtyColorFormatter if sys.stderr.isatty() else logging.Formatter
+        handler.setFormatter(fmt_cls(_FMT))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("NHD_TPU_LOG_LEVEL", "WARNING").upper())
+        logger.propagate = False
+    return logger

@@ -1,0 +1,15 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any jax import — pytest imports conftest first, so setting
+the env here guarantees every test module sees 8 virtual CPU devices,
+giving a multi-chip sharding story without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,108 @@
+"""libconfig reader/writer tests."""
+
+import pytest
+
+from nhd_tpu.config import libconfig
+from nhd_tpu.config.libconfig import ConfigDict, ConfigError
+
+
+def test_scalars():
+    cfg = libconfig.loads(
+        """
+        a = 1;
+        b = -2;
+        c = 3.5;
+        d = true;
+        e = false;
+        f = "hello world";
+        g = 0x1A;
+        h = 10L;
+        i = 1e3;
+        """
+    )
+    assert cfg.a == 1
+    assert cfg.b == -2
+    assert cfg.c == 3.5
+    assert cfg.d is True
+    assert cfg.e is False
+    assert cfg.f == "hello world"
+    assert cfg.g == 26
+    assert cfg.h == 10
+    assert cfg.i == 1000.0
+
+
+def test_colon_assignment_and_comma_terminator():
+    cfg = libconfig.loads("grp : { x = 1, y = 2 };")
+    assert cfg.grp.x == 1 and cfg.grp.y == 2
+
+
+def test_group_list_array_types():
+    cfg = libconfig.loads(
+        """
+        grp = { inner = { v = 7; }; };
+        lst = ( 1, "two", { three = 3; } );
+        arr = [ 1, 2, 3 ];
+        empty_lst = ( );
+        empty_arr = [ ];
+        """
+    )
+    assert isinstance(cfg.grp, ConfigDict)
+    assert cfg.grp.inner.v == 7
+    assert isinstance(cfg.lst, tuple)
+    assert cfg.lst[0] == 1 and cfg.lst[1] == "two" and cfg.lst[2].three == 3
+    assert isinstance(cfg.arr, list) and cfg.arr == [1, 2, 3]
+    assert cfg.empty_lst == ()
+    assert cfg.empty_arr == []
+
+
+def test_comments_and_string_concat():
+    cfg = libconfig.loads(
+        """
+        // line comment
+        # hash comment
+        /* block
+           comment */
+        s = "ab" "cd";
+        t = "esc\\n\\"q\\"";
+        """
+    )
+    assert cfg.s == "abcd"
+    assert cfg.t == 'esc\n"q"'
+
+
+def test_nested_tuples():
+    cfg = libconfig.loads("gpu_map = ( ( -1, 0 ), ( -1, 1 ) );")
+    assert cfg.gpu_map == ((-1, 0), (-1, 1))
+
+
+def test_roundtrip():
+    src = """
+    TopologyCfg : {
+      cpu_arch = "ANY";
+      ext_cores = [ "CtrlCores[0]" ];
+      nested = ( { a = 1; b = [ 1, 2 ]; }, 2.5, "x" );
+    };
+    Hugepages_GB = 16;
+    flag = true;
+    """
+    cfg = libconfig.loads(src)
+    text = libconfig.dumps(cfg)
+    cfg2 = libconfig.loads(text)
+    assert cfg == cfg2
+    # a second round trip is byte-stable
+    assert libconfig.dumps(cfg2) == text
+
+
+def test_attribute_write():
+    cfg = libconfig.loads("a = { b = 1; };")
+    cfg.a.b = 5
+    assert cfg["a"]["b"] == 5
+
+
+def test_errors():
+    with pytest.raises(ConfigError):
+        libconfig.loads("a = ;")
+    with pytest.raises(ConfigError):
+        libconfig.loads("a = { b = 1;")
+    with pytest.raises(ConfigError):
+        libconfig.loads("= 3;")

@@ -1,0 +1,127 @@
+"""HostNode label parsing + resource accounting tests (reference: Node.py)."""
+
+from nhd_tpu.core.node import HostNode
+from nhd_tpu.core.topology import SmtMode
+from nhd_tpu.sim import SynthNodeSpec, make_node, make_node_labels
+
+
+def default_node(**kw):
+    return make_node(SynthNodeSpec(**kw))
+
+
+def test_core_layout_smt():
+    node = default_node(phys_cores=8, sockets=2, smt=True, reserved_cores=2)
+    assert node.numa_nodes == 2
+    assert len(node.cores) == 16
+    # siblings: c <-> c+8
+    assert node.cores[3].sibling == 11
+    assert node.cores[11].sibling == 3
+    # socket blocks: 0-3 socket0, 4-7 socket1 (and same for siblings)
+    assert node.cores[2].socket == 0
+    assert node.cores[6].socket == 1
+    assert node.cores[10].socket == 0
+    # reserved: cores 0,1 and siblings 8,9 are used
+    assert node.cores[0].used and node.cores[8].used
+    assert not node.cores[2].used
+    # free physical cores: socket0 lost 2, socket1 intact
+    assert node.free_cpu_cores_per_numa() == [2, 4]
+
+
+def test_core_layout_no_smt():
+    node = default_node(phys_cores=8, sockets=2, smt=False, reserved_cores=1)
+    assert len(node.cores) == 8
+    assert node.cores[0].sibling == -1
+    assert node.free_cpu_cores_per_numa() == [3, 4]
+
+
+def test_partial_sibling_blocks_pair():
+    node = default_node(phys_cores=8, sockets=2, smt=True, reserved_cores=0)
+    assert node.free_cpu_cores_per_numa() == [4, 4]
+    # claim one logical core: its physical core no longer counts as free
+    node.cores[2].used = True
+    assert node.free_cpu_cores_per_numa() == [3, 4]
+    node.cores[10].used = True  # sibling of 2; no further change
+    assert node.free_cpu_cores_per_numa() == [3, 4]
+
+
+def test_nic_parsing_and_exclusions():
+    spec = SynthNodeSpec(nics_per_numa=2, sriov_pfs=1, slow_nics=2)
+    node = make_node(spec)
+    # 2 per NUMA node schedulable; PFs and slow NICs excluded
+    assert len(node.nics) == 4
+    assert all(n.speed_gbps == 100.0 for n in node.nics)
+    # per-NUMA ordinals assigned in order
+    numa0 = [n for n in node.nics if n.numa_node == 0]
+    assert [n.idx for n in numa0] == [0, 1]
+    # MAC reformatted to colon form
+    assert ":" in node.nics[0].mac and node.nics[0].mac == node.nics[0].mac.upper()
+
+
+def test_nic_bw_sharing_disabled():
+    node = default_node()
+    nic = node.nics[0]
+    assert nic.free_bw() == (90.0, 90.0)
+    nic.pods_used = 1
+    assert nic.free_bw() == (0.0, 0.0)
+
+
+def test_gpu_parsing():
+    node = default_node(gpus_per_numa=2)
+    assert len(node.gpus) == 4
+    assert node.free_gpus_per_numa() == [2, 2]
+    by_sw = node.free_gpus_per_pciesw()
+    assert sum(by_sw.values()) == 4
+    node.gpus[0].used = True
+    assert node.free_gpus_per_numa() == [1, 2]
+
+
+def test_hugepages_reservation():
+    node = make_node(SynthNodeSpec(hugepages_gb=64, reserved_hugepages_gb=4))
+    assert node.mem.free_hugepages_gb == 60
+    assert node.mem.ttl_hugepages_gb == 64
+
+
+def test_free_cpu_batch_smt_pairing():
+    node = default_node(phys_cores=8, sockets=2, smt=True, reserved_cores=0)
+    got = node.free_cpu_batch(0, 4, SmtMode.ON)
+    # pairs handed out together: core then sibling
+    assert got == [0, 8, 1, 9]
+    for c in got:
+        node.cores[c].used = True
+    got2 = node.free_cpu_batch(0, 2, SmtMode.OFF)
+    # SMT-off takes one logical core per fully-free pair
+    assert got2 == [2, 3]
+
+
+def test_maintenance_label():
+    labels = make_node_labels(SynthNodeSpec())
+    labels["sigproc.viasat.io/maintenance"] = "cordoned"
+    node = HostNode("m1")
+    assert node.parse_labels(labels)
+    assert node.maintenance
+    labels["sigproc.viasat.io/maintenance"] = "not_scheduled"
+    node2 = HostNode("m2")
+    assert node2.parse_labels(labels)
+    assert not node2.maintenance
+
+
+def test_busy_window():
+    node = default_node()
+    node.set_busy(now=1000.0)
+    assert node.is_busy(now=1010.0)
+    assert not node.is_busy(now=1031.0)
+
+
+def test_free_cpu_batch_no_duplicates_on_overask():
+    """Over-asking returns a short list, never duplicate or sibling-shared
+    cores (deviation from reference Node.py:502-519, which re-issues pairs)."""
+    node = default_node(phys_cores=8, sockets=2, smt=True, reserved_cores=0)
+    # leave only 2 free pairs on numa 0
+    for c in (0, 1, 8, 9):
+        node.cores[c].used = True
+    got = node.free_cpu_batch(0, 6, SmtMode.ON)
+    assert len(got) == len(set(got)) == 4  # short, not padded with dupes
+    got2 = node.free_cpu_batch(0, 4, SmtMode.OFF)
+    # SMT-averse request never receives both siblings of one physical core
+    assert len(got2) == 2
+    assert all(node.cores[c].sibling not in got2 for c in got2)

@@ -1,0 +1,62 @@
+"""Config-path get/set tests (the magicattr-equivalent indirection layer)."""
+
+import pytest
+
+from nhd_tpu.config import libconfig
+from nhd_tpu.config.paths import PathError, path_get, path_set
+
+SRC = """
+mods = (
+  { module = "m0";
+    dp = ( { rx_cores = [ -1, -1 ]; gpu_map = ( ( -1, 0 ) ); } );
+  }
+);
+CtrlCores = [ -1, -1 ];
+KniVlan = 0;
+"""
+
+
+def test_get():
+    cfg = libconfig.loads(SRC)
+    assert path_get(cfg, "KniVlan") == 0
+    assert path_get(cfg, "CtrlCores[1]") == -1
+    assert path_get(cfg, "mods[0].module") == "m0"
+    assert path_get(cfg, "mods[0].dp[0].rx_cores[1]") == -1
+    assert path_get(cfg, "mods[0].dp[0].gpu_map[0][1]") == 0
+
+
+def test_set_scalar_and_array():
+    cfg = libconfig.loads(SRC)
+    path_set(cfg, "KniVlan", 42)
+    path_set(cfg, "CtrlCores[0]", 7)
+    assert cfg.KniVlan == 42
+    assert cfg.CtrlCores == [7, -1]
+
+
+def test_set_inside_tuple_rebuilds():
+    cfg = libconfig.loads(SRC)
+    path_set(cfg, "mods[0].dp[0].rx_cores[0]", 9)
+    assert path_get(cfg, "mods[0].dp[0].rx_cores[0]") == 9
+    # sibling values untouched
+    assert path_get(cfg, "mods[0].dp[0].rx_cores[1]") == -1
+    assert path_get(cfg, "mods[0].module") == "m0"
+
+
+def test_set_nested_tuple_element():
+    cfg = libconfig.loads(SRC)
+    path_set(cfg, "mods[0].dp[0].gpu_map[0][0]", 3)
+    assert path_get(cfg, "mods[0].dp[0].gpu_map[0]") == (3, 0)
+
+
+def test_set_whole_key():
+    cfg = libconfig.loads(SRC)
+    path_set(cfg, "Network_Config", ({"mac": "AA"},))
+    assert cfg.Network_Config[0]["mac"] == "AA"
+
+
+def test_errors():
+    cfg = libconfig.loads(SRC)
+    with pytest.raises(PathError):
+        path_get(cfg, "nope.deeper")
+    with pytest.raises(PathError):
+        path_get(cfg, "CtrlCores[9]")

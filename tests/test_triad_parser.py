@@ -1,0 +1,172 @@
+"""Triad config ⇄ topology round-trip tests (reference: TriadCfgParser.py)."""
+
+from nhd_tpu.config import libconfig
+from nhd_tpu.config.parser import get_cfg_parser
+from nhd_tpu.config.triad import TriadCfgParser
+from nhd_tpu.core.request import PodRequest
+from nhd_tpu.core.topology import MapMode, NicDir, SmtMode
+from nhd_tpu.sim import make_triad_config
+
+
+def parse(text):
+    p = TriadCfgParser(text)
+    top = p.to_topology(False)
+    assert top is not None
+    return p, top
+
+
+def test_basic_parse():
+    text = make_triad_config(
+        n_groups=2,
+        nic_pairs_per_group=1,
+        rx_gbps=10.0,
+        tx_gbps=5.0,
+        cpu_workers=2,
+        gpus_per_group=1,
+        feeders_per_gpu=2,
+        helpers_per_group=1,
+        ext_cores=2,
+        hugepages_gb=8,
+    )
+    _, top = parse(text)
+    assert len(top.proc_groups) == 2
+    assert top.hugepages_gb == 8
+    assert top.map_mode == MapMode.NUMA
+    assert len(top.misc_cores) == 2
+    assert top.ctrl_vlan.name == "KniVlan"
+
+    pg = top.proc_groups[0]
+    # 2 NIC cores (rx+tx) + 2 cpu workers; gpu feeders live on the GPU
+    assert len(pg.proc_cores) == 4
+    assert len(pg.gpus) == 1
+    assert len(pg.gpus[0].cpu_cores) == 2
+    assert len(pg.misc_cores) == 1
+    assert pg.proc_smt == SmtMode.ON
+
+    rx = [c for c in pg.proc_cores if c.nic_dir == NicDir.RX]
+    tx = [c for c in pg.proc_cores if c.nic_dir == NicDir.TX]
+    assert len(rx) == 1 and rx[0].nic_speed == 10.0
+    assert len(tx) == 1 and tx[0].nic_speed == 5.0
+    assert len(top.nic_pairs) == 2  # one per group
+
+
+def test_request_extraction():
+    text = make_triad_config(
+        n_groups=1,
+        nic_pairs_per_group=2,
+        rx_gbps=10.0,
+        tx_gbps=5.0,
+        cpu_workers=1,
+        gpus_per_group=2,
+        feeders_per_gpu=1,
+        helpers_per_group=3,
+        ext_cores=2,
+        hugepages_gb=4,
+    )
+    _, top = parse(text)
+    req = PodRequest.from_topology(top)
+    assert req.n_groups == 1
+    g = req.groups[0]
+    # proc = 2 rx + 2 tx + 1 worker + 2 gpu feeders = 7
+    assert g.proc.count == 7
+    assert g.misc.count == 3
+    assert g.gpus == 2
+    assert g.nic_rx_gbps == 20.0
+    assert g.nic_tx_gbps == 10.0
+    assert req.misc.count == 2
+    assert req.hugepages_gb == 4
+    # SMT-on proc request on an SMT node: ceil(7/2) + ceil(3/2) = 4 + 2
+    assert g.cpu_physical(node_smt=True) == 6
+    assert g.cpu_physical(node_smt=False) == 10
+    assert req.cpu_slot_counts(True) == [6, 1]
+
+
+def test_mandatory_field_enforcement():
+    text = make_triad_config().replace('cpu_arch = "ANY";', "")
+    p = TriadCfgParser(text)
+    assert p.to_topology(False) is None
+
+
+def test_registry_default():
+    text = make_triad_config()
+    p = get_cfg_parser(None, text)
+    assert isinstance(p, TriadCfgParser)
+    p2 = get_cfg_parser("triad", text)
+    assert p2.to_topology(False) is not None
+
+
+def test_write_back_roundtrip():
+    """Solve-side write-back: fill physical IDs, serialize, re-parse with
+    parse_net=True, and check the deployed-config path reloads the same
+    assignment (reference round trip: TriadCfgParser.py:337-380 ⇄ 413-459)."""
+    text = make_triad_config(
+        n_groups=1,
+        nic_pairs_per_group=1,
+        cpu_workers=1,
+        gpus_per_group=1,
+        feeders_per_gpu=1,
+        helpers_per_group=1,
+        ext_cores=1,
+    )
+    p, top = parse(text)
+
+    # simulate the scheduler's assignment
+    next_core = iter(range(10, 40))
+    for pg in top.proc_groups:
+        pg.vlan.vlan = 812
+        for c in pg.proc_cores:
+            c.core = next(next_core)
+        for c in pg.misc_cores:
+            c.core = next(next_core)
+        for gpu in pg.gpus:
+            gpu.device_id = 1
+            for c in gpu.cpu_cores:
+                c.core = next(next_core)
+    for c in top.misc_cores:
+        c.core = next(next_core)
+    top.ctrl_vlan.vlan = 812
+    top.set_data_default_gw("10.1.0.1/32")
+    for pair in top.nic_pairs:
+        pair.mac = "0C:42:A1:00:00:00"
+
+    out = p.to_config()
+    cfg = libconfig.loads(out)
+
+    # all placeholders replaced
+    assert -1 not in cfg.CtrlCores
+    assert cfg.KniVlan == 812
+    assert cfg.mods[0].vlan == 812
+    dp = cfg.mods[0].dp[0]
+    assert all(c >= 10 for c in dp.rx_cores + dp.tx_cores + dp.cpu_workers)
+    assert dp.gpu_map[0][1] == 1
+
+    # Network_Config synthesized per MAC
+    assert len(cfg.Network_Config) == 1
+    net = cfg.Network_Config[0]
+    assert net.mac == "0C:42:A1:00:00:00"
+    assert net.gwIps == ["10.1.0.1/32"]
+
+    # deployed-config replay parses and reloads the NIC pairing
+    p2 = TriadCfgParser(out)
+    top2 = p2.to_topology(True)
+    assert top2 is not None
+    assert top2.nic_pairs[0].mac == "0C:42:A1:00:00:00"
+    assert [c.core for c in top2.misc_cores] == [c.core for c in top.misc_cores]
+
+
+def test_gpu_map_annotation():
+    text = make_triad_config(gpus_per_group=2, feeders_per_gpu=1, n_groups=1)
+    p, top = parse(text)
+    for i, gpu in enumerate(top.proc_groups[0].gpus):
+        gpu.device_id = 5 + i
+    assert p.to_gpu_map() == {"nvidia0": 5, "nvidia1": 6}
+
+
+def test_gpu_map_annotation_multi_group():
+    """nvidia<i> index runs across proc groups (deviation from reference
+    TriadCfgParser.py:403, which overwrote earlier groups' entries)."""
+    text = make_triad_config(n_groups=2, gpus_per_group=1, feeders_per_gpu=1)
+    p, top = parse(text)
+    top.proc_groups[0].gpus[0].device_id = 2
+    top.proc_groups[1].gpus[0].device_id = 3
+    assert p.to_gpu_map() == {"nvidia0": 2, "nvidia1": 3}
