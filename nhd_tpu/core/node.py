@@ -138,7 +138,10 @@ class HostNode:
         self.data_vlan = 0
         self.gwip = "0.0.0.0/32"
         self.pod_info: Dict[Tuple[str, str], PodTopology] = {}
-        self._busy_time = 0.0
+        # -inf: a node that never took a placement is never "busy", whatever
+        # clock epoch the caller uses (the reference's 0.0 init relies on
+        # time.monotonic() being large, Node.py:115)
+        self._busy_time = float("-inf")
 
     # ------------------------------------------------------------------
     # label parsing

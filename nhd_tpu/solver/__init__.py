@@ -1,3 +1,37 @@
+"""Matchers and batch scheduling.
+
+The serial oracle is pure Python; the batched solver pulls in jax. Jax-
+dependent symbols are exported lazily so `from nhd_tpu.solver import
+OracleMatcher` (the baseline path) neither requires jax nor pays its
+import cost.
+"""
+
 from nhd_tpu.solver.oracle import MatchResult, OracleMatcher, find_node
 
-__all__ = ["MatchResult", "OracleMatcher", "find_node"]
+__all__ = [
+    "BatchAssignment",
+    "BatchItem",
+    "BatchScheduler",
+    "BatchStats",
+    "JaxMatcher",
+    "MatchResult",
+    "OracleMatcher",
+    "find_node",
+]
+
+_LAZY = {
+    "BatchAssignment": "nhd_tpu.solver.batch",
+    "BatchItem": "nhd_tpu.solver.batch",
+    "BatchScheduler": "nhd_tpu.solver.batch",
+    "BatchStats": "nhd_tpu.solver.batch",
+    "JaxMatcher": "nhd_tpu.solver.jax_matcher",
+}
+
+
+def __getattr__(name: str):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

@@ -1,0 +1,333 @@
+"""Gang/batch scheduling: greedy rounds over the batched solve.
+
+The reference schedules strictly sequentially — each pod's claim mutates
+node state before the next pod is considered (NHDScheduler.py:425-436).
+A 10k-pod batch can't afford 10k serial solves, so this module runs
+*greedy rounds* (SURVEY §7 hard part 2):
+
+  round:  1. one batched feasibility solve against the current state
+          2. every pending pod picks a candidate by the reference's
+             selection rule; pods of the same type fan out across that
+             type's candidate list by rank (distinct nodes)
+          3. conflicts (two pods → one node) go to the lowest pod index;
+             losers retry next round
+          4. winners' physical assignments are applied (host mirror is
+             authoritative), state re-encoded, next round
+
+Serializability: at most one pod claims any node per round and each
+assignment was feasible at round start, so applying a round's winners in
+pod-index order is a valid sequential execution — every claim was feasible
+when made. Placement can differ from the reference's strict order (pod k
+may land on a node the reference would have filled with pod k-1's
+neighbors), which is the documented semantic extension that buys the
+~100× throughput; single-pod batches reproduce the oracle exactly.
+
+Busy back-off note: with respect_busy=True (live default) a node accepts
+at most one GPU pod per MIN_BUSY_SECS, exactly like the reference
+(Matcher.py:103-111) — a 10k-GPU-pod benchmark must disable it, as the
+back-off, not the solver, becomes the rate limit.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nhd_tpu.core.node import AssignmentError, HostNode
+from nhd_tpu.core.request import PodRequest
+from nhd_tpu.core.topology import MapMode, PodTopology
+from nhd_tpu.solver.encode import encode_cluster, encode_pods, refresh_node_row
+from nhd_tpu.solver.fast_assign import (
+    AssignRecord,
+    FastAssignError,
+    FastCluster,
+    apply_record_to_topology,
+)
+from nhd_tpu.solver.jax_matcher import decode_mapping
+from nhd_tpu.solver.kernel import solve_bucket
+from nhd_tpu.utils import get_logger
+
+
+@dataclass
+class BatchItem:
+    """One pod to place: its numeric request plus (optionally) the full
+    topology object to fill with physical IDs."""
+
+    key: Tuple[str, str]                 # (namespace, podname)
+    request: PodRequest
+    topology: Optional[PodTopology] = None
+
+
+@dataclass
+class BatchAssignment:
+    key: Tuple[str, str]
+    node: Optional[str]                  # None → unschedulable
+    mapping: Optional[Dict[str, tuple]] = None
+    nic_list: Optional[list] = None      # (nic_index, speed, dir) consumed
+    round_no: int = -1
+
+
+from collections import namedtuple
+
+SolveHost = namedtuple("SolveHost", "cand pref best_c best_m best_a n_combos")
+
+
+@dataclass
+class BatchStats:
+    rounds: int = 0
+    solve_seconds: float = 0.0
+    select_seconds: float = 0.0
+    assign_seconds: float = 0.0
+    scheduled: int = 0
+    failed: int = 0
+
+
+class BatchScheduler:
+    """Schedules a whole pending batch against the host node mirror.
+
+    ``use_fast`` (default) routes physical assignment through the
+    vectorized FastCluster (solver/fast_assign.py) and syncs the HostNode
+    mirror once at the end; with it off, every winner goes through
+    HostNode.assign_physical_ids object-by-object (the reference path) —
+    kept for cross-checking, ~13× slower per pod.
+    """
+
+    def __init__(
+        self,
+        *,
+        respect_busy: bool = True,
+        max_rounds: int = 10_000,
+        use_fast: bool = True,
+        register_pods: bool = True,
+    ):
+        self.logger = get_logger(__name__)
+        self.respect_busy = respect_busy
+        self.max_rounds = max_rounds
+        self.use_fast = use_fast
+        self.register_pods = register_pods
+
+    def schedule(
+        self,
+        nodes: Dict[str, HostNode],
+        items: Sequence[BatchItem],
+        *,
+        now: Optional[float] = None,
+        apply: bool = True,
+    ) -> Tuple[List[BatchAssignment], BatchStats]:
+        """Place every item it can; mutates ``nodes`` when ``apply``.
+
+        Items without a topology get a synthetic one (sim.requests), so
+        physical assignment always runs — claims must hit the host mirror
+        for subsequent rounds to see them.
+        """
+        from nhd_tpu.sim.requests import request_to_topology
+
+        stats = BatchStats()
+        results: List[BatchAssignment] = [
+            BatchAssignment(it.key, None) for it in items
+        ]
+        pending: List[int] = [
+            i for i, it in enumerate(items)
+            if it.request.map_mode in (MapMode.NUMA, MapMode.PCI)
+        ]
+        if now is None:
+            now = time.monotonic()
+
+        node_list = list(nodes.values())
+        cluster = encode_cluster(nodes, now=now)
+        if not self.respect_busy:
+            cluster.busy[:] = False
+        fast = (
+            FastCluster(nodes, cluster.U, cluster.K, arrays=cluster)
+            if (self.use_fast and apply)
+            else None
+        )
+        records: Dict[int, AssignRecord] = {}
+
+        for round_no in range(self.max_rounds):
+            if not pending:
+                break
+            stats.rounds = round_no + 1
+
+            t0 = time.perf_counter()
+            buckets = encode_pods(
+                [items[i].request for i in pending],
+                cluster.interner,
+                indices=pending,
+            )
+            # pod index → (node index, bucket G, type) chosen this round
+            claims: Dict[int, Tuple[int, int, int]] = {}
+            bucket_out = {}
+            for G, pods in buckets.items():
+                out = solve_bucket(cluster, pods)
+                # pull results to host once — element reads off jax arrays
+                # cost ~0.2 ms each and the winner loop does three per pod
+                bucket_out[G] = (pods, SolveHost(*map(np.asarray, out)))
+            stats.solve_seconds += time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            node_claimed: Dict[int, int] = {}  # node index → pod index
+            for G, (pods, out) in bucket_out.items():
+                cand = out.cand
+                pref = out.pref
+                N = cand.shape[1]
+                sel_val = np.where(
+                    cand, pref * (N + 1) + (N - np.arange(N))[None, :], 0
+                )
+                # rank-ordered candidate nodes per type (desc value)
+                order = np.argsort(-sel_val, axis=1, kind="stable")
+                n_cands = (sel_val > 0).sum(axis=1)
+
+                if not apply:
+                    # dry-run: every pod reports its own snapshot match (the
+                    # reference's FindNode answer), with no contention model —
+                    # a conflict "loser" here would wrongly read as
+                    # unschedulable when capacity exists elsewhere
+                    for t, pod_i in zip(pods.pod_type, pods.pod_index):
+                        t = int(t)
+                        if n_cands[t] > 0:
+                            claims[int(pod_i)] = (int(order[t, 0]), G, t)
+                    continue
+
+                # fan pods of one type across its candidates by rank
+                rank_in_type: Dict[int, int] = {}
+                for t, pod_i in zip(pods.pod_type, pods.pod_index):
+                    t = int(t)
+                    r = rank_in_type.get(t, 0)
+                    if r >= n_cands[t]:
+                        continue  # no node left for this pod this round
+                    rank_in_type[t] = r + 1
+                    n = int(order[t, r])
+                    pod_i = int(pod_i)
+                    prev = node_claimed.get(n)
+                    if prev is None or pod_i < prev:
+                        if prev is not None:
+                            claims.pop(prev)
+                        node_claimed[n] = pod_i
+                        claims[pod_i] = (n, G, t)
+            stats.select_seconds += time.perf_counter() - t0
+
+            if not claims:
+                break  # no pod could be placed: remaining are unschedulable
+
+            t0 = time.perf_counter()
+            newly_scheduled: List[int] = []
+            for pod_i, (n, G, t) in claims.items():
+                pods, out = bucket_out[G]
+                mapping = decode_mapping(
+                    G, cluster.U, cluster.K,
+                    int(out.best_c[t, n]), int(out.best_m[t, n]),
+                    int(out.best_a[t, n]),
+                )
+                node = node_list[n]
+                item = items[pod_i]
+                if not apply:
+                    # dry-run: snapshot match per pod (no claims, see below)
+                    results[pod_i] = BatchAssignment(
+                        item.key, node.name, mapping, None, round_no
+                    )
+                    newly_scheduled.append(pod_i)
+                    continue
+
+                if fast is not None:
+                    try:
+                        rec = fast.assign(n, mapping, item.request)
+                    except FastAssignError as exc:
+                        self.logger.error(
+                            f"assignment failed for {item.key} on {node.name}: {exc}"
+                        )
+                        results[pod_i] = BatchAssignment(item.key, None)
+                        newly_scheduled.append(pod_i)
+                        stats.failed += 1
+                        continue
+                    records[pod_i] = rec
+                    if self.respect_busy:
+                        cluster.busy[n] = True
+                    results[pod_i] = BatchAssignment(
+                        item.key, node.name, mapping, rec.nic_list, round_no
+                    )
+                    newly_scheduled.append(pod_i)
+                    stats.scheduled += 1
+                    continue
+
+                # object path (reference-style, for cross-checking)
+                try:
+                    top = item.topology or request_to_topology(item.request)
+                except ValueError as exc:
+                    self.logger.error(
+                        f"cannot materialize topology for {item.key}: {exc}"
+                    )
+                    results[pod_i] = BatchAssignment(item.key, None)
+                    newly_scheduled.append(pod_i)
+                    stats.failed += 1
+                    continue
+                node.set_busy(now)  # reference: NHDScheduler.py:289
+                try:
+                    nic_list = node.assign_physical_ids(mapping, top)
+                except AssignmentError as exc:
+                    # promised mapping didn't materialize (PCI quirk etc.):
+                    # fail the pod like the reference (NHDScheduler.py:296-299)
+                    self.logger.error(
+                        f"assignment failed for {item.key} on {node.name}: {exc}"
+                    )
+                    results[pod_i] = BatchAssignment(item.key, None)
+                    newly_scheduled.append(pod_i)  # drop from pending
+                    stats.failed += 1
+                    continue
+                nidx = sorted({x[0] for x in nic_list})
+                node.claim_nic_pods(nidx)
+                node.add_scheduled_pod(item.key[1], item.key[0], top)
+                results[pod_i] = BatchAssignment(
+                    item.key, node.name, mapping, nic_list, round_no
+                )
+                newly_scheduled.append(pod_i)
+                stats.scheduled += 1
+            stats.assign_seconds += time.perf_counter() - t0
+
+            # incremental device-state update: the fast path maintained the
+            # arrays at assign time; the object path re-projects claimed rows
+            if fast is None:
+                t0 = time.perf_counter()
+                for n in node_claimed:
+                    refresh_node_row(cluster, n, node_list[n], now=now)
+                    if not self.respect_busy:
+                        cluster.busy[n] = False
+                stats.assign_seconds += time.perf_counter() - t0
+
+            done = set(newly_scheduled)
+            pending = [i for i in pending if i not in done]
+            if not apply:
+                break  # without claims, later rounds would repeat choices
+
+        # fast path: one final sync of the HostNode mirror + topology fills
+        if fast is not None:
+            t0 = time.perf_counter()
+            fast.sync_to_nodes()
+            for pod_i, rec in records.items():
+                item = items[pod_i]
+                node = node_list[rec.node_index]
+                node.set_busy(now)
+                if item.topology is not None:
+                    apply_record_to_topology(rec, item.topology)
+                    if self.register_pods:
+                        node.add_scheduled_pod(
+                            item.key[1], item.key[0], item.topology
+                        )
+                elif self.register_pods:
+                    try:
+                        top = request_to_topology(item.request)
+                    except ValueError as exc:
+                        # the pod IS scheduled (claims applied); only the
+                        # bookkeeping object can't be synthesized
+                        self.logger.warning(
+                            f"skipping pod registration for {item.key}: {exc}"
+                        )
+                        continue
+                    apply_record_to_topology(rec, top)
+                    node.add_scheduled_pod(item.key[1], item.key[0], top)
+            stats.assign_seconds += time.perf_counter() - t0
+
+        return results, stats

@@ -1,0 +1,244 @@
+"""Dense tensor encodings of cluster state and pod batches.
+
+Host-side HostNode objects stay the source of truth (SURVEY §5.4 stance:
+device state must always be re-derivable from host state); this module
+projects them into packed numpy arrays the jitted solver consumes, and
+dedupes a pod batch into *types* — identical PodRequests share one solver
+row, which is what makes gang batches (a TriadSet scaling to thousands of
+replicas, BASELINE config 4) cheap: feasibility is O(types × nodes), not
+O(pods × nodes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nhd_tpu.core.node import HostNode
+from nhd_tpu.core.request import PodRequest
+from nhd_tpu.core.topology import MapMode
+
+MAX_GROUP_BITS = 63  # node-group bitmask width (int64, sign bit unused)
+
+
+class GroupInterner:
+    """Node-group names → bit positions, shared across cluster and pods."""
+
+    def __init__(self) -> None:
+        self._bits: Dict[str, int] = {}
+
+    def mask(self, names) -> int:
+        m = 0
+        for name in names:
+            bit = self._bits.get(name)
+            if bit is None:
+                bit = len(self._bits)
+                if bit >= MAX_GROUP_BITS:
+                    raise ValueError(
+                        f"more than {MAX_GROUP_BITS} distinct node groups"
+                    )
+                self._bits[name] = bit
+            m |= 1 << bit
+        return m
+
+
+@dataclass
+class ClusterArrays:
+    """Packed per-node state. Shapes: N nodes, U NUMA (padded), K NICs/NUMA
+    (padded), S PCIe switches per node (padded)."""
+
+    names: List[str]
+    U: int
+    K: int
+    S: int
+    numa_nodes: np.ndarray     # [N] int8
+    smt: np.ndarray            # [N] bool
+    active: np.ndarray         # [N] bool
+    maintenance: np.ndarray    # [N] bool
+    busy: np.ndarray           # [N] bool (pre-resolved against `now`)
+    gpuless: np.ndarray        # [N] bool — node has zero GPUs total
+    group_mask: np.ndarray     # [N] int64
+    hp_free: np.ndarray        # [N] int32
+    cpu_free: np.ndarray       # [N, U] int32 — fully-free physical cores
+    gpu_free: np.ndarray       # [N, U] int32
+    nic_count: np.ndarray      # [N, U] int32
+    nic_free: np.ndarray       # [N, U, K, 2] float32 — rx/tx headroom Gbps
+    nic_sw: np.ndarray         # [N, U, K] int32 — dense per-node switch id, -1 none
+    gpu_free_sw: np.ndarray    # [N, S] int32 — free GPUs per dense switch id
+    interner: GroupInterner = field(default_factory=GroupInterner)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.names)
+
+
+def encode_cluster(
+    nodes: Dict[str, HostNode],
+    *,
+    now: Optional[float] = None,
+    interner: Optional[GroupInterner] = None,
+) -> ClusterArrays:
+    """Project HostNodes into dense arrays (one row per node, name order =
+    dict insertion order = the reference's node iteration order)."""
+    names = list(nodes.keys())
+    nl = [nodes[n] for n in names]
+    N = len(nl)
+    U = max((n.numa_nodes for n in nl), default=1) or 1
+    K = 1
+    S = 1
+    for node in nl:
+        per_numa = [0] * node.numa_nodes
+        for nic in node.nics:
+            if nic.numa_node < node.numa_nodes:
+                per_numa[nic.numa_node] += 1
+        K = max(K, max(per_numa, default=0))
+        switches = {g.pciesw for g in node.gpus} | {n.pciesw for n in node.nics}
+        S = max(S, len(switches))
+
+    interner = interner or GroupInterner()
+    arr = ClusterArrays(
+        names=names, U=U, K=K, S=S,
+        numa_nodes=np.zeros(N, np.int8),
+        smt=np.zeros(N, bool),
+        active=np.zeros(N, bool),
+        maintenance=np.zeros(N, bool),
+        busy=np.zeros(N, bool),
+        gpuless=np.zeros(N, bool),
+        group_mask=np.zeros(N, np.int64),
+        hp_free=np.zeros(N, np.int32),
+        cpu_free=np.zeros((N, U), np.int32),
+        gpu_free=np.zeros((N, U), np.int32),
+        nic_count=np.zeros((N, U), np.int32),
+        nic_free=np.full((N, U, K, 2), -1.0, np.float32),
+        nic_sw=np.full((N, U, K), -1, np.int32),
+        gpu_free_sw=np.zeros((N, S), np.int32),
+        interner=interner,
+    )
+    for i, node in enumerate(nl):
+        refresh_node_row(arr, i, node, now=now)
+    return arr
+
+
+def refresh_node_row(
+    arr: ClusterArrays, i: int, node: HostNode, *, now: Optional[float] = None
+) -> None:
+    """Re-project one node into row *i* (incremental update path)."""
+    U, K, S = arr.U, arr.K, arr.S
+    arr.numa_nodes[i] = node.numa_nodes
+    arr.smt[i] = node.smt_enabled
+    arr.active[i] = node.active
+    arr.maintenance[i] = node.maintenance
+    arr.busy[i] = node.is_busy(now)
+    arr.gpuless[i] = node.total_gpus() == 0
+    arr.group_mask[i] = arr.interner.mask(node.groups)
+    arr.hp_free[i] = node.mem.free_hugepages_gb
+
+    arr.cpu_free[i] = 0
+    cpu = node.free_cpu_cores_per_numa()
+    arr.cpu_free[i, : len(cpu)] = cpu
+
+    arr.gpu_free[i] = 0
+    gpu = node.free_gpus_per_numa()
+    arr.gpu_free[i, : len(gpu)] = gpu
+
+    arr.nic_count[i] = 0
+    arr.nic_free[i] = -1.0
+    arr.nic_sw[i] = -1
+
+    # dense per-node PCIe switch ids, in sorted order for determinism
+    switches = sorted({g.pciesw for g in node.gpus} | {n.pciesw for n in node.nics})
+    sw_id = {sw: j for j, sw in enumerate(switches)}
+
+    for nic in node.nics:
+        u, k = nic.numa_node, nic.idx
+        if u >= U or k >= K:
+            continue
+        rx, tx = nic.free_bw()
+        arr.nic_free[i, u, k, 0] = rx
+        arr.nic_free[i, u, k, 1] = tx
+        arr.nic_sw[i, u, k] = sw_id[nic.pciesw]
+        arr.nic_count[i, u] = max(arr.nic_count[i, u], k + 1)
+
+    arr.gpu_free_sw[i] = 0
+    for g in node.gpus:
+        if not g.used and sw_id.get(g.pciesw, S) < S:
+            arr.gpu_free_sw[i, sw_id[g.pciesw]] += 1
+
+
+@dataclass
+class PodTypeArrays:
+    """Deduped pod-type tensors for one group-count bucket (G groups)."""
+
+    G: int
+    requests: List[PodRequest]      # one exemplar per type, type order
+    pod_type: np.ndarray            # [P] int32 — type index of each input pod
+    pod_index: np.ndarray           # [P] int64 — original batch positions
+    cpu_dem_smt: np.ndarray         # [T, G+1] int32 (node-SMT-enabled demand)
+    cpu_dem_raw: np.ndarray         # [T, G+1] int32
+    gpu_dem: np.ndarray             # [T, G] int32
+    rx: np.ndarray                  # [T, G] float32
+    tx: np.ndarray                  # [T, G] float32
+    hp: np.ndarray                  # [T] int32
+    needs_gpu: np.ndarray           # [T] bool
+    map_pci: np.ndarray             # [T] bool
+    group_mask: np.ndarray          # [T] int64
+
+    @property
+    def n_types(self) -> int:
+        return len(self.requests)
+
+
+def encode_pods(
+    pods: Sequence[PodRequest],
+    interner: GroupInterner,
+    indices: Optional[Sequence[int]] = None,
+) -> Dict[int, PodTypeArrays]:
+    """Bucket a pod batch by group count and dedupe identical requests into
+    types. Returns {n_groups: PodTypeArrays}."""
+    if indices is None:
+        indices = range(len(pods))
+    buckets: Dict[int, Tuple[List[PodRequest], List[int], List[int], Dict[PodRequest, int]]] = {}
+    for pod, idx in zip(pods, indices):
+        G = pod.n_groups
+        reqs, types, positions, seen = buckets.setdefault(G, ([], [], [], {}))
+        t = seen.get(pod)
+        if t is None:
+            t = len(reqs)
+            seen[pod] = t
+            reqs.append(pod)
+        types.append(t)
+        positions.append(idx)
+
+    out: Dict[int, PodTypeArrays] = {}
+    for G, (reqs, types, positions, _) in buckets.items():
+        T = len(reqs)
+        arr = PodTypeArrays(
+            G=G,
+            requests=reqs,
+            pod_type=np.asarray(types, np.int32),
+            pod_index=np.asarray(positions, np.int64),
+            cpu_dem_smt=np.zeros((T, G + 1), np.int32),
+            cpu_dem_raw=np.zeros((T, G + 1), np.int32),
+            gpu_dem=np.zeros((T, G), np.int32),
+            rx=np.zeros((T, G), np.float32),
+            tx=np.zeros((T, G), np.float32),
+            hp=np.zeros(T, np.int32),
+            needs_gpu=np.zeros(T, bool),
+            map_pci=np.zeros(T, bool),
+            group_mask=np.zeros(T, np.int64),
+        )
+        for t, r in enumerate(reqs):
+            arr.cpu_dem_smt[t] = r.cpu_slot_counts(node_smt=True)
+            arr.cpu_dem_raw[t] = r.cpu_slot_counts(node_smt=False)
+            arr.gpu_dem[t] = r.gpu_counts()
+            for g, (rx, tx) in enumerate(r.nic_bw()):
+                arr.rx[t, g] = rx
+                arr.tx[t, g] = tx
+            arr.hp[t] = r.hugepages_gb
+            arr.needs_gpu[t] = r.needs_gpu
+            arr.map_pci[t] = r.map_mode == MapMode.PCI
+            arr.group_mask[t] = interner.mask(r.node_groups)
+        out[G] = arr
+    return out

@@ -1,0 +1,116 @@
+"""JaxMatcher: the oracle-compatible front door of the batched solver.
+
+Implements the same contract as OracleMatcher.find_node (and therefore the
+reference's Matcher.FindNode, Matcher.py:27) but runs the feasibility solve
+as one jitted tensor program — and, through find_nodes, amortizes it over a
+whole pending batch at once (the BASELINE.json north star).
+
+Selection semantics mirror the oracle exactly for a single pod:
+* node: preferred (CPU-only pod × GPU-less node) first, then first
+  candidate in node order;
+* combo: skew-maximal feasible, first wins;
+* misc NUMA / NIC pick: first feasible in product order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from nhd_tpu.core.node import HostNode
+from nhd_tpu.core.request import PodRequest
+from nhd_tpu.core.topology import MapMode, PodTopology
+from nhd_tpu.solver.combos import get_tables
+from nhd_tpu.solver.encode import encode_cluster, encode_pods
+from nhd_tpu.solver.kernel import solve_bucket
+from nhd_tpu.solver.oracle import MatchResult
+from nhd_tpu.utils import get_logger
+
+
+def decode_mapping(G: int, U: int, K: int, c: int, m: int, a: int) -> Dict[str, tuple]:
+    """(combo, misc-numa, pick) indices → the oracle's mapping dict."""
+    tables = get_tables(G, U, K)
+    combo = tuple(int(x) for x in tables.combo[c])
+    pick = tuple(int(x) for x in tables.pick[a])
+    return {
+        "gpu": combo,
+        "cpu": combo + (int(m),),
+        "nic": tuple(zip(combo, pick)),
+    }
+
+
+class JaxMatcher:
+    """Batched matcher over a host-side node mirror."""
+
+    def __init__(self) -> None:
+        self.logger = get_logger(__name__)
+
+    def find_node(
+        self,
+        nodes: Dict[str, HostNode],
+        req: Union[PodRequest, PodTopology],
+        *,
+        now: Optional[float] = None,
+        respect_busy: bool = True,
+    ) -> Optional[MatchResult]:
+        """Single-pod entry point, drop-in for OracleMatcher.find_node."""
+        if isinstance(req, PodTopology):
+            req = PodRequest.from_topology(req)
+        results = self.find_nodes(
+            nodes, [req], now=now, respect_busy=respect_busy
+        )
+        return results[0]
+
+    def find_nodes(
+        self,
+        nodes: Dict[str, HostNode],
+        reqs: Sequence[PodRequest],
+        *,
+        now: Optional[float] = None,
+        respect_busy: bool = True,
+    ) -> List[Optional[MatchResult]]:
+        """Evaluate a whole pending batch against the *current* state at
+        once. Every result is computed against the same snapshot — no claims
+        are applied between pods (that is BatchScheduler's job)."""
+        results: List[Optional[MatchResult]] = [None] * len(reqs)
+
+        valid_idx = [
+            i for i, r in enumerate(reqs)
+            if r.map_mode in (MapMode.NUMA, MapMode.PCI)
+        ]
+        if not valid_idx:
+            return results
+
+        cluster = encode_cluster(nodes, now=now)
+        if not respect_busy:
+            cluster.busy[:] = False
+        buckets = encode_pods(
+            [reqs[i] for i in valid_idx], cluster.interner, indices=valid_idx
+        )
+
+        for G, pods in buckets.items():
+            out = solve_bucket(cluster, pods)
+            cand = np.asarray(out.cand)
+            pref = np.asarray(out.pref)
+            best_c = np.asarray(out.best_c)
+            best_m = np.asarray(out.best_m)
+            best_a = np.asarray(out.best_a)
+
+            N = cand.shape[1]
+            # lexicographic (pref desc, node index asc) via one argmax
+            sel_val = pref * (N + 1) + (N - np.arange(N))[None, :]
+            sel_val = np.where(cand, sel_val, 0)
+            node_pick = np.argmax(sel_val, axis=1)  # [T]
+            has_node = sel_val[np.arange(len(node_pick)), node_pick] > 0
+
+            for t, pod_i in zip(pods.pod_type, pods.pod_index):
+                if not has_node[t]:
+                    continue
+                n = int(node_pick[t])
+                mapping = decode_mapping(
+                    G, cluster.U, cluster.K,
+                    int(best_c[t, n]), int(best_m[t, n]), int(best_a[t, n]),
+                )
+                results[int(pod_i)] = MatchResult(cluster.names[n], mapping)
+        return results

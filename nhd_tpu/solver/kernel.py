@@ -1,0 +1,221 @@
+"""The batched feasibility solve: one jitted function per bucket shape.
+
+This is the TPU replacement for the reference's per-pod Python walk
+(Matcher.py:86-391): every predicate becomes a broadcasted boolean tensor
+over [T types, N nodes, C numa-combos, A nic-picks], reduced with any/all.
+XLA fuses the comparison lattices into the reductions, so the big
+intermediates never materialize; the combo tables ride as constants.
+
+Outputs are the *decisions* the host needs, already reduced to [T, N]:
+candidacy, the selection preference, and the argmax-encoded best combo /
+misc-NUMA / NIC-pick — tie-breaking matches the oracle because combo axes
+are in itertools.product order (see combos.py) and jnp.argmax returns the
+first maximum.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nhd_tpu.solver.combos import get_tables
+
+
+class SolveOut(NamedTuple):
+    cand: jax.Array      # [T, N] bool — node feasible for type
+    pref: jax.Array      # [T, N] int32 — 0 invalid / 1 candidate / 2 preferred
+    best_c: jax.Array    # [T, N] int32 — skew-maximal feasible combo
+    best_m: jax.Array    # [T, N] int32 — first feasible misc NUMA for best_c
+    best_a: jax.Array    # [T, N] int32 — first feasible NIC pick for best_c
+    n_combos: jax.Array  # [T, N] int32 — feasible combo count (introspection)
+
+
+def _solve(
+    tables,
+    # node arrays
+    numa_nodes, smt, active, maintenance, busy, gpuless, node_gmask,
+    hp_free, cpu_free, gpu_free, nic_count, nic_free, nic_sw, gpu_free_sw,
+    # pod-type arrays
+    cpu_dem_smt, cpu_dem_raw, gpu_dem, rx, tx, hp, needs_gpu, map_pci,
+    pod_gmask,
+) -> SolveOut:
+    C, A, U, K = tables.C, tables.A, tables.U, tables.K
+    combo_onehot = jnp.asarray(tables.combo_onehot)          # [C,G,U]
+    choose_onehot = jnp.asarray(tables.choose_onehot)        # [C,A,G,U,K]
+    need_max = jnp.asarray(tables.need_max)                  # [C,A,U]
+    chosen_cnt = jnp.asarray(tables.chosen_cnt)              # [C,A,U,K]
+    maxdig = jnp.asarray(tables.combo_maxdig)                # [C]
+    skew = jnp.asarray(tables.skew)                          # [C]
+
+    # ---- node-level predicate (reference: Matcher.py:65-84,103-111 +
+    # NHDScheduler.py:235-247 group/active filter) ----
+    node_ok = (
+        active
+        & ~maintenance
+        & (hp[:, None] <= hp_free[None, :])
+        & ((pod_gmask[:, None] & node_gmask[None, :]) != 0)
+        & (~needs_gpu[:, None] | ~busy[None, :])
+    )  # [T, N]
+
+    # combos using NUMA nodes the node doesn't have are invalid
+    combo_valid = maxdig[None, :] < numa_nodes[:, None]  # [N, C]
+
+    # ---- GPU predicate (reference: Matcher.py:97-141) ----
+    gpu_need = jnp.einsum("tg,cgu->tcu", gpu_dem.astype(jnp.float32), combo_onehot)
+    gpu_ok = jnp.all(
+        gpu_need[:, None, :, :] <= gpu_free[None, :, None, :], axis=-1
+    )  # [T, N, C]
+
+    # ---- CPU predicate incl. trailing misc slot (reference: Matcher.py:152-222) ----
+    def cpu_fit(dem):  # dem [T, G+1]
+        group_need = jnp.einsum(
+            "tg,cgu->tcu", dem[:, :-1].astype(jnp.float32), combo_onehot
+        )  # [T, C, U]
+        misc_need = (
+            dem[:, -1].astype(jnp.float32)[:, None, None]
+            * jnp.asarray(tables.misc_onehot)[None, :, :]
+        )  # [T, M=U, U]
+        total = group_need[:, :, None, :] + misc_need[:, None, :, :]  # [T,C,M,U]
+        return jnp.all(
+            total[:, None] <= cpu_free[None, :, None, None, :], axis=-1
+        )  # [T, N, C, M]
+
+    cpu_ok = jnp.where(
+        smt[None, :, None, None], cpu_fit(cpu_dem_smt), cpu_fit(cpu_dem_raw)
+    )  # [T, N, C, M]
+    cpu_any = jnp.any(cpu_ok, axis=-1)  # [T, N, C]
+
+    # ---- NIC predicate (reference: Matcher.py:224-276) ----
+    # demand each (numa, nic) accumulates under combo c / pick a — groups
+    # sharing a NIC sum jointly, the reference's in-pod sharing semantics
+    dem_rx = jnp.einsum("tg,caguk->tcauk", rx, choose_onehot)
+    dem_tx = jnp.einsum("tg,caguk->tcauk", tx, choose_onehot)
+    # only (numa, nic) slots some group actually chose constrain the fit —
+    # unchosen slots are padded with free = -1 and must not veto
+    unchosen = (chosen_cnt == 0)[None, None]  # [1, 1, C, A, U, K]
+    fit = jnp.all(
+        unchosen
+        | (
+            (dem_rx[:, None] <= nic_free[None, :, None, None, :, :, 0])
+            & (dem_tx[:, None] <= nic_free[None, :, None, None, :, :, 1])
+        ),
+        axis=(-2, -1),
+    )  # [T, N, C, A]
+
+    # every chosen ordinal must exist on the node
+    pick_valid = jnp.all(
+        need_max[None, :, :, :] <= nic_count[:, None, None, :], axis=-1
+    )  # [N, C, A]
+
+    # PCI map mode: chosen NICs need matching free GPUs on their PCIe switch
+    # (reference: Matcher.py:295-335 — counts NICs per switch, see oracle.py
+    # module docstring for the kept quirk)
+    S = gpu_free_sw.shape[-1]
+    sw_onehot = (
+        nic_sw[:, :, :, None] == jnp.arange(S)[None, None, None, :]
+    ).astype(jnp.float32)  # [N, U, K, S]
+    sw_need = jnp.einsum("cauk,nuks->ncas", chosen_cnt, sw_onehot)
+    pci_ok = jnp.all(sw_need <= gpu_free_sw[:, None, None, :], axis=-1)  # [N,C,A]
+
+    nic_ok = (
+        fit
+        & pick_valid[None]
+        & (pci_ok[None] | ~map_pci[:, None, None, None])
+    )  # [T, N, C, A]
+    nic_any = jnp.any(nic_ok, axis=-1)  # [T, N, C]
+    first_a = jnp.argmax(nic_ok, axis=-1).astype(jnp.int32)  # [T, N, C]
+
+    # ---- intersection on the group prefix (reference: Matcher.py:337-390) ----
+    feasible = (
+        node_ok[:, :, None] & combo_valid[None] & gpu_ok & cpu_any & nic_any
+    )  # [T, N, C]
+    cand = jnp.any(feasible, axis=-1)
+    n_combos = jnp.sum(feasible, axis=-1).astype(jnp.int32)
+
+    # ---- combo choice: max skew, first wins (reference: Matcher.py:423-452) ----
+    combo_val = jnp.where(
+        feasible,
+        skew[None, None, :] * (C + 1) + (C - jnp.arange(C))[None, None, :],
+        -1,
+    )
+    best_c = jnp.argmax(combo_val, axis=-1).astype(jnp.int32)  # [T, N]
+
+    take = lambda x: jnp.take_along_axis(x, best_c[:, :, None], axis=-1)[:, :, 0]
+    best_m = jnp.argmax(
+        jnp.take_along_axis(cpu_ok, best_c[:, :, None, None], axis=2)[:, :, 0, :],
+        axis=-1,
+    ).astype(jnp.int32)  # [T, N] first feasible misc NUMA
+    best_a = take(first_a)  # [T, N]
+
+    # ---- selection preference (reference: Matcher.py:393-421) ----
+    pref = jnp.where(
+        cand, 1 + (~needs_gpu[:, None] & gpuless[None, :]).astype(jnp.int32), 0
+    )
+
+    return SolveOut(cand, pref, best_c, best_m, best_a, n_combos)
+
+
+@lru_cache(maxsize=None)
+def get_solver(n_groups: int, n_numa: int, max_nic: int):
+    """A jitted solver specialized to one bucket shape; tables are closure
+    constants so XLA folds them."""
+    tables = get_tables(n_groups, n_numa, max_nic)
+
+    def fn(*args):
+        return _solve(tables, *args)
+
+    return jax.jit(fn)
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+def solve_bucket(cluster, pods, *, device=None) -> SolveOut:
+    """Run the bucket solve for (ClusterArrays, PodTypeArrays) → SolveOut.
+
+    Node and type axes are padded to power-of-two buckets so repeated solves
+    against growing/shrinking batches reuse the jit cache (SURVEY §7 hard
+    part 3: fixed-shape padding without recompiles). Padded node rows are
+    inactive (never candidates); padded type rows are garbage the callers
+    must slice off (outputs are [T, N] with the original sizes restored).
+    """
+    T, N = pods.n_types, cluster.n_nodes
+    Tp, Np = _pad_pow2(T), _pad_pow2(N)
+
+    def pad_n(a):  # pad axis 0 to Np
+        if a.shape[0] == Np:
+            return a
+        return np.concatenate(
+            [a, np.zeros((Np - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
+        )
+
+    def pad_t(a):
+        if a.shape[0] == Tp:
+            return a
+        return np.concatenate(
+            [a, np.zeros((Tp - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
+        )
+
+    solver = get_solver(pods.G, cluster.U, cluster.K)
+    args = (
+        pad_n(cluster.numa_nodes), pad_n(cluster.smt), pad_n(cluster.active),
+        pad_n(cluster.maintenance), pad_n(cluster.busy), pad_n(cluster.gpuless),
+        pad_n(cluster.group_mask), pad_n(cluster.hp_free), pad_n(cluster.cpu_free),
+        pad_n(cluster.gpu_free), pad_n(cluster.nic_count), pad_n(cluster.nic_free),
+        pad_n(cluster.nic_sw), pad_n(cluster.gpu_free_sw),
+        pad_t(pods.cpu_dem_smt), pad_t(pods.cpu_dem_raw), pad_t(pods.gpu_dem),
+        pad_t(pods.rx), pad_t(pods.tx), pad_t(pods.hp), pad_t(pods.needs_gpu),
+        pad_t(pods.map_pci), pad_t(pods.group_mask),
+    )
+    if device is not None:
+        args = jax.device_put(args, device)
+    out = solver(*args)
+    return SolveOut(*(x[:T, :N] if x.ndim == 2 else x for x in out))

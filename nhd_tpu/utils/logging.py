@@ -1,9 +1,9 @@
 """Logger factory for the framework.
 
 Equivalent role to the reference's NHDCommon.GetLogger (NHDCommon.py:20-38):
-one logger per module, colored when attached to a TTY, INFO by default.
-Implemented on stdlib logging only (no colorlog dependency); level is
-overridable via the NHD_TPU_LOG_LEVEL environment variable.
+one logger per module, colored when attached to a TTY. Defaults to WARNING
+(the reference's INFO narration is extremely chatty in the matcher); set
+NHD_TPU_LOG_LEVEL=INFO to get it.
 """
 
 from __future__ import annotations

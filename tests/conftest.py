@@ -2,14 +2,24 @@
 
 Must run before any jax import — pytest imports conftest first, so setting
 the env here guarantees every test module sees 8 virtual CPU devices,
-giving a multi-chip sharding story without TPU hardware.
+giving a multi-chip sharding story without TPU hardware. This *overrides*
+any inherited JAX_PLATFORMS (the dev box exports a TPU backend by default;
+unit tests must not depend on, or be slowed by, real hardware).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# persistent compile cache: the suite compiles ~a dozen solver shapes; repeat
+# runs hit the cache instead of recompiling each (G, U, K) bucket. This jax
+# build ignores the JAX_COMPILATION_CACHE_DIR env var, so configure via API.
+import jax  # noqa: E402  (env vars above must be set first)
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/nhd_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

@@ -1,0 +1,194 @@
+"""Batch scheduler tests: serializability, gang spread, oracle agreement."""
+
+import copy
+import random
+
+from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+from nhd_tpu.core.topology import MapMode, SmtMode
+from nhd_tpu.sim import SynthNodeSpec, make_cluster
+from nhd_tpu.sim.requests import request_to_topology
+from nhd_tpu.solver import BatchItem, BatchScheduler, find_node
+
+
+def simple_request(gpus=0, rx=10.0, proc=4) -> PodRequest:
+    return PodRequest(
+        groups=(
+            GroupRequest(
+                proc=CpuRequest(proc, SmtMode.ON),
+                misc=CpuRequest(1, SmtMode.ON),
+                gpus=gpus,
+                nic_rx_gbps=rx,
+                nic_tx_gbps=5.0,
+            ),
+        ),
+        misc=CpuRequest(1, SmtMode.ON),
+        hugepages_gb=2,
+        map_mode=MapMode.NUMA,
+    )
+
+
+def items(reqs):
+    return [BatchItem(("ns", f"pod{i}"), r) for i, r in enumerate(reqs)]
+
+
+def test_single_item_matches_oracle():
+    nodes = make_cluster(4)
+    ref_nodes = copy.deepcopy(nodes)
+    req = simple_request(gpus=1)
+    sched = BatchScheduler(respect_busy=False)
+    results, stats = sched.schedule(nodes, items([req]), now=0.0)
+    want = find_node(ref_nodes, req, now=0.0, respect_busy=False)
+    assert results[0].node == want.node
+    assert results[0].mapping == want.mapping
+    assert stats.scheduled == 1
+
+
+def test_sequential_agreement_identical_pods():
+    """A gang of identical pods scheduled in batch lands the same total as
+    the strict sequential oracle loop on an identical cluster."""
+    batch_nodes = make_cluster(4)
+    seq_nodes = copy.deepcopy(batch_nodes)
+    reqs = [simple_request(gpus=1) for _ in range(40)]
+
+    sched = BatchScheduler(respect_busy=False)
+    results, stats = sched.schedule(batch_nodes, items(reqs), now=0.0)
+    batch_count = sum(1 for r in results if r.node)
+
+    seq_count = 0
+    for r in reqs:
+        m = find_node(seq_nodes, r, now=0.0, respect_busy=False)
+        if m is None:
+            continue
+        top = request_to_topology(r)
+        seq_nodes[m.node].assign_physical_ids(m.mapping, top)
+        nidx = sorted({i for i, n in enumerate(seq_nodes[m.node].nics)
+                       if n.mac in {p.mac for p in top.nic_pairs}})
+        seq_nodes[m.node].claim_nic_pods(nidx)
+        seq_count += 1
+
+    assert batch_count == seq_count > 0
+    # end-state resource totals agree cluster-wide
+    batch_free = sorted(
+        (sum(n.free_cpu_cores_per_numa()), n.free_gpu_count())
+        for n in batch_nodes.values()
+    )
+    seq_free = sorted(
+        (sum(n.free_cpu_cores_per_numa()), n.free_gpu_count())
+        for n in seq_nodes.values()
+    )
+    assert batch_free == seq_free
+
+
+def test_gang_spreads_across_nodes():
+    nodes = make_cluster(8)
+    reqs = [simple_request() for _ in range(8)]
+    sched = BatchScheduler(respect_busy=False)
+    results, stats = sched.schedule(nodes, items(reqs), now=0.0)
+    placed = [r.node for r in results]
+    assert all(placed)
+    # 8 identical pods over 8 identical nodes: one each, in round 1
+    assert sorted(placed) == sorted(nodes.keys())
+    assert stats.rounds == 1
+
+
+def test_busy_backoff_limits_gpu_pods_per_node():
+    nodes = make_cluster(2)
+    reqs = [simple_request(gpus=1) for _ in range(6)]
+    sched = BatchScheduler(respect_busy=True)
+    results, _ = sched.schedule(nodes, items(reqs), now=0.0)
+    placed = [r.node for r in results if r.node]
+    # one GPU pod per node per busy window
+    assert len(placed) == 2
+    assert len(set(placed)) == 2
+
+
+def test_no_double_booking_under_pressure():
+    """Saturate a small cluster with a mixed batch; core/GPU books must
+    balance exactly (each core at most one owner)."""
+    rng = random.Random(7)
+    nodes = make_cluster(3, SynthNodeSpec(phys_cores=16, hugepages_gb=32))
+    reqs = []
+    for _ in range(60):
+        reqs.append(
+            simple_request(
+                gpus=rng.choice([0, 1]),
+                rx=rng.choice([5.0, 20.0]),
+                proc=rng.choice([2, 4, 6]),
+            )
+        )
+    sched = BatchScheduler(respect_busy=False)
+    batch_items = [
+        BatchItem(("ns", f"p{i}"), r, request_to_topology(r))
+        for i, r in enumerate(reqs)
+    ]
+    results, _ = sched.schedule(nodes, batch_items, now=0.0)
+
+    # every scheduled pod's cores are disjoint and within bounds per node
+    per_node_cores = {}
+    for item, res in zip(batch_items, results):
+        if not res.node:
+            continue
+        cores = [c.core for pg in item.topology.proc_groups
+                 for c in pg.proc_cores + pg.misc_cores]
+        cores += [c.core for pg in item.topology.proc_groups
+                  for g in pg.gpus for c in g.cpu_cores]
+        cores += [c.core for c in item.topology.misc_cores]
+        seen = per_node_cores.setdefault(res.node, set())
+        assert len(cores) == len(set(cores))
+        assert not (seen & set(cores)), "core double-booked across pods"
+        seen.update(cores)
+
+    # node mirrors agree with the sum of handed-out cores
+    for name, node in nodes.items():
+        used = {c.core for c in node.cores if c.used and c.core not in node.reserved_cores}
+        assert per_node_cores.get(name, set()) == used
+
+
+def test_unschedulable_marked_none():
+    nodes = make_cluster(1, SynthNodeSpec(gpus_per_numa=0))
+    reqs = [simple_request(gpus=1)]
+    sched = BatchScheduler(respect_busy=False)
+    results, stats = sched.schedule(nodes, items(reqs), now=0.0)
+    assert results[0].node is None
+    assert stats.scheduled == 0
+
+
+def test_dry_run_reports_snapshot_matches():
+    """apply=False: every pod reports its snapshot match — identical pods
+    all name the same node, and nothing is mutated."""
+    nodes = make_cluster(2)
+    before = {k: sum(n.free_cpu_cores_per_numa()) for k, n in nodes.items()}
+    reqs = [simple_request() for _ in range(5)]
+    results, _ = BatchScheduler(respect_busy=False).schedule(
+        nodes, items(reqs), now=0.0, apply=False
+    )
+    assert all(r.node == results[0].node for r in results)
+    assert results[0].node is not None
+    after = {k: sum(n.free_cpu_cores_per_numa()) for k, n in nodes.items()}
+    assert before == after
+
+
+def test_unrepresentable_request_fails_cleanly():
+    """A 1-proc-core group with NIC bandwidth can't synthesize a topology;
+    the pod must fail alone, not crash the batch."""
+    from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+    from nhd_tpu.core.topology import MapMode, SmtMode
+
+    weird = PodRequest(
+        groups=(
+            GroupRequest(CpuRequest(1, SmtMode.ON), CpuRequest(0, SmtMode.OFF),
+                         0, 5.0, 0.0),
+        ),
+        misc=CpuRequest(0, SmtMode.OFF),
+        hugepages_gb=0,
+        map_mode=MapMode.NUMA,
+    )
+    nodes = make_cluster(2)
+    reqs = [simple_request(), weird, simple_request()]
+    results, stats = BatchScheduler(respect_busy=False).schedule(
+        nodes, items(reqs), now=0.0
+    )
+    assert results[0].node and results[2].node
+    # the weird pod is still *scheduled* on the fast path (claims applied);
+    # only its bookkeeping registration is skipped
+    assert results[1].node is not None

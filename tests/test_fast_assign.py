@@ -1,0 +1,102 @@
+"""FastCluster assignment must match HostNode.assign_physical_ids exactly."""
+
+import copy
+import random
+
+from nhd_tpu.sim import SynthNodeSpec, make_cluster
+from nhd_tpu.sim.requests import request_to_topology
+from nhd_tpu.solver import BatchItem, BatchScheduler, find_node
+from nhd_tpu.solver.encode import encode_cluster
+from nhd_tpu.solver.fast_assign import FastCluster, apply_record_to_topology
+from tests.test_jax_matcher import random_cluster, random_request
+
+
+def state_fingerprint(nodes):
+    out = {}
+    for name, n in nodes.items():
+        out[name] = (
+            tuple(c.used for c in n.cores),
+            tuple(g.used for g in n.gpus),
+            tuple((tuple(x.speed_used), x.pods_used) for x in n.nics),
+            n.mem.free_hugepages_gb,
+        )
+    return out
+
+
+def test_fast_assign_matches_object_path():
+    rng = random.Random(42)
+    for trial in range(15):
+        nodes_a = random_cluster(rng, 4)
+        nodes_b = copy.deepcopy(nodes_a)
+        req = random_request(rng)
+        m = find_node(nodes_a, req, now=1010.0, respect_busy=False)
+        if m is None:
+            continue
+
+        # object path (assign + the scheduler's separate NIC pod claim,
+        # reference NHDScheduler.py:292-304)
+        top_a = request_to_topology(req)
+        try:
+            nic_list = nodes_a[m.node].assign_physical_ids(m.mapping, top_a)
+            nodes_a[m.node].claim_nic_pods(sorted({x[0] for x in nic_list}))
+            a_failed = False
+        except Exception:
+            a_failed = True
+
+        # fast path on the clone
+        arrays = encode_cluster(nodes_b, now=1010.0)
+        fast = FastCluster(nodes_b, arrays.U, arrays.K)
+        n_idx = arrays.names.index(m.node)
+        top_b = request_to_topology(req)
+        try:
+            rec = fast.assign(n_idx, m.mapping, req)
+            b_failed = False
+        except Exception:
+            b_failed = True
+
+        assert a_failed == b_failed, f"trial {trial}: divergent failure"
+        if a_failed:
+            continue
+        fast.sync_to_nodes()
+        apply_record_to_topology(rec, top_b)
+
+        fp_a = state_fingerprint(nodes_a)
+        fp_b = state_fingerprint(nodes_b)
+        assert fp_a == fp_b, f"trial {trial}: node state diverged"
+
+        def ids(top):
+            return (
+                [[c.core for c in pg.proc_cores] for pg in top.proc_groups],
+                [[c.core for c in pg.misc_cores] for pg in top.proc_groups],
+                [[(g.device_id, [c.core for c in g.cpu_cores]) for g in pg.gpus]
+                 for pg in top.proc_groups],
+                [c.core for c in top.misc_cores],
+                [p.mac for p in top.nic_pairs],
+                top.data_default_gw,
+            )
+
+        assert ids(top_a) == ids(top_b), f"trial {trial}: topology fill diverged"
+
+
+def test_batch_fast_vs_object_paths_agree():
+    """Whole-batch outcomes identical between fast and object assignment."""
+    nodes_fast = make_cluster(4, SynthNodeSpec(phys_cores=16))
+    nodes_obj = copy.deepcopy(nodes_fast)
+    rng = random.Random(3)
+    reqs = []
+    for _ in range(30):
+        r = random_request(rng)
+        reqs.append(r)
+    items_f = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
+    items_o = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
+
+    rf, sf = BatchScheduler(respect_busy=False, use_fast=True).schedule(
+        nodes_fast, items_f, now=0.0
+    )
+    ro, so = BatchScheduler(respect_busy=False, use_fast=False).schedule(
+        nodes_obj, items_o, now=0.0
+    )
+    assert [r.node for r in rf] == [r.node for r in ro]
+    assert [r.mapping for r in rf] == [r.mapping for r in ro]
+    assert state_fingerprint(nodes_fast) == state_fingerprint(nodes_obj)
+    assert sf.scheduled == so.scheduled

@@ -1,0 +1,123 @@
+"""Property tests: the batched JAX solver must agree with the serial oracle.
+
+This is the parity contract from SURVEY §7: identical feasibility decisions,
+identical node choice, identical mapping (combo / misc-NUMA / NIC pick) for
+single-pod queries against any cluster state.
+"""
+
+import random
+
+import pytest
+
+from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+from nhd_tpu.core.topology import MapMode, SmtMode
+from nhd_tpu.sim import SynthNodeSpec, make_node
+from nhd_tpu.solver.jax_matcher import JaxMatcher
+from nhd_tpu.solver.oracle import find_node
+
+
+def random_cluster(rng: random.Random, n_nodes: int):
+    nodes = {}
+    for i in range(n_nodes):
+        spec = SynthNodeSpec(
+            name=f"node{i:03d}",
+            sockets=2,
+            phys_cores=rng.choice([8, 12, 16]),
+            smt=rng.random() < 0.7,
+            reserved_cores=rng.choice([0, 2]),
+            nics_per_numa=rng.choice([1, 2, 3]),
+            nic_speed_mbps=rng.choice([25000, 100000]),
+            gpus_per_numa=rng.choice([0, 1, 2]),
+            hugepages_gb=rng.choice([16, 64]),
+            groups=rng.choice(["default", "default.edge", "edge"]),
+        )
+        node = make_node(spec)
+        # degrade state randomly: claimed cores/GPUs/NICs/hugepages
+        for core in node.cores:
+            if rng.random() < 0.2:
+                core.used = True
+        for gpu in node.gpus:
+            if rng.random() < 0.3:
+                gpu.used = True
+        for nic in node.nics:
+            if rng.random() < 0.2:
+                nic.pods_used = 1
+        node.mem.free_hugepages_gb -= rng.choice([0, 0, 8])
+        if rng.random() < 0.1:
+            node.maintenance = True
+        if rng.random() < 0.1:
+            node.active = False
+        if rng.random() < 0.2:
+            node.set_busy(now=1000.0)
+        nodes[node.name] = node
+    return nodes
+
+
+def random_request(rng: random.Random) -> PodRequest:
+    n_groups = rng.choice([1, 1, 2, 3])
+
+    def group():
+        rx = rng.choice([0.0, 5.0, 20.0, 80.0])
+        tx = rng.choice([0.0, 5.0, 20.0])
+        # bandwidth implies an rx+tx core pair (inherent Triad format shape)
+        proc_min = 2 if (rx or tx) else 1
+        return GroupRequest(
+            proc=CpuRequest(rng.randint(proc_min, 6), rng.choice(list(SmtMode))),
+            misc=CpuRequest(rng.randint(0, 2), rng.choice(list(SmtMode))),
+            gpus=rng.choice([0, 0, 1, 2]),
+            nic_rx_gbps=rx,
+            nic_tx_gbps=tx,
+        )
+
+    groups = tuple(group() for _ in range(n_groups))
+    return PodRequest(
+        groups=groups,
+        misc=CpuRequest(rng.randint(0, 3), rng.choice(list(SmtMode))),
+        hugepages_gb=rng.choice([0, 4, 16]),
+        map_mode=rng.choice([MapMode.NUMA, MapMode.NUMA, MapMode.PCI]),
+        node_groups=frozenset(rng.choice([["default"], ["edge"], ["default", "edge"]])),
+    )
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_single_pod_parity(seed):
+    rng = random.Random(seed)
+    nodes = random_cluster(rng, rng.randint(1, 6))
+    matcher = JaxMatcher()
+    for _ in range(4):
+        req = random_request(rng)
+        want = find_node(nodes, req, now=1010.0)
+        got = matcher.find_node(nodes, req, now=1010.0)
+        if want is None:
+            assert got is None, f"jax found {got}, oracle found nothing (req={req})"
+        else:
+            assert got is not None, f"oracle found {want}, jax found nothing (req={req})"
+            assert got.node == want.node
+            assert got.mapping == want.mapping
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_busy_toggle_parity(seed):
+    rng = random.Random(100 + seed)
+    nodes = random_cluster(rng, 3)
+    matcher = JaxMatcher()
+    req = random_request(rng)
+    want = find_node(nodes, req, now=1010.0, respect_busy=False)
+    got = matcher.find_node(nodes, req, now=1010.0, respect_busy=False)
+    assert (want is None) == (got is None)
+    if want:
+        assert got.node == want.node and got.mapping == want.mapping
+
+
+def test_batch_matches_singles():
+    """find_nodes on a batch equals per-pod find_node on the same snapshot."""
+    rng = random.Random(999)
+    nodes = random_cluster(rng, 5)
+    reqs = [random_request(rng) for _ in range(12)]
+    matcher = JaxMatcher()
+    batch = matcher.find_nodes(nodes, reqs, now=1010.0)
+    for r, got in zip(reqs, batch):
+        want = matcher.find_node(nodes, r, now=1010.0)
+        assert (want is None) == (got is None)
+        if want:
+            assert got.node == want.node and got.mapping == want.mapping
