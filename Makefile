@@ -1,0 +1,22 @@
+# Build/test entry points (reference: Makefile proto rule at :86-89).
+
+PROTO_DIR := nhd_tpu/rpc
+
+.PHONY: test proto bench wheel clean
+
+test:
+	python -m pytest tests/ -x -q
+
+# Regenerate protobuf message bindings. Service stubs are hand-written in
+# nhd_tpu/rpc/server.py (no grpc_python_plugin needed).
+proto:
+	protoc --python_out=$(PROTO_DIR) --proto_path=$(PROTO_DIR) $(PROTO_DIR)/nhd_stats.proto
+
+bench:
+	python bench.py
+
+wheel:
+	python -m pip wheel --no-deps -w dist .
+
+clean:
+	rm -rf dist build *.egg-info

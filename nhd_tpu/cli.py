@@ -1,0 +1,92 @@
+"""Process harness: spawn controller + scheduler + RPC threads, watch them.
+
+Equivalent of the reference's bin/nhd entry script (bin/nhd:18-65): three
+threads, two queues, and a 1 Hz liveness watchdog that kills the process if
+any thread dies — crash-only; the Deployment restarts us and state replays
+from pod annotations (README.md:85-87).
+
+Usage:
+    nhd-tpu                 # real cluster (requires kubernetes package)
+    nhd-tpu --fake          # in-memory backend (demo/smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import queue
+import sys
+import time
+
+from nhd_tpu import NHD_SCHED_NAME, __version__
+from nhd_tpu.scheduler.controller import Controller
+from nhd_tpu.scheduler.core import Scheduler
+from nhd_tpu.scheduler.events import WatchQueue
+from nhd_tpu.utils import get_logger
+
+
+def build_threads(backend, *, rpc_port: int = 45655, respect_busy: bool = True):
+    """Wire up the thread set for a backend; returns (threads, rpc_queue)."""
+    watch_q = WatchQueue()
+    rpc_q: queue.Queue = queue.Queue(maxsize=128)  # reference: bin/nhd:21
+
+    scheduler = Scheduler(backend, watch_q, rpc_q, respect_busy=respect_busy)
+    controller = Controller(backend, watch_q)
+    threads = [controller, scheduler]
+
+    try:
+        from nhd_tpu.rpc.server import StatsRpcServer
+
+        threads.append(StatsRpcServer(rpc_q, port=rpc_port))
+    except ImportError as exc:
+        get_logger(__name__).warning(f"stats RPC plane disabled: {exc}")
+
+    return threads, rpc_q
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="nhd_tpu scheduler")
+    parser.add_argument("--fake", action="store_true",
+                        help="use the in-memory backend (demo mode)")
+    parser.add_argument("--rpc-port", type=int, default=45655)
+    args = parser.parse_args(argv)
+
+    logger = get_logger(__name__)
+    logger.warning(f"nhd_tpu version {__version__}")
+
+    if args.fake:
+        from nhd_tpu.k8s.fake import FakeClusterBackend
+        from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+
+        # demo cluster: 4 synthetic nodes + a 6-replica TriadSet, so the
+        # harness visibly discovers, reconciles, and binds
+        backend = FakeClusterBackend()
+        for i in range(4):
+            spec = SynthNodeSpec(name=f"sim-node{i}")
+            backend.add_node(spec.name, make_node_labels(spec),
+                             hugepages_gb=spec.hugepages_gb)
+        backend.add_triadset(
+            "demo", "default", replicas=6, service_name="triad",
+            cfg_text=make_triad_config(gpus_per_group=1, cpu_workers=2),
+        )
+    else:
+        from nhd_tpu.k8s.kube import KubeClusterBackend
+
+        backend = KubeClusterBackend()
+
+    threads, _ = build_threads(backend, rpc_port=args.rpc_port)
+    for t in threads:
+        t.start()
+
+    # liveness watchdog (reference: bin/nhd:43-56): crash-only — if any
+    # thread dies the whole process exits and the Deployment restarts it
+    while True:
+        time.sleep(1)
+        for t in threads:
+            if not t.is_alive():
+                logger.error(f"thread {t.name} died; exiting")
+                os._exit(-1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

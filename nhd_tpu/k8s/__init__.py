@@ -1,0 +1,3 @@
+from nhd_tpu.k8s.interface import ClusterBackend, EventType, PodEvent, WatchEvent
+
+__all__ = ["ClusterBackend", "EventType", "PodEvent", "WatchEvent"]

@@ -1,0 +1,336 @@
+"""In-memory cluster backend: the hermetic test/simulation seam.
+
+Gives the framework what the reference never had (SURVEY §4): a way to run
+the full scheduler — watches, binding, annotations, restart replay —
+without a live cluster. State layout intentionally mirrors what the API
+server would hold, so the scheduler can't tell the difference.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from nhd_tpu.k8s.interface import (
+    CFG_ANNOTATION,
+    CFG_TYPE_ANNOTATION,
+    GPU_MAP_ANNOTATION_PREFIX,
+    GROUPS_ANNOTATION,
+    MAINTENANCE_LABEL,
+    NAD_ANNOTATION,
+    SCHEDULER_TAINT,
+    ClusterBackend,
+    EventType,
+    PodEvent,
+    WatchEvent,
+)
+from nhd_tpu.utils import get_logger
+
+
+@dataclass
+class FakeNode:
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    addr: str = "10.0.0.1"
+    hugepages_capacity_gb: int = 64
+    hugepages_allocatable_gb: int = 64
+    ready: bool = True
+    unschedulable: bool = False
+    taints: List[str] = field(default_factory=lambda: [SCHEDULER_TAINT])
+
+
+@dataclass
+class FakePod:
+    name: str
+    namespace: str
+    uid: str
+    scheduler_name: str = "nhd-scheduler"
+    phase: str = "Pending"
+    node: Optional[str] = None
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resources: Dict[str, str] = field(default_factory=dict)
+    configmap_name: Optional[str] = None
+    hostname: str = ""
+    subdomain: str = ""
+
+
+class FakeClusterBackend(ClusterBackend):
+    """A thread-safe in-memory API server stand-in."""
+
+    def __init__(self) -> None:
+        self.logger = get_logger(__name__)
+        self._lock = threading.RLock()
+        self.nodes: Dict[str, FakeNode] = {}
+        self.pods: Dict[Tuple[str, str], FakePod] = {}
+        self.configmaps: Dict[Tuple[str, str], str] = {}  # (ns, name) → text
+        self.events: List[PodEvent] = []
+        self.triadsets: List[dict] = []
+        self._watch: List[WatchEvent] = []
+        self._uid = itertools.count(1)
+        self.fail_bind_for: set = set()      # (ns, pod) forced bind failures
+        self.bind_count = 0
+
+    # ------------------------------------------------------------------
+    # simulation controls (test-facing, not part of ClusterBackend)
+    # ------------------------------------------------------------------
+
+    def add_node(self, name: str, labels: Dict[str, str], *,
+                 hugepages_gb: int = 64, addr: str = "") -> FakeNode:
+        with self._lock:
+            node = FakeNode(
+                name=name, labels=dict(labels), addr=addr or f"10.0.1.{len(self.nodes) + 1}",
+                hugepages_capacity_gb=hugepages_gb, hugepages_allocatable_gb=hugepages_gb,
+            )
+            self.nodes[name] = node
+            return node
+
+    def create_pod(
+        self,
+        name: str,
+        ns: str = "default",
+        *,
+        cfg_text: Optional[str] = None,
+        cfg_type: str = "triad",
+        groups: Optional[str] = None,
+        resources: Optional[Dict[str, str]] = None,
+        scheduler_name: str = "nhd-scheduler",
+        emit_watch: bool = True,
+    ) -> FakePod:
+        """Create a Pending pod with its ConfigMap, like a TriadSet would."""
+        with self._lock:
+            uid = f"uid-{next(self._uid)}"
+            pod = FakePod(name=name, namespace=ns, uid=uid,
+                          scheduler_name=scheduler_name,
+                          resources=dict(resources or {}))
+            pod.annotations[CFG_TYPE_ANNOTATION] = cfg_type
+            if groups:
+                pod.annotations[GROUPS_ANNOTATION] = groups
+            if cfg_text is not None:
+                cm = f"{name}-cfg"
+                self.configmaps[(ns, cm)] = cfg_text
+                pod.configmap_name = cm
+            self.pods[(ns, name)] = pod
+            if emit_watch:
+                self._watch.append(
+                    WatchEvent(kind="pod_create", name=name, namespace=ns,
+                               annotations=dict(pod.annotations), uid=uid,
+                               scheduler_name=pod.scheduler_name)
+                )
+            return pod
+
+    def delete_pod(self, name: str, ns: str = "default",
+                   emit_watch: bool = True) -> None:
+        with self._lock:
+            pod = self.pods.pop((ns, name), None)
+            if pod and emit_watch:
+                self._watch.append(
+                    WatchEvent(kind="pod_delete", name=name, namespace=ns,
+                               annotations=dict(pod.annotations), uid=pod.uid,
+                               scheduler_name=pod.scheduler_name,
+                               node=pod.node or "")
+                )
+
+    def set_pod_phase(self, name: str, ns: str, phase: str) -> None:
+        with self._lock:
+            self.pods[(ns, name)].phase = phase
+
+    def cordon_node(self, name: str, cordon: bool = True) -> None:
+        with self._lock:
+            node = self.nodes[name]
+            was = node.unschedulable
+            node.unschedulable = cordon
+            self._watch.append(
+                WatchEvent(kind="node_update", name=name,
+                           labels=dict(node.labels), old_labels=dict(node.labels),
+                           unschedulable=cordon, was_unschedulable=was,
+                           taints=list(node.taints), old_taints=list(node.taints))
+            )
+
+    def update_node_labels(self, name: str, new_labels: Dict[str, str]) -> None:
+        with self._lock:
+            node = self.nodes[name]
+            old = dict(node.labels)
+            node.labels.update(new_labels)
+            self._watch.append(
+                WatchEvent(kind="node_update", name=name,
+                           labels=dict(node.labels), old_labels=old,
+                           unschedulable=node.unschedulable,
+                           was_unschedulable=node.unschedulable,
+                           taints=list(node.taints), old_taints=list(node.taints))
+            )
+
+    def add_triadset(self, name: str, ns: str, replicas: int,
+                     service_name: str, cfg_text: str) -> None:
+        with self._lock:
+            self.triadsets.append(
+                {"name": name, "ns": ns, "replicas": replicas,
+                 "service_name": service_name, "cfg_text": cfg_text}
+            )
+
+    # ------------------------------------------------------------------
+    # ClusterBackend: node reads
+    # ------------------------------------------------------------------
+
+    def get_nodes(self) -> List[str]:
+        with self._lock:
+            return [n.name for n in self.nodes.values() if n.ready]
+
+    def is_node_active(self, node: str) -> bool:
+        with self._lock:
+            n = self.nodes.get(node)
+            return bool(n and SCHEDULER_TAINT in n.taints and not n.unschedulable)
+
+    def get_node_labels(self, node: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self.nodes[node].labels)
+
+    def get_node_addr(self, node: str) -> str:
+        with self._lock:
+            return self.nodes[node].addr
+
+    def get_node_hugepage_resources(self, node: str) -> Tuple[int, int]:
+        with self._lock:
+            n = self.nodes[node]
+            return (n.hugepages_capacity_gb, n.hugepages_allocatable_gb)
+
+    # ------------------------------------------------------------------
+    # ClusterBackend: pod reads
+    # ------------------------------------------------------------------
+
+    def _pod(self, pod: str, ns: str) -> Optional[FakePod]:
+        return self.pods.get((ns, pod))
+
+    def pod_exists(self, pod: str, ns: str) -> bool:
+        with self._lock:
+            return (ns, pod) in self.pods
+
+    def get_pod_node(self, pod: str, ns: str) -> Optional[str]:
+        with self._lock:
+            p = self._pod(pod, ns)
+            return p.node if p else None
+
+    def get_pod_annotations(self, pod: str, ns: str) -> Optional[Dict[str, str]]:
+        with self._lock:
+            p = self._pod(pod, ns)
+            return dict(p.annotations) if p else None
+
+    def get_cfg_annotations(self, pod: str, ns: str) -> Optional[str]:
+        with self._lock:
+            p = self._pod(pod, ns)
+            return p.annotations.get(CFG_ANNOTATION) if p else None
+
+    def get_cfg_type(self, pod: str, ns: str) -> Optional[str]:
+        with self._lock:
+            p = self._pod(pod, ns)
+            return p.annotations.get(CFG_TYPE_ANNOTATION) if p else None
+
+    def get_pod_node_groups(self, pod: str, ns: str) -> List[str]:
+        with self._lock:
+            p = self._pod(pod, ns)
+            if p and GROUPS_ANNOTATION in p.annotations:
+                return p.annotations[GROUPS_ANNOTATION].split(".")
+            return ["default"]
+
+    def get_requested_pod_resources(self, pod: str, ns: str) -> Dict[str, str]:
+        with self._lock:
+            p = self._pod(pod, ns)
+            return dict(p.resources) if p else {}
+
+    def get_scheduled_pods(self, scheduler: str) -> List[Tuple[str, str, str, str]]:
+        with self._lock:
+            return [
+                (p.name, p.namespace, p.uid, p.phase)
+                for p in self.pods.values()
+                if p.scheduler_name == scheduler and p.node is not None
+            ]
+
+    def service_pods(self, scheduler: str):
+        with self._lock:
+            return {
+                (p.namespace, p.name, p.uid): (p.phase, p.node)
+                for p in self.pods.values()
+                if p.scheduler_name == scheduler
+            }
+
+    def get_cfg_map(self, pod: str, ns: str) -> Tuple[Optional[str], Optional[str]]:
+        with self._lock:
+            p = self._pod(pod, ns)
+            if not p or not p.configmap_name:
+                return (None, None)
+            return (p.configmap_name, self.configmaps.get((ns, p.configmap_name)))
+
+    # ------------------------------------------------------------------
+    # ClusterBackend: writes
+    # ------------------------------------------------------------------
+
+    def add_nad_to_pod(self, pod: str, ns: str, nad: str) -> bool:
+        with self._lock:
+            p = self._pod(pod, ns)
+            if p is None:
+                return False
+            p.annotations[NAD_ANNOTATION] = nad
+            return True
+
+    def annotate_pod_config(self, ns: str, pod: str, cfg: str) -> bool:
+        with self._lock:
+            p = self._pod(pod, ns)
+            if p is None:
+                return False
+            p.annotations[CFG_ANNOTATION] = cfg
+            return True
+
+    def annotate_pod_gpu_map(self, ns: str, pod: str, gpu_map: Dict[str, int]) -> bool:
+        with self._lock:
+            p = self._pod(pod, ns)
+            if p is None:
+                return False
+            for dev, devid in gpu_map.items():
+                p.annotations[f"{GPU_MAP_ANNOTATION_PREFIX}.{dev}"] = str(devid)
+            return True
+
+    def bind_pod_to_node(self, pod: str, node: str, ns: str) -> bool:
+        with self._lock:
+            p = self._pod(pod, ns)
+            if p is None or (ns, pod) in self.fail_bind_for:
+                return False
+            p.node = node
+            p.phase = "Running"  # kubelet admission, fast-forwarded
+            self.bind_count += 1
+            return True
+
+    def generate_pod_event(self, pod, ns, reason, event_type, message) -> None:
+        with self._lock:
+            self.events.append(
+                PodEvent(pod, ns, reason, event_type, f"NHD: {message}")
+            )
+
+    # ------------------------------------------------------------------
+    # watch + TriadSets
+    # ------------------------------------------------------------------
+
+    def poll_watch_events(self, timeout: float = 0.0) -> Iterable[WatchEvent]:
+        with self._lock:
+            out, self._watch = self._watch, []
+            return out
+
+    def list_triadsets(self) -> List[dict]:
+        with self._lock:
+            return list(self.triadsets)
+
+    def list_pods_of_triadset(self, ts: dict) -> List[str]:
+        with self._lock:
+            prefix = ts["service_name"] + "-"
+            return [
+                p.name for p in self.pods.values()
+                if p.namespace == ts["ns"] and p.name.startswith(prefix)
+                and p.name[len(prefix):].isdigit()
+            ]
+
+    def create_pod_for_triadset(self, ts: dict, ordinal: int) -> bool:
+        name = f"{ts['service_name']}-{ordinal}"
+        pod = self.create_pod(name, ts["ns"], cfg_text=ts["cfg_text"])
+        pod.hostname = name
+        pod.subdomain = ts["service_name"]
+        return True

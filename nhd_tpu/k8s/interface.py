@@ -1,0 +1,187 @@
+"""Cluster backend interface: every API-server interaction behind one seam.
+
+The reference funnels all Kubernetes I/O through the K8SMgr singleton
+(K8SMgr.py:21-53) — mockable but never mocked (SURVEY §4). Here the seam is
+an explicit ABC with two implementations:
+
+* k8s.fake.FakeClusterBackend — in-memory cluster for tests, simulation and
+  benchmarks (the "multi-node without a real cluster" story the reference
+  lacks);
+* k8s.kube.KubeClusterBackend — the real kubernetes-client backend, method
+  for method the reference's K8SMgr surface.
+
+Annotation keys and taints match the reference so both systems can coexist
+on one cluster.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Reference annotation/label/taint vocabulary (K8SMgr.py:139,160,182,496;
+# Node.py:108; TriadController.py:19-23)
+DOMAIN = "sigproc.viasat.io"
+CFG_ANNOTATION = f"{DOMAIN}/nhd_config"
+CFG_TYPE_ANNOTATION = f"{DOMAIN}/cfg_type"
+GROUPS_ANNOTATION = f"{DOMAIN}/nhd_groups"
+GPU_MAP_ANNOTATION_PREFIX = f"{DOMAIN}/nhd_gpu_devices"
+SCHEDULER_TAINT = f"{DOMAIN}/nhd_scheduler"
+MAINTENANCE_LABEL = f"{DOMAIN}/maintenance"
+NAD_ANNOTATION = "k8s.v1.cni.cncf.io/networks"
+
+
+class EventType(Enum):
+    NORMAL = "Normal"
+    WARNING = "Warning"
+
+
+@dataclass
+class PodEvent:
+    """A recorded scheduling event (reference: K8SMgr.py:518-559)."""
+
+    pod: str
+    namespace: str
+    reason: str
+    event_type: EventType
+    message: str
+
+
+@dataclass
+class WatchEvent:
+    """Backend→controller change notification (what kopf watches deliver
+    in the reference, TriadController.py:41-144)."""
+
+    kind: str                    # 'pod_create' | 'pod_delete' | 'node_update'
+    name: str
+    namespace: str = ""
+    annotations: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    old_labels: Dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+    scheduler_name: str = ""     # pod events: spec.schedulerName
+    node: str = ""               # pod events: spec.nodeName at event time
+    unschedulable: bool = False
+    was_unschedulable: bool = False
+    taints: List[str] = field(default_factory=list)
+    old_taints: List[str] = field(default_factory=list)
+
+
+class ClusterBackend(ABC):
+    """The K8SMgr surface (reference file:line cited per method)."""
+
+    # ---- node reads ----
+
+    @abstractmethod
+    def get_nodes(self) -> List[str]:
+        """Names of KubeletReady nodes (K8SMgr.py:55-69)."""
+
+    @abstractmethod
+    def is_node_active(self, node: str) -> bool:
+        """Has the scheduler taint and is not unschedulable (K8SMgr.py:167-192)."""
+
+    @abstractmethod
+    def get_node_labels(self, node: str) -> Dict[str, str]:
+        """(K8SMgr.py:108-110)"""
+
+    @abstractmethod
+    def get_node_addr(self, node: str) -> str:
+        """First InternalIP (K8SMgr.py:91-106)."""
+
+    @abstractmethod
+    def get_node_hugepage_resources(self, node: str) -> Tuple[int, int]:
+        """(capacity GiB, allocatable GiB) of 1Gi hugepages (K8SMgr.py:71-89)."""
+
+    # ---- pod reads ----
+
+    @abstractmethod
+    def pod_exists(self, pod: str, ns: str) -> bool:
+        """(K8SMgr.py:128-135)"""
+
+    @abstractmethod
+    def get_pod_node(self, pod: str, ns: str) -> Optional[str]:
+        """(K8SMgr.py:112-126)"""
+
+    @abstractmethod
+    def get_pod_annotations(self, pod: str, ns: str) -> Optional[Dict[str, str]]:
+        """(K8SMgr.py:194-202)"""
+
+    @abstractmethod
+    def get_cfg_annotations(self, pod: str, ns: str) -> Optional[str]:
+        """The solved-config annotation, if present (K8SMgr.py:137-150)."""
+
+    @abstractmethod
+    def get_cfg_type(self, pod: str, ns: str) -> Optional[str]:
+        """(K8SMgr.py:494-506)"""
+
+    @abstractmethod
+    def get_pod_node_groups(self, pod: str, ns: str) -> List[str]:
+        """Requested node groups, defaulting to ['default'] (K8SMgr.py:152-165)."""
+
+    @abstractmethod
+    def get_requested_pod_resources(self, pod: str, ns: str) -> Dict[str, str]:
+        """First container's resource requests (K8SMgr.py:215-225)."""
+
+    @abstractmethod
+    def get_scheduled_pods(self, scheduler: str) -> List[Tuple[str, str, str, str]]:
+        """(pod, ns, uid, phase) for pods already bound by this scheduler
+        (K8SMgr.py:204-213)."""
+
+    @abstractmethod
+    def service_pods(self, scheduler: str) -> Dict[Tuple[str, str, str], Tuple[str, Optional[str]]]:
+        """{(ns, pod, uid): (phase, node)} for pods requesting this
+        scheduler (K8SMgr.py:227-242)."""
+
+    @abstractmethod
+    def get_cfg_map(self, pod: str, ns: str) -> Tuple[Optional[str], Optional[str]]:
+        """(configmap name, first file's text) for the pod's config volume
+        (K8SMgr.py:328-356)."""
+
+    # ---- writes ----
+
+    @abstractmethod
+    def add_nad_to_pod(self, pod: str, ns: str, nad: str) -> bool:
+        """CNI NetworkAttachmentDefinition annotation (K8SMgr.py:284-298)."""
+
+    @abstractmethod
+    def annotate_pod_config(self, ns: str, pod: str, cfg: str) -> bool:
+        """Persist the solved config (K8SMgr.py:379-393)."""
+
+    @abstractmethod
+    def annotate_pod_gpu_map(self, ns: str, pod: str, gpu_map: Dict[str, int]) -> bool:
+        """Per-device GPU annotations (K8SMgr.py:359-376)."""
+
+    @abstractmethod
+    def bind_pod_to_node(self, pod: str, node: str, ns: str) -> bool:
+        """THE schedule commit point — V1Binding (K8SMgr.py:468-492)."""
+
+    @abstractmethod
+    def generate_pod_event(
+        self, pod: str, ns: str, reason: str, event_type: EventType, message: str
+    ) -> None:
+        """Operator-facing audit trail, 'NHD:'-prefixed (K8SMgr.py:518-559)."""
+
+    # ---- watch plane (consumed by the controller) ----
+
+    @abstractmethod
+    def poll_watch_events(self, timeout: float = 0.0) -> Iterable[WatchEvent]:
+        """Drain pending cluster-change notifications (the kopf watch
+        equivalent, TriadController.py:41-144)."""
+
+    # ---- TriadSet support ----
+
+    @abstractmethod
+    def list_triadsets(self) -> List[dict]:
+        """TriadSet CRD objects: {name, ns, replicas, service_name, template}
+        (TriadController.py:87-120, deploy/triad-crd.1.16.yaml)."""
+
+    @abstractmethod
+    def list_pods_of_triadset(self, ts: dict) -> List[str]:
+        """Existing pod names for a TriadSet."""
+
+    @abstractmethod
+    def create_pod_for_triadset(self, ts: dict, ordinal: int) -> bool:
+        """Create the missing '{service}-{ordinal}' pod with hostname/
+        subdomain patched in (TriadController.py:101-120)."""

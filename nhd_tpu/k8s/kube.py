@@ -1,0 +1,373 @@
+"""Real Kubernetes backend: the reference K8SMgr surface on kubernetes-client.
+
+Method-for-method port of the reference's API-server interactions
+(K8SMgr.py), behind the ClusterBackend seam. Import is gated: the
+kubernetes package is only required when this backend is actually
+constructed, so hermetic environments (tests, benchmarks, this dev image)
+never need it.
+
+The watch plane differs from the reference by design: instead of kopf's
+asyncio operators (TriadController.py:161-171), watches run in daemon
+threads that translate raw API events into WatchEvent records drained by
+the controller — same information, no framework dependency.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from nhd_tpu.k8s.interface import (
+    CFG_ANNOTATION,
+    CFG_TYPE_ANNOTATION,
+    GPU_MAP_ANNOTATION_PREFIX,
+    GROUPS_ANNOTATION,
+    NAD_ANNOTATION,
+    SCHEDULER_TAINT,
+    ClusterBackend,
+    EventType,
+    WatchEvent,
+)
+from nhd_tpu.utils import get_logger
+
+
+class KubeClusterBackend(ClusterBackend):
+    """kubernetes-client implementation (reference: K8SMgr.py)."""
+
+    def __init__(self, start_watches: bool = True):
+        try:
+            import kubernetes  # noqa: F401
+            from kubernetes import client, config, watch
+        except ImportError as exc:  # pragma: no cover - env without k8s
+            raise RuntimeError(
+                "KubeClusterBackend requires the 'kubernetes' package; use "
+                "FakeClusterBackend for hermetic runs"
+            ) from exc
+
+        self.logger = get_logger(__name__)
+        self._client = client
+        self._watch_mod = watch
+        try:
+            config.load_incluster_config()
+        except Exception:
+            # outside a pod: fall back to kubeconfig (K8SMgr.py:43-46)
+            config.load_kube_config()
+        self.v1 = client.CoreV1Api()
+        self.crd = client.CustomObjectsApi()
+        self._events: "queue.Queue[WatchEvent]" = queue.Queue()
+        if start_watches:
+            self._start_watches()
+
+    # ------------------------------------------------------------------
+    # node reads
+    # ------------------------------------------------------------------
+
+    def get_nodes(self) -> List[str]:
+        """KubeletReady nodes (K8SMgr.py:55-69)."""
+        out = []
+        for item in self.v1.list_node().items:
+            for cond in item.status.conditions or []:
+                if cond.reason == "KubeletReady" and cond.status == "True":
+                    out.append(item.metadata.name)
+        return out
+
+    def is_node_active(self, node: str) -> bool:
+        """Scheduler taint present and node not cordoned (K8SMgr.py:167-192)."""
+        obj = self.v1.read_node(node)
+        has_taint = any(
+            t.key == SCHEDULER_TAINT and t.effect == "NoSchedule"
+            for t in (obj.spec.taints or [])
+        )
+        return has_taint and not bool(obj.spec.unschedulable)
+
+    def get_node_labels(self, node: str) -> Dict[str, str]:
+        return dict(self.v1.read_node(node).metadata.labels or {})
+
+    def get_node_addr(self, node: str) -> str:
+        """First InternalIP (K8SMgr.py:91-106)."""
+        for addr in self.v1.read_node(node).status.addresses or []:
+            if addr.type == "InternalIP":
+                return addr.address
+        return ""
+
+    def get_node_hugepage_resources(self, node: str) -> Tuple[int, int]:
+        """1Gi hugepage capacity/allocatable in GiB (K8SMgr.py:71-89)."""
+        obj = self.v1.read_node(node)
+
+        def gi(res: Optional[dict]) -> int:
+            if not res:
+                return 0
+            val = res.get("hugepages-1Gi", "0")
+            return int(str(val).rstrip("Gi")) if "Gi" in str(val) else int(val)
+
+        return (gi(obj.status.capacity), gi(obj.status.allocatable))
+
+    # ------------------------------------------------------------------
+    # pod reads
+    # ------------------------------------------------------------------
+
+    def _read_pod(self, pod: str, ns: str):
+        try:
+            return self.v1.read_namespaced_pod(pod, ns)
+        except self._client.exceptions.ApiException:
+            return None
+
+    def pod_exists(self, pod: str, ns: str) -> bool:
+        return self._read_pod(pod, ns) is not None
+
+    def get_pod_node(self, pod: str, ns: str) -> Optional[str]:
+        obj = self._read_pod(pod, ns)
+        return obj.spec.node_name if obj else None
+
+    def get_pod_annotations(self, pod: str, ns: str) -> Optional[Dict[str, str]]:
+        obj = self._read_pod(pod, ns)
+        return dict(obj.metadata.annotations or {}) if obj else None
+
+    def get_cfg_annotations(self, pod: str, ns: str) -> Optional[str]:
+        annots = self.get_pod_annotations(pod, ns)
+        return annots.get(CFG_ANNOTATION) if annots else None
+
+    def get_cfg_type(self, pod: str, ns: str) -> Optional[str]:
+        annots = self.get_pod_annotations(pod, ns)
+        return annots.get(CFG_TYPE_ANNOTATION) if annots else None
+
+    def get_pod_node_groups(self, pod: str, ns: str) -> List[str]:
+        annots = self.get_pod_annotations(pod, ns) or {}
+        if GROUPS_ANNOTATION in annots:
+            return annots[GROUPS_ANNOTATION].split(".")
+        return ["default"]
+
+    def get_requested_pod_resources(self, pod: str, ns: str) -> Dict[str, str]:
+        """First container only, like the reference (K8SMgr.py:215-225)."""
+        obj = self._read_pod(pod, ns)
+        if not obj or not obj.spec.containers:
+            return {}
+        res = obj.spec.containers[0].resources
+        return dict(res.requests or {}) if res else {}
+
+    def get_scheduled_pods(self, scheduler: str) -> List[Tuple[str, str, str, str]]:
+        out = []
+        for p in self.v1.list_pod_for_all_namespaces().items:
+            if p.spec.scheduler_name == scheduler and p.spec.node_name:
+                out.append(
+                    (p.metadata.name, p.metadata.namespace, p.metadata.uid,
+                     p.status.phase)
+                )
+        return out
+
+    def service_pods(self, scheduler: str):
+        out = {}
+        for p in self.v1.list_pod_for_all_namespaces().items:
+            if p.spec.scheduler_name == scheduler:
+                key = (p.metadata.namespace, p.metadata.name, p.metadata.uid)
+                out[key] = (p.status.phase, p.spec.node_name)
+        return out
+
+    def get_cfg_map(self, pod: str, ns: str) -> Tuple[Optional[str], Optional[str]]:
+        """Find the pod's ConfigMap volume, return its first file
+        (K8SMgr.py:328-356)."""
+        obj = self._read_pod(pod, ns)
+        if obj is None:
+            return (None, None)
+        for vol in obj.spec.volumes or []:
+            if vol.config_map is None:
+                continue
+            cm = self.v1.read_namespaced_config_map(vol.config_map.name, ns)
+            if cm.data:
+                return (vol.config_map.name, next(iter(cm.data.values())))
+        return (None, None)
+
+    # ------------------------------------------------------------------
+    # writes
+    # ------------------------------------------------------------------
+
+    def _patch_annotation(self, pod: str, ns: str, annots: Dict[str, str]) -> bool:
+        try:
+            self.v1.patch_namespaced_pod(
+                pod, ns, {"metadata": {"annotations": annots}}
+            )
+            return True
+        except self._client.exceptions.ApiException as exc:
+            self.logger.error(f"annotation patch failed for {ns}/{pod}: {exc}")
+            return False
+
+    def add_nad_to_pod(self, pod: str, ns: str, nad: str) -> bool:
+        return self._patch_annotation(pod, ns, {NAD_ANNOTATION: nad})
+
+    def annotate_pod_config(self, ns: str, pod: str, cfg: str) -> bool:
+        return self._patch_annotation(pod, ns, {CFG_ANNOTATION: cfg})
+
+    def annotate_pod_gpu_map(self, ns: str, pod: str, gpu_map: Dict[str, int]) -> bool:
+        return self._patch_annotation(
+            pod, ns,
+            {f"{GPU_MAP_ANNOTATION_PREFIX}.{d}": str(i) for d, i in gpu_map.items()},
+        )
+
+    def bind_pod_to_node(self, pod: str, node: str, ns: str) -> bool:
+        """V1Binding; the known kubernetes-client ValueError on the empty
+        response is swallowed like the reference does (K8SMgr.py:487-491)."""
+        client = self._client
+        body = client.V1Binding(
+            metadata=client.V1ObjectMeta(name=pod),
+            target=client.V1ObjectReference(
+                api_version="v1", kind="Node", name=node, namespace=ns
+            ),
+        )
+        try:
+            self.v1.create_namespaced_pod_binding(pod, ns, body)
+        except ValueError:
+            pass  # client chokes on the empty 201 body; bind succeeded
+        except client.exceptions.ApiException as exc:
+            self.logger.error(f"bind failed for {ns}/{pod} -> {node}: {exc}")
+            return False
+        return True
+
+    def generate_pod_event(self, pod, ns, reason, event_type, message) -> None:
+        """'NHD:'-prefixed V1Event on the pod (K8SMgr.py:518-559)."""
+        import datetime
+
+        client = self._client
+        obj = self._read_pod(pod, ns)
+        if obj is None:
+            return
+        now = datetime.datetime.now(datetime.timezone.utc)
+        body = client.CoreV1Event(
+            metadata=client.V1ObjectMeta(generate_name=f"{pod}.nhd."),
+            involved_object=client.V1ObjectReference(
+                api_version="v1", kind="Pod", name=pod, namespace=ns,
+                uid=obj.metadata.uid,
+            ),
+            reason=reason, message=f"NHD: {message}",
+            type=event_type.value, count=1,
+            first_timestamp=now, last_timestamp=now,
+            source=client.V1EventSource(component="nhd-scheduler"),
+        )
+        try:
+            self.v1.create_namespaced_event(ns, body)
+        except client.exceptions.ApiException as exc:
+            self.logger.error(f"event post failed for {ns}/{pod}: {exc}")
+
+    # ------------------------------------------------------------------
+    # watch plane
+    # ------------------------------------------------------------------
+
+    def _start_watches(self) -> None:
+        threading.Thread(target=self._watch_pods, daemon=True).start()
+        threading.Thread(target=self._watch_nodes, daemon=True).start()
+
+    def _watch_pods(self) -> None:  # pragma: no cover - live cluster only
+        w = self._watch_mod.Watch()
+        while True:
+            try:
+                for ev in w.stream(self.v1.list_pod_for_all_namespaces):
+                    obj = ev["object"]
+                    kind = {"ADDED": "pod_create", "DELETED": "pod_delete"}.get(
+                        ev["type"]
+                    )
+                    if kind is None:
+                        continue
+                    self._events.put(
+                        WatchEvent(
+                            kind=kind, name=obj.metadata.name,
+                            namespace=obj.metadata.namespace,
+                            annotations=dict(obj.metadata.annotations or {}),
+                            uid=obj.metadata.uid,
+                            scheduler_name=obj.spec.scheduler_name or "",
+                            node=obj.spec.node_name or "",
+                        )
+                    )
+            except Exception as exc:
+                self.logger.error(f"pod watch restarted: {exc}")
+
+    def _watch_nodes(self) -> None:  # pragma: no cover - live cluster only
+        last: Dict[str, tuple] = {}
+        w = self._watch_mod.Watch()
+        while True:
+            try:
+                for ev in w.stream(self.v1.list_node):
+                    obj = ev["object"]
+                    name = obj.metadata.name
+                    labels = dict(obj.metadata.labels or {})
+                    unsched = bool(obj.spec.unschedulable)
+                    taints = [t.key for t in (obj.spec.taints or [])]
+                    old_labels, old_unsched, old_taints = last.get(
+                        name, (labels, unsched, taints)
+                    )
+                    self._events.put(
+                        WatchEvent(
+                            kind="node_update", name=name, labels=labels,
+                            old_labels=old_labels, unschedulable=unsched,
+                            was_unschedulable=old_unsched, taints=taints,
+                            old_taints=old_taints,
+                        )
+                    )
+                    last[name] = (labels, unsched, taints)
+            except Exception as exc:
+                self.logger.error(f"node watch restarted: {exc}")
+
+    def poll_watch_events(self, timeout: float = 0.0) -> Iterable[WatchEvent]:
+        out = []
+        try:
+            while True:
+                out.append(self._events.get(block=bool(timeout), timeout=timeout or None))
+                timeout = 0.0
+        except queue.Empty:
+            pass
+        return out
+
+    # ------------------------------------------------------------------
+    # TriadSets (CRD group/version per deploy/triad-crd.1.16.yaml)
+    # ------------------------------------------------------------------
+
+    _CRD_GROUP = "sigproc.viasat.io"
+    _CRD_VERSION = "v1"
+    _CRD_PLURAL = "triadsets"
+
+    def list_triadsets(self) -> List[dict]:
+        try:
+            objs = self.crd.list_cluster_custom_object(
+                self._CRD_GROUP, self._CRD_VERSION, self._CRD_PLURAL
+            )
+        except self._client.exceptions.ApiException:
+            return []
+        out = []
+        for item in objs.get("items", []):
+            spec = item.get("spec", {})
+            out.append(
+                {
+                    "name": item["metadata"]["name"],
+                    "ns": item["metadata"]["namespace"],
+                    "replicas": spec.get("replicas", 0),
+                    "service_name": spec.get("serviceName", item["metadata"]["name"]),
+                    "template": spec.get("template", {}),
+                }
+            )
+        return out
+
+    def list_pods_of_triadset(self, ts: dict) -> List[str]:
+        prefix = ts["service_name"] + "-"
+        out = []
+        for p in self.v1.list_namespaced_pod(ts["ns"]).items:
+            name = p.metadata.name
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                out.append(name)
+        return out
+
+    def create_pod_for_triadset(self, ts: dict, ordinal: int) -> bool:
+        """Instantiate the template as '{service}-{ordinal}' with hostname
+        and subdomain patched (TriadController.py:101-120)."""
+        name = f"{ts['service_name']}-{ordinal}"
+        template = dict(ts.get("template") or {})
+        meta = dict(template.get("metadata", {}))
+        spec = dict(template.get("spec", {}))
+        meta["name"] = name
+        spec["hostname"] = name
+        spec["subdomain"] = ts["service_name"]
+        body = {"apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": spec}
+        try:
+            self.v1.create_namespaced_pod(ts["ns"], body)
+            return True
+        except self._client.exceptions.ApiException as exc:
+            self.logger.error(f"TriadSet pod create failed for {name}: {exc}")
+            return False

@@ -1,0 +1,5 @@
+from nhd_tpu.scheduler.events import WatchQueue, WatchType
+from nhd_tpu.scheduler.core import PodStatus, Scheduler
+from nhd_tpu.scheduler.controller import Controller
+
+__all__ = ["Controller", "PodStatus", "Scheduler", "WatchQueue", "WatchType"]

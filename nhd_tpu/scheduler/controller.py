@@ -1,0 +1,145 @@
+"""Controller: watch-plane translation + TriadSet reconciliation.
+
+The reference builds this on kopf (TriadController.py): node watches become
+cordon/maintenance/group events, pod watches become create/delete events,
+and a 3-second timer recreates missing TriadSet pods. This implementation
+consumes the backend's WatchEvent stream directly — no operator framework —
+and keeps the same translation rules and the crash-only stance (a
+controller exception stops the harness, which exits; reference
+TriadController.py:147-152).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from nhd_tpu.k8s.interface import (
+    CFG_ANNOTATION,
+    CFG_TYPE_ANNOTATION,
+    MAINTENANCE_LABEL,
+    SCHEDULER_TAINT,
+    ClusterBackend,
+    WatchEvent,
+)
+from nhd_tpu.core.node import HostNode
+from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
+from nhd_tpu.utils import get_logger
+
+NHD_GROUP_LABEL = "NHD_GROUP"
+TRIADSET_PERIOD_SEC = 3.0   # reference: TriadController.py:89
+
+
+class Controller(threading.Thread):
+    """Translates cluster changes into scheduler events and keeps TriadSets
+    at their replica counts."""
+
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        watch_queue: WatchQueue,
+        *,
+        sched_name: str = "nhd-scheduler",
+        poll_interval: float = 0.1,
+    ):
+        super().__init__(name="nhd-controller", daemon=True)
+        self.logger = get_logger(__name__)
+        self.backend = backend
+        self.queue = watch_queue
+        self.sched_name = sched_name
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        self._last_triadset = 0.0
+
+    # ------------------------------------------------------------------
+
+    def handle_node_update(self, ev: WatchEvent) -> None:
+        """Cordon/uncordon via taint or unschedulable flips, NHD group label
+        diffs, maintenance label diffs (reference: TriadController.py:41-84)."""
+        had_taint = SCHEDULER_TAINT in ev.old_taints
+        has_taint = SCHEDULER_TAINT in ev.taints
+
+        if (had_taint and not has_taint) or (
+            not ev.was_unschedulable and ev.unschedulable
+        ):
+            self.queue.put(WatchItem(WatchType.NODE_CORDON, node=ev.name))
+        elif (not had_taint and has_taint) or (
+            ev.was_unschedulable and not ev.unschedulable and has_taint
+        ):
+            # uncordon-via-unschedulable only reactivates nodes that carry
+            # the scheduler taint — never nodes NHD doesn't manage
+            # (reference: TriadController.py:56-63)
+            self.queue.put(WatchItem(WatchType.NODE_UNCORDON, node=ev.name))
+
+        old_group = ev.old_labels.get(NHD_GROUP_LABEL)
+        new_group = ev.labels.get(NHD_GROUP_LABEL)
+        if new_group is not None and new_group != old_group:
+            self.queue.put(
+                WatchItem(WatchType.GROUP_UPDATE, node=ev.name, groups=new_group)
+            )
+
+        was_maint = HostNode.maintenance_from_labels(ev.old_labels)
+        is_maint = HostNode.maintenance_from_labels(ev.labels)
+        if not was_maint and is_maint:
+            self.queue.put(WatchItem(WatchType.NODE_MAINT_START, node=ev.name))
+        elif was_maint and not is_maint:
+            self.queue.put(WatchItem(WatchType.NODE_MAINT_END, node=ev.name))
+
+    def handle_pod_event(self, ev: WatchEvent) -> None:
+        """Only Triad pods that request THIS scheduler matter — both the
+        cfg_type annotation and spec.schedulerName gate the event
+        (reference: TriadController.py:123-144 'when' clauses)."""
+        if ev.annotations.get(CFG_TYPE_ANNOTATION) != "triad":
+            return
+        if ev.scheduler_name != self.sched_name:
+            return
+        wt = (
+            WatchType.TRIAD_POD_CREATE
+            if ev.kind == "pod_create"
+            else WatchType.TRIAD_POD_DELETE
+        )
+        self.queue.put(
+            WatchItem(
+                wt,
+                pod={
+                    "ns": ev.namespace, "name": ev.name, "uid": ev.uid,
+                    # deletes carry the last-seen solved config + node so the
+                    # scheduler can release without re-reading a gone pod
+                    "cfg": ev.annotations.get(CFG_ANNOTATION, ""),
+                    "node": ev.node,
+                },
+            )
+        )
+
+    def reconcile_triadsets(self) -> None:
+        """Create any missing '{service}-{ordinal}' pods
+        (reference: TriadController.py:87-120)."""
+        for ts in self.backend.list_triadsets():
+            existing = set(self.backend.list_pods_of_triadset(ts))
+            for ordinal in range(int(ts.get("replicas", 0))):
+                name = f"{ts['service_name']}-{ordinal}"
+                if name not in existing:
+                    self.logger.info(f"TriadSet {ts['name']}: creating pod {name}")
+                    self.backend.create_pod_for_triadset(ts, ordinal)
+
+    # ------------------------------------------------------------------
+
+    def run_once(self, now: Optional[float] = None) -> None:
+        for ev in self.backend.poll_watch_events():
+            if ev.kind == "node_update":
+                self.handle_node_update(ev)
+            elif ev.kind in ("pod_create", "pod_delete"):
+                self.handle_pod_event(ev)
+        t = time.monotonic() if now is None else now
+        if t - self._last_triadset >= TRIADSET_PERIOD_SEC:
+            self._last_triadset = t
+            self.reconcile_triadsets()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self.run_once()
+            time.sleep(self.poll_interval)
+
+    def stop(self) -> None:
+        self._stop.set()

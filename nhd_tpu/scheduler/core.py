@@ -1,0 +1,534 @@
+"""Scheduler core: the reconciliation loop around the batched solver.
+
+Keeps the reference's architecture (NHDScheduler.py:36-570) — single owner
+thread for all mutable state, event-driven fast path plus periodic full
+reconciliation, crash-only recovery by replaying solved configs from pod
+annotations — with one structural change: pending pods are scheduled as a
+*batch* through BatchScheduler instead of one at a time, which is the whole
+point of the rebuild (BASELINE.json north star). Single pending pods take
+the same path with a batch of one, reproducing reference behavior exactly.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from enum import Enum
+from typing import Dict, List, Optional, Tuple
+
+from nhd_tpu import NHD_SCHED_NAME
+from nhd_tpu.config.parser import CfgParser, get_cfg_parser
+from nhd_tpu.core.node import HostNode
+from nhd_tpu.core.request import PodRequest
+from nhd_tpu.k8s.interface import ClusterBackend, EventType
+from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
+from nhd_tpu.solver.batch import BatchItem, BatchScheduler
+from nhd_tpu.utils import get_logger
+
+IDLE_CNT_THRESH = 60        # reference: NHDScheduler.py:24
+Q_BLOCK_TIME_SEC = 0.5      # reference: NHDScheduler.py:25
+
+
+class PodStatus(Enum):
+    """Reference: NHDScheduler.py:29-34."""
+
+    SCHEDULED = 0
+    FAILED = 1
+    SUCCEEDED = 2
+    RUNNING = 3
+    COMPLETED = 4
+
+
+class RpcMsgType(Enum):
+    """Reference: NHDCommon.py:69-73."""
+
+    NODE_INFO = 0
+    SCHEDULER_INFO = 1
+    POD_INFO = 2
+
+
+class Scheduler(threading.Thread):
+    """The single-writer scheduling thread (reference: NHDScheduler.py:43)."""
+
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        watch_queue: Optional[WatchQueue] = None,
+        rpc_queue: Optional[queue.Queue] = None,
+        *,
+        sched_name: str = NHD_SCHED_NAME,
+        respect_busy: bool = True,
+    ):
+        super().__init__(name="nhd-scheduler", daemon=True)
+        self.logger = get_logger(__name__)
+        self.backend = backend
+        self.nqueue = watch_queue or WatchQueue()
+        self.rpcq = rpc_queue or queue.Queue(maxsize=128)
+        self.sched_name = sched_name
+        self.nodes: Dict[str, HostNode] = {}
+        self.pod_state: Dict[Tuple[str, str], dict] = {}
+        self.failed_schedule_count = 0
+        self.batch = BatchScheduler(respect_busy=respect_busy)
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    # startup / node inventory
+    # ------------------------------------------------------------------
+
+    def build_initial_node_list(self) -> None:
+        """Discover nodes, parse labels, read hugepages
+        (reference: NHDScheduler.py:61-105)."""
+        for name in self.backend.get_nodes():
+            node = HostNode(name, self.backend.is_node_active(name))
+            self.nodes[name] = node
+            try:
+                node.addr = self.backend.get_node_addr(name)
+                if not node.parse_labels(self.backend.get_node_labels(name)):
+                    self.logger.error(f"label parse failed for {name}; deactivating")
+                    node.active = False
+                    continue
+                alloc, free = self.backend.get_node_hugepage_resources(name)
+                if alloc == 0 or not node.set_hugepages(alloc, free):
+                    self.logger.error(f"no hugepages on {name}; deactivating")
+                    node.active = False
+            except Exception as exc:
+                self.logger.error(f"node setup failed for {name}: {exc}")
+                node.active = False
+
+    # ------------------------------------------------------------------
+    # claim / release (restart replay)
+    # ------------------------------------------------------------------
+
+    def _parse_pod_config(
+        self, pod: str, ns: str, cfg_text: str, parse_net: bool
+    ) -> Tuple[Optional[CfgParser], Optional[object]]:
+        cfg_type = self.backend.get_cfg_type(pod, ns)
+        try:
+            parser = get_cfg_parser(cfg_type, cfg_text)
+            top = parser.to_topology(parse_net)
+        except Exception as exc:
+            # broad on purpose: the config is user-supplied text and parse
+            # failures of any species must fail the pod, not the scheduler
+            # (the reference would crash the whole process here via the
+            # kopf exception handler, TriadController.py:147-152)
+            self.logger.error(f"config parse failed for {ns}.{pod}: {exc}")
+            return (None, None)
+        return (parser, top)
+
+    def claim_pod_resources(self, pod: str, ns: str, uid: str) -> None:
+        """Re-claim a deployed pod's resources from its solved-config
+        annotation (reference: NHDScheduler.py:107-144)."""
+        cfg = self.backend.get_cfg_annotations(pod, ns)
+        if not cfg:
+            self.logger.error(f"no solved config for {ns}.{pod}")
+            return
+        _, top = self._parse_pod_config(pod, ns, cfg, parse_net=True)
+        if top is None:
+            return
+        node_name = self.backend.get_pod_node(pod, ns)
+        if not node_name or node_name not in self.nodes:
+            self.logger.error(f"{ns}.{pod} bound to unknown node {node_name}")
+            return
+        node = self.nodes[node_name]
+        if node.pod_present(pod, ns):
+            self.logger.error(f"{ns}.{pod} already claimed on {node_name}")
+            return
+        if not node.claim_from_topology(top):
+            return
+        node.add_scheduled_pod(pod, ns, top)
+        self.pod_state[(ns, pod)] = {
+            "state": PodStatus.SCHEDULED, "time": time.time(), "uid": uid
+        }
+
+    def load_deployed_configs(self) -> None:
+        """Replay all bound pods after restart (reference: NHDScheduler.py:161-172)."""
+        for pod, ns, uid, phase in self.backend.get_scheduled_pods(self.sched_name):
+            if phase in ("Running", "CrashLoopBackOff", "Pending"):
+                self.claim_pod_resources(pod, ns, uid)
+
+    def reset_resources(self) -> None:
+        """Wipe and rebuild all claims from the cluster — drift repair
+        (reference: NHDScheduler.py:146-159)."""
+        for node in self.nodes.values():
+            node.reset_resources()
+        self.pod_state.clear()
+        self.load_deployed_configs()
+
+    def release_pod_resources(
+        self,
+        pod: str,
+        ns: str,
+        *,
+        cfg: Optional[str] = None,
+        node_name: Optional[str] = None,
+    ) -> None:
+        """Free a completed/removed pod's claims (reference: NHDScheduler.py:174-205).
+
+        Delete watches fire after the pod object is gone, so the event
+        carries the last-seen solved config + node (controller.py); the
+        backend read is only a fallback for callers without one. Only when
+        neither source yields the config does this degrade to the
+        reference's full-cluster rescan.
+        """
+        cfg = cfg or self.backend.get_cfg_annotations(pod, ns)
+        if not cfg:
+            self.logger.warning(
+                f"{ns}.{pod} gone before release; rescanning cluster"
+            )
+            self.reset_resources()
+            return
+        _, top = self._parse_pod_config(pod, ns, cfg, parse_net=True)
+        if top is None:
+            return
+        node_name = node_name or self.backend.get_pod_node(pod, ns)
+        if not node_name:
+            # last resort: the host mirror knows where the pod sits
+            node_name = next(
+                (n for n, v in self.nodes.items() if v.pod_present(pod, ns)), None
+            )
+        if not node_name or node_name not in self.nodes:
+            return
+        node = self.nodes[node_name]
+        if not node.pod_present(pod, ns):
+            self.logger.error(f"{ns}.{pod} not on node {node_name}; cannot release")
+            return
+        node.release_from_topology(top)
+        node.remove_scheduled_pod(pod, ns)
+        node.set_busy()
+
+    # ------------------------------------------------------------------
+    # scheduling
+    # ------------------------------------------------------------------
+
+    def _pod_reservations(self, pod: str, ns: str) -> Dict[str, int]:
+        """Pod-spec-native resources worth enforcing (reference:
+        NHDScheduler.py:214-225 — hugepages only)."""
+        res = self.backend.get_requested_pod_resources(pod, ns)
+        out = {}
+        if "hugepages-1Gi" in res:
+            raw = str(res["hugepages-1Gi"])
+            out["hugepages-1Gi"] = int(raw[: raw.find("G")]) if "G" in raw else int(raw)
+        return out
+
+    def _prepare_item(self, pod: str, ns: str) -> Optional[Tuple[CfgParser, BatchItem]]:
+        """Parse one pending pod's config into a BatchItem."""
+        _, cfg_text = self.backend.get_cfg_map(pod, ns)
+        if cfg_text is None:
+            self.backend.generate_pod_event(
+                pod, ns, "FailedCfgParse", EventType.WARNING,
+                f"No config found for pod {pod}",
+            )
+            return None
+        parser, top = self._parse_pod_config(pod, ns, cfg_text, parse_net=False)
+        if top is None:
+            self.backend.generate_pod_event(
+                pod, ns, "FailedCfgParse", EventType.WARNING,
+                f"Error while processing config for pod {pod}",
+            )
+            return None
+        top.add_pod_reservations(self._pod_reservations(pod, ns))
+        groups = frozenset(self.backend.get_pod_node_groups(pod, ns))
+        req = PodRequest.from_topology(top, node_groups=groups)
+        return parser, BatchItem((ns, pod), req, top)
+
+    def attempt_scheduling_batch(self, pods: List[Tuple[str, str, str]]) -> int:
+        """Schedule a set of (pod, ns, uid) as one batched solve, then walk
+        the reference's annotate→bind commit path per winner
+        (reference: NHDScheduler.py:249-353)."""
+        uids = {(ns, pod): uid for pod, ns, uid in pods}
+        prepared: List[Tuple[CfgParser, BatchItem]] = []
+        for pod, ns, _uid in pods:
+            if not self.backend.pod_exists(pod, ns):
+                continue
+            self.backend.generate_pod_event(
+                pod, ns, "StartedScheduling", EventType.NORMAL,
+                f"Started scheduling {ns}/{pod}",
+            )
+            got = self._prepare_item(pod, ns)
+            if got is None:
+                self.pod_state[(ns, pod)] = {
+                    "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
+                }
+                self.failed_schedule_count += 1
+                continue
+            prepared.append(got)
+        if not prepared:
+            return 0
+
+        results, _ = self.batch.schedule(
+            self.nodes, [item for _, item in prepared]
+        )
+
+        scheduled = 0
+        for (parser, item), result in zip(prepared, results):
+            ns, pod = item.key
+            if result.node is None:
+                self.backend.generate_pod_event(
+                    pod, ns, "FailedScheduling", EventType.WARNING,
+                    f"No valid candidate nodes found for scheduling pod {pod}",
+                )
+                self.failed_schedule_count += 1
+                self.pod_state[(ns, pod)] = {
+                    "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
+                }
+                continue
+            if self._commit_pod(parser, item, result):
+                scheduled += 1
+                self.pod_state[(ns, pod)] = {
+                    "state": PodStatus.SCHEDULED, "time": time.time(),
+                    "uid": uids.get((ns, pod), "0"),
+                }
+            else:
+                self.failed_schedule_count += 1
+                self.pod_state[(ns, pod)] = {
+                    "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
+                }
+        return scheduled
+
+    def _commit_pod(self, parser: CfgParser, item: BatchItem, result) -> bool:
+        """NAD → solved config → GPU map → bind, releasing on any failure
+        (reference: NHDScheduler.py:286-353)."""
+        ns, pod = item.key
+        node = self.nodes[result.node]
+        self.backend.generate_pod_event(
+            pod, ns, "Scheduling", EventType.NORMAL,
+            f"Node {result.node} selected for scheduling",
+        )
+
+        nic_indices = sorted({x[0] for x in (result.nic_list or [])})
+        nad = ",".join(f"{x}@{x}" for x in node.nad_names_from_indices(nic_indices))
+        if nad and not self.backend.add_nad_to_pod(pod, ns, nad):
+            self.logger.error(f"NAD annotation failed for {ns}/{pod}")
+            self._unwind(pod, ns, node, item)
+            return False
+
+        solved = parser.to_config()
+        gpu_map = parser.to_gpu_map()
+
+        if gpu_map and not self.backend.annotate_pod_gpu_map(ns, pod, gpu_map):
+            self.backend.generate_pod_event(
+                pod, ns, "PodCfgFailed", EventType.WARNING,
+                "Failed to annotate pod's GPU configuration",
+            )
+            self._unwind(pod, ns, node, item)
+            return False
+
+        if not self.backend.annotate_pod_config(ns, pod, solved):
+            self.backend.generate_pod_event(
+                pod, ns, "PodCfgFailed", EventType.WARNING,
+                "Failed to annotate pod's configuration",
+            )
+            self._unwind(pod, ns, node, item)
+            return False
+        self.backend.generate_pod_event(
+            pod, ns, "PodCfgSuccess", EventType.NORMAL,
+            "Successfully added pod's configuration to annotations",
+        )
+
+        if not self.backend.bind_pod_to_node(pod, result.node, ns):
+            self.backend.generate_pod_event(
+                pod, ns, "FailedScheduling", EventType.WARNING,
+                f"Failed to schedule {ns}/{pod} to {result.node}",
+            )
+            self._unwind(pod, ns, node, item)
+            return False
+
+        self.backend.generate_pod_event(
+            pod, ns, "Scheduled", EventType.NORMAL,
+            f"Successfully assigned {ns}/{pod} to {result.node}",
+        )
+        return True
+
+    def _unwind(self, pod: str, ns: str, node: HostNode, item: BatchItem) -> None:
+        """Roll back an applied batch claim when the K8s commit path fails.
+
+        The batch already mutated the host mirror, so release directly from
+        the solved topology (the reference re-reads the annotation,
+        NHDScheduler.py:174-205; at this point the annotation may not exist
+        yet, but the topology object in hand is the same data).
+        """
+        if item.topology is not None:
+            node.release_from_topology(item.topology)
+        node.remove_scheduled_pod(pod, ns)
+        node.set_busy()
+
+    # ------------------------------------------------------------------
+    # reconciliation
+    # ------------------------------------------------------------------
+
+    def check_pending_pods(self) -> None:
+        """Full-cluster scan: batch-schedule Pending pods, release Failed
+        ones (reference: NHDScheduler.py:425-441)."""
+        podlist = self.backend.service_pods(self.sched_name)
+        to_schedule: List[Tuple[str, str, str]] = []
+        for (ns, pod, uid), (phase, node) in podlist.items():
+            key = (ns, pod)
+            if phase == "Pending" and node is None and (
+                key not in self.pod_state
+                or self.pod_state[key]["state"] != PodStatus.SCHEDULED
+            ):
+                to_schedule.append((pod, ns, uid))
+            elif (
+                phase == "Failed"
+                and key in self.pod_state
+                and self.pod_state[key]["state"] == PodStatus.SCHEDULED
+            ):
+                self.release_pod_resources(pod, ns)
+                self.pod_state[key] = {
+                    "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
+                }
+        if to_schedule:
+            self.attempt_scheduling_batch(to_schedule)
+
+    # ------------------------------------------------------------------
+    # stats (consumed by the RPC plane)
+    # ------------------------------------------------------------------
+
+    def get_basic_node_stats(self) -> List[dict]:
+        """Reference: NHDScheduler.py:355-378."""
+        out = []
+        for name, v in self.nodes.items():
+            out.append(
+                {
+                    "name": name,
+                    "freegpu": v.free_gpu_count(),
+                    "totalgpu": v.total_gpus(),
+                    "freecpu": v.free_cpu_core_count(),
+                    "totalcpu": v.total_cpus(),
+                    "freehuge_gb": v.mem.free_hugepages_gb,
+                    "totalhuge_gb": v.mem.ttl_hugepages_gb,
+                    "totalpods": v.total_pods(),
+                    "active": v.active,
+                    "nicstats": v.nic_used_speeds(),
+                }
+            )
+        return out
+
+    def get_pod_stats(self) -> List[dict]:
+        """Reference: NHDScheduler.py:380-406."""
+        out = []
+        for node_name, v in self.nodes.items():
+            for (pod, ns), top in v.pod_info.items():
+                annots = self.backend.get_pod_annotations(pod, ns)
+                if annots is None:
+                    continue
+                out.append(
+                    {
+                        "namespace": ns,
+                        "podname": pod,
+                        "node": node_name,
+                        "annotations": annots,
+                        "hugepages": top.hugepages_gb,
+                        "proc_cores": [
+                            c.core for pg in top.proc_groups for c in pg.proc_cores
+                        ],
+                        "proc_helper_cores": [
+                            c.core for pg in top.proc_groups for c in pg.misc_cores
+                        ],
+                        "misc_cores": [c.core for c in top.misc_cores],
+                        "gpus": [
+                            g.device_id for pg in top.proc_groups for g in pg.gpus
+                        ],
+                        "nics": [p.mac for p in top.nic_pairs],
+                    }
+                )
+        return out
+
+    def _parse_rpc_req(self, msg_type: RpcMsgType, reply_q: queue.Queue) -> None:
+        """Reference: NHDScheduler.py:408-423."""
+        if msg_type == RpcMsgType.NODE_INFO:
+            reply_q.put(self.get_basic_node_stats())
+        elif msg_type == RpcMsgType.SCHEDULER_INFO:
+            reply_q.put(self.failed_schedule_count)
+        elif msg_type == RpcMsgType.POD_INFO:
+            reply_q.put(self.get_pod_stats())
+
+    # ------------------------------------------------------------------
+    # event handling
+    # ------------------------------------------------------------------
+
+    def handle_watch_item(self, item: WatchItem) -> None:
+        """One controller event (reference: NHDScheduler.py:492-570)."""
+        if item.type == WatchType.TRIAD_POD_DELETE:
+            ns, pod = item.pod["ns"], item.pod["name"]
+            self.release_pod_resources(
+                pod, ns,
+                cfg=item.pod.get("cfg") or None,
+                node_name=item.pod.get("node") or None,
+            )
+            self.pod_state.pop((ns, pod), None)
+
+        elif item.type == WatchType.TRIAD_POD_CREATE:
+            ns, pod, uid = item.pod["ns"], item.pod["name"], item.pod["uid"]
+            state = self.pod_state.get((ns, pod))
+            if state and state["state"] == PodStatus.SCHEDULED:
+                if state["uid"] == uid:
+                    return  # already scheduled; stale event
+                # uid changed: stale record — release and resync
+                self.release_pod_resources(pod, ns)
+                self.pod_state.pop((ns, pod), None)
+            self.attempt_scheduling_batch([(pod, ns, uid)])
+
+        elif item.type in (WatchType.NODE_CORDON, WatchType.NODE_UNCORDON):
+            node = self.nodes.get(item.node)
+            if node is not None:
+                node.active = item.type == WatchType.NODE_UNCORDON
+
+        elif item.type == WatchType.NODE_MAINT_START:
+            node = self.nodes.get(item.node)
+            if node is not None:
+                node.maintenance = True
+
+        elif item.type == WatchType.NODE_MAINT_END:
+            node = self.nodes.get(item.node)
+            if node is not None:
+                node.maintenance = False
+
+        elif item.type == WatchType.GROUP_UPDATE:
+            node = self.nodes.get(item.node)
+            if node is not None:
+                node.set_groups(item.groups)
+
+    # ------------------------------------------------------------------
+    # main loop
+    # ------------------------------------------------------------------
+
+    def startup(self) -> None:
+        """Initialization sequence (reference: NHDScheduler.py:443-464)."""
+        self.build_initial_node_list()
+        self.load_deployed_configs()
+        self.check_pending_pods()
+        # flush any watch events raised while we replayed existing pods
+        try:
+            while True:
+                self.nqueue.get(block=False)
+        except queue.Empty:
+            pass
+
+    def run_once(self, *, idle_count: int = 0) -> int:
+        """One loop iteration; returns the updated idle counter
+        (reference: NHDScheduler.py:470-489 structure)."""
+        try:
+            item = self.nqueue.get(block=False)
+        except queue.Empty:
+            try:
+                rpc = self.rpcq.get(block=True, timeout=Q_BLOCK_TIME_SEC)
+                self._parse_rpc_req(rpc[0], rpc[1])
+            except queue.Empty:
+                idle_count += 1
+                if idle_count >= IDLE_CNT_THRESH:
+                    idle_count = 0
+                    self.check_pending_pods()
+            return idle_count
+        self.handle_watch_item(item)
+        return idle_count
+
+    def run(self) -> None:
+        self.startup()
+        idle = 0
+        while not self._stop.is_set():
+            idle = self.run_once(idle_count=idle)
+
+    def stop(self) -> None:
+        self._stop.set()

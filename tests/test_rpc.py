@@ -1,0 +1,94 @@
+"""gRPC stats plane tests: real server + client over localhost.
+
+Replaces the reference's manual live-cluster script (test/RPCTest.py) with
+an asserting, hermetic round trip: fake cluster → scheduler → gRPC server
+→ client.
+"""
+
+import queue
+import threading
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from nhd_tpu.rpc.server import NHDControlClient, StatsRpcServer
+from nhd_tpu.rpc import nhd_stats_pb2 as pb
+from tests.test_scheduler import make_backend, make_scheduler, pod_cfg
+
+
+@pytest.fixture
+def stack():
+    backend = make_backend(n_nodes=2)
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+
+    # scheduler loop thread answering RPC queue requests
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                item = sched.rpcq.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            sched._parse_rpc_req(item[0], item[1])
+
+    pump_thread = threading.Thread(target=pump, daemon=True)
+    pump_thread.start()
+
+    server = StatsRpcServer(sched.rpcq, port=0)  # ephemeral port
+    server.start()
+    client = NHDControlClient(f"localhost:{server.bound_port}")
+    yield backend, sched, client
+    client.close()
+    server.stop()
+    stop.set()
+
+
+def test_basic_node_stats(stack):
+    backend, sched, client = stack
+    reply = client.get_basic_node_stats()
+    assert reply.status == pb.NHD_STATUS_OK
+    assert len(reply.info) == 2
+    by_name = {i.name: i for i in reply.info}
+    n0 = by_name["node0"]
+    assert n0.total_pods == 1
+    assert n0.used_gpus == 1
+    assert n0.used_hugepages == 4
+    assert n0.active
+    assert len(n0.nic_info) == 4
+    assert sum(i.used_rx for i in n0.nic_info) == 10  # 10 Gbps rx claimed
+
+
+def test_scheduler_stats(stack):
+    _, sched, client = stack
+    reply = client.get_scheduler_stats()
+    assert reply.status == pb.NHD_STATUS_OK
+    assert reply.failed_schedule_count == 0
+
+
+def test_pod_stats(stack):
+    backend, sched, client = stack
+    reply = client.get_pod_stats()
+    assert reply.status == pb.NHD_STATUS_OK
+    assert len(reply.info) == 1
+    info = reply.info[0]
+    assert info.name == "triad-0"
+    assert info.node == "node0"
+    assert info.hugepages == 4
+    assert len(info.gpus) == 1
+    assert all(c >= 0 for c in info.proc_cores)
+    assert any("nhd_config" in k for k in info.annotations)
+
+
+def test_detailed_node_stats(stack):
+    _, _, client = stack
+    reply = client.get_detailed_node_stats("node0")
+    assert reply.status == pb.NHD_STATUS_OK
+    assert reply.name == "node0"
+    assert len(reply.podinfo) == 1
+    empty = client.get_detailed_node_stats("node1")
+    assert empty.status == pb.NHD_STATUS_OK
+    assert len(empty.podinfo) == 0

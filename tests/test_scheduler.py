@@ -1,0 +1,316 @@
+"""End-to-end scheduler tests against the fake cluster backend.
+
+Covers the reference's full lifecycle (NHDScheduler.py + TriadController.py):
+pending pod → parse → match → annotate → bind; deletion → release; restart
+replay; cordon/maintenance/group events; TriadSet reconciliation; bind
+failure unwind.
+"""
+
+import queue
+
+import pytest
+
+from nhd_tpu.config import libconfig
+from nhd_tpu.k8s.fake import FakeClusterBackend
+from nhd_tpu.k8s.interface import CFG_ANNOTATION, NAD_ANNOTATION
+from nhd_tpu.scheduler.controller import Controller
+from nhd_tpu.scheduler.core import PodStatus, RpcMsgType, Scheduler
+from nhd_tpu.scheduler.events import WatchQueue
+from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+
+
+def make_backend(n_nodes=2, spec=None) -> FakeClusterBackend:
+    backend = FakeClusterBackend()
+    spec = spec or SynthNodeSpec()
+    for i in range(n_nodes):
+        s = SynthNodeSpec(**{**spec.__dict__, "name": f"node{i}"})
+        backend.add_node(s.name, make_node_labels(s), hugepages_gb=s.hugepages_gb)
+    return backend
+
+
+def make_scheduler(backend) -> Scheduler:
+    sched = Scheduler(backend, WatchQueue(), queue.Queue(), respect_busy=False)
+    sched.build_initial_node_list()
+    sched.load_deployed_configs()
+    return sched
+
+
+def pod_cfg(**kw):
+    kw.setdefault("gpus_per_group", 1)
+    kw.setdefault("cpu_workers", 2)
+    kw.setdefault("hugepages_gb", 4)
+    return make_triad_config(**kw)
+
+
+def test_schedule_pending_pod_end_to_end():
+    backend = make_backend()
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+
+    pod = backend.pods[("default", "triad-0")]
+    assert pod.node == "node0"
+    assert pod.phase == "Running"
+    # solved config annotated and parseable, placeholders replaced
+    solved = pod.annotations[CFG_ANNOTATION]
+    cfg = libconfig.loads(solved)
+    assert all(c >= 0 for c in cfg.mods[0].dp[0].rx_cores)
+    # NAD annotation names a host interface
+    assert "eth" in pod.annotations[NAD_ANNOTATION]
+    # audit trail events in reference order
+    reasons = [e.reason for e in backend.events]
+    assert reasons == [
+        "StartedScheduling", "Scheduling", "PodCfgSuccess", "Scheduled"
+    ]
+    # node mirror claimed resources
+    node = sched.nodes["node0"]
+    assert node.total_pods() == 1
+    assert node.free_gpu_count() == node.total_gpus() - 1
+    assert node.mem.free_hugepages_gb == node.mem.ttl_hugepages_gb - 4
+
+
+def test_gang_batch_via_check_pending():
+    backend = make_backend(n_nodes=4)
+    for i in range(8):
+        backend.create_pod(f"triad-{i}", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+    placed = [p.node for p in backend.pods.values()]
+    assert all(placed)
+    # spread across the 4 nodes (2 each: GPU-capacity per node is 4, but
+    # rounds fan identical pods over distinct nodes)
+    assert len(set(placed)) == 4
+
+
+def test_delete_releases_resources():
+    backend = make_backend()
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+    node = sched.nodes["node0"]
+    free_before = node.free_gpu_count()
+
+    # drain watch events (create) then delete the pod
+    list(backend.poll_watch_events())
+    backend.delete_pod("triad-0")
+    ctrl = Controller(backend, sched.nqueue)
+    ctrl.run_once(now=100.0)
+    sched.run_once()  # consumes the delete event
+
+    assert node.total_pods() == 0
+    assert node.free_gpu_count() == free_before + 1
+    assert node.mem.free_hugepages_gb == node.mem.ttl_hugepages_gb
+
+
+def test_restart_replay():
+    """A new scheduler instance rebuilds claims from pod annotations
+    (reference: NHDScheduler.py:161-172, README.md:85-87)."""
+    backend = make_backend()
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    sched1 = make_scheduler(backend)
+    sched1.check_pending_pods()
+    state1 = {
+        name: (sum(n.free_cpu_cores_per_numa()), n.free_gpu_count(),
+               n.mem.free_hugepages_gb)
+        for name, n in sched1.nodes.items()
+    }
+
+    sched2 = make_scheduler(backend)  # fresh instance, same cluster
+    state2 = {
+        name: (sum(n.free_cpu_cores_per_numa()), n.free_gpu_count(),
+               n.mem.free_hugepages_gb)
+        for name, n in sched2.nodes.items()
+    }
+    assert state1 == state2
+    assert sched2.nodes["node0"].total_pods() == 1
+
+
+def test_bind_failure_unwinds():
+    backend = make_backend(n_nodes=1)
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    backend.fail_bind_for.add(("default", "triad-0"))
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+
+    pod = backend.pods[("default", "triad-0")]
+    assert pod.node is None
+    node = sched.nodes["node0"]
+    assert node.total_pods() == 0
+    assert node.free_gpu_count() == node.total_gpus()
+    assert node.mem.free_hugepages_gb == node.mem.ttl_hugepages_gb
+    assert sched.pod_state[("default", "triad-0")]["state"] == PodStatus.FAILED
+    assert "FailedScheduling" in [e.reason for e in backend.events]
+
+
+def test_cordon_and_maintenance_events():
+    backend = make_backend()
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+
+    backend.cordon_node("node0", True)
+    ctrl.run_once(now=0.0)
+    sched.run_once()
+    assert not sched.nodes["node0"].active
+
+    backend.cordon_node("node0", False)
+    ctrl.run_once(now=0.1)
+    sched.run_once()
+    assert sched.nodes["node0"].active
+
+    backend.update_node_labels(
+        "node0", {"sigproc.viasat.io/maintenance": "draining"}
+    )
+    ctrl.run_once(now=0.2)
+    sched.run_once()
+    assert sched.nodes["node0"].maintenance
+
+    backend.update_node_labels(
+        "node0", {"sigproc.viasat.io/maintenance": "not_scheduled"}
+    )
+    ctrl.run_once(now=0.3)
+    sched.run_once()
+    assert not sched.nodes["node0"].maintenance
+
+
+def test_group_update_event():
+    backend = make_backend()
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+    backend.update_node_labels("node0", {"NHD_GROUP": "edge.lab"})
+    ctrl.run_once(now=0.0)
+    sched.run_once()
+    assert sched.nodes["node0"].groups == ["edge", "lab"]
+
+
+def test_triadset_reconciliation():
+    backend = make_backend(n_nodes=4)
+    backend.add_triadset("ts1", "default", replicas=3,
+                         service_name="triad", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+
+    ctrl.run_once(now=10.0)  # creates triad-0..2
+    assert {p.name for p in backend.pods.values()} == {
+        "triad-0", "triad-1", "triad-2"
+    }
+    # pod-create watch events flow to the scheduler and get scheduled
+    ctrl.run_once(now=20.0)
+    for _ in range(3):
+        sched.run_once()
+    assert all(p.node for p in backend.pods.values())
+
+    # killing one pod gets it recreated on the next timer pass
+    backend.delete_pod("triad-1")
+    ctrl.run_once(now=30.0)
+    assert ("default", "triad-1") in backend.pods
+
+
+def test_duplicate_create_event_ignored():
+    backend = make_backend()
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+    ctrl.run_once(now=0.0)
+    sched.run_once()          # schedules from the create event
+    pod = backend.pods[("default", "triad-0")]
+    assert pod.node is not None
+    node = sched.nodes[pod.node]
+    gpu_free = node.free_gpu_count()
+
+    # stale duplicate create with the same uid must be a no-op
+    from nhd_tpu.scheduler.events import WatchItem, WatchType
+
+    sched.nqueue.put(WatchItem(
+        WatchType.TRIAD_POD_CREATE,
+        pod={"ns": "default", "name": "triad-0", "uid": pod.uid},
+    ))
+    sched.run_once()
+    assert node.free_gpu_count() == gpu_free
+    assert node.total_pods() == 1
+
+
+def test_rpc_stats_roundtrip():
+    backend = make_backend()
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+
+    reply: queue.Queue = queue.Queue()
+    sched._parse_rpc_req(RpcMsgType.NODE_INFO, reply)
+    stats = reply.get_nowait()
+    assert len(stats) == 2
+    assert stats[0]["totalpods"] + stats[1]["totalpods"] == 1
+
+    sched._parse_rpc_req(RpcMsgType.POD_INFO, reply)
+    pods = reply.get_nowait()
+    assert len(pods) == 1
+    assert pods[0]["podname"] == "triad-0"
+    assert pods[0]["gpus"] and all(g >= 0 for g in pods[0]["gpus"])
+
+    sched._parse_rpc_req(RpcMsgType.SCHEDULER_INFO, reply)
+    assert reply.get_nowait() == 0
+
+
+def test_unschedulable_pod_failed_count():
+    backend = make_backend(n_nodes=1, spec=SynthNodeSpec(gpus_per_numa=0))
+    backend.create_pod("triad-0", cfg_text=pod_cfg())  # wants a GPU
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+    assert backend.pods[("default", "triad-0")].node is None
+    assert sched.failed_schedule_count == 1
+    assert sched.pod_state[("default", "triad-0")]["state"] == PodStatus.FAILED
+
+
+def test_foreign_scheduler_pods_ignored():
+    """Pods naming another scheduler never reach the queue
+    (reference: TriadController.py 'when' clauses)."""
+    backend = make_backend()
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+    backend.create_pod("other-0", cfg_text=pod_cfg(),
+                       scheduler_name="default-scheduler")
+    ctrl.run_once(now=0.0)
+    assert sched.nqueue.empty()
+    # and the periodic scan doesn't pick it up either
+    sched.check_pending_pods()
+    assert backend.pods[("default", "other-0")].node is None
+
+
+def test_delete_release_is_targeted_not_full_rescan():
+    """Deletes release via the event-carried config, not reset_resources."""
+    backend = make_backend()
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    backend.create_pod("triad-1", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+    resets = []
+    sched.reset_resources = lambda: resets.append(1)  # sentinel
+
+    list(backend.poll_watch_events())
+    backend.delete_pod("triad-0")
+    ctrl = Controller(backend, sched.nqueue)
+    ctrl.run_once(now=100.0)
+    sched.run_once()
+
+    assert not resets, "delete fell back to a full cluster rescan"
+    nodes_with_pods = [n for n in sched.nodes.values() if n.total_pods()]
+    assert sum(n.total_pods() for n in nodes_with_pods) == 1
+
+
+def test_uncordon_requires_scheduler_taint():
+    backend = make_backend()
+    # a foreign node without the scheduler taint
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels
+
+    spec = SynthNodeSpec(name="foreign")
+    n = backend.add_node("foreign", make_node_labels(spec))
+    n.taints = []  # not NHD-managed
+    sched = make_scheduler(backend)
+    assert not sched.nodes["foreign"].active
+    ctrl = Controller(backend, sched.nqueue)
+    backend.cordon_node("foreign", True)
+    backend.cordon_node("foreign", False)
+    ctrl.run_once(now=0.0)
+    while not sched.nqueue.empty():
+        sched.run_once()
+    assert not sched.nodes["foreign"].active
