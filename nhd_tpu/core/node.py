@@ -98,9 +98,14 @@ class NodeNic:
 
 @dataclass
 class NodeMemory:
-    """Hugepage accounting (reference: Node.py:62-71)."""
+    """Hugepage accounting (reference: Node.py:62-71).
+
+    ``alloc_hugepages_gb`` keeps the K8s-allocatable figure so resets can
+    restore free space correctly (the reference resets to raw capacity,
+    Node.py:159, silently granting back the OS reserve)."""
 
     ttl_hugepages_gb: int = 0
+    alloc_hugepages_gb: int = 0
     free_hugepages_gb: int = 0
     res_hugepages_gb: int = 0
 
@@ -277,6 +282,7 @@ class HostNode:
         """Capacity from the K8s allocatable numbers, minus the node's
         reserved amount (reference: Node.py:489-493)."""
         self.mem.ttl_hugepages_gb = alloc
+        self.mem.alloc_hugepages_gb = free
         self.mem.free_hugepages_gb = free - self.mem.res_hugepages_gb
         return True
 
@@ -436,28 +442,50 @@ class HostNode:
         for n in self.nics:
             n.pods_used = 0
             n.speed_used = [0.0, 0.0]
-        self.mem.free_hugepages_gb = self.mem.ttl_hugepages_gb
+        # allocatable minus reserve, NOT raw capacity: the reference resets
+        # to ttl (Node.py:159), silently re-granting the OS reserve on every
+        # drift repair
+        self.mem.free_hugepages_gb = (
+            self.mem.alloc_hugepages_gb - self.mem.res_hugepages_gb
+        )
         self.pod_info.clear()
+
+    def _topology_core_ids(self, top: PodTopology):
+        """Every physical core id a solved topology names."""
+        ids = []
+        for pg in top.proc_groups:
+            ids.extend(c.core for c in pg.misc_cores)
+            ids.extend(c.core for c in pg.proc_cores)
+            for gpu in pg.gpus:
+                ids.extend(c.core for c in gpu.cpu_cores)
+        ids.extend(c.core for c in top.misc_cores)
+        return ids
 
     def claim_from_topology(self, top: PodTopology) -> bool:
         """Mark every resource named in a (solved) topology as used — the
-        restart-replay path (reference: Node.py:530-585)."""
+        restart-replay path (reference: Node.py:530-585).
+
+        Validate-then-apply: a stale annotation naming out-of-range or
+        negative core ids (node shrunk/relabeled between restarts) rejects
+        the whole claim with no partial mutation, instead of crashing the
+        scheduler thread or leaking half-claimed cores.
+        """
+        core_ids = self._topology_core_ids(top)
+        for cid in core_ids:
+            if not 0 <= cid < len(self.cores):
+                self.logger.error(f"node {self.name}: core {cid} out of range")
+                return False
+        for cid in core_ids:
+            self.cores[cid].used = True
         for pg in top.proc_groups:
-            for core in pg.misc_cores + pg.proc_cores:
-                if core.core >= len(self.cores):
-                    self.logger.error(
-                        f"node {self.name}: core {core.core} out of range"
-                    )
-                    return False
-                self.cores[core.core].used = True
             for gpu in pg.gpus:
                 dev = self.gpu_by_device_id(gpu.device_id)
                 if dev is not None:
                     dev.used = True
-                for core in gpu.cpu_cores:
-                    self.cores[core.core].used = True
-        for core in top.misc_cores:
-            self.cores[core.core].used = True
+        # bandwidth accrues per rx/tx pair; pods_used once per distinct NIC
+        # per pod — matching the live claim path (claim_nic_pods), where the
+        # reference is asymmetric and can drive pods_used negative
+        claimed_macs = set()
         for pair in top.nic_pairs:
             nic = self.nic_by_mac(pair.mac)
             if nic is None:
@@ -465,7 +493,9 @@ class HostNode:
                 continue
             nic.speed_used[0] += pair.rx_core.nic_speed
             nic.speed_used[1] += pair.tx_core.nic_speed
-            nic.pods_used += 1
+            if pair.mac not in claimed_macs:
+                claimed_macs.add(pair.mac)
+                nic.pods_used += 1
         if top.hugepages_gb > 0:
             self.mem.free_hugepages_gb -= top.hugepages_gb
         return True
@@ -483,6 +513,7 @@ class HostNode:
                     self.cores[core.core].used = False
         for core in top.misc_cores:
             self.cores[core.core].used = False
+        released_macs = set()
         for pair in top.nic_pairs:
             nic = self.nic_by_mac(pair.mac)
             if nic is None:
@@ -490,7 +521,13 @@ class HostNode:
                 continue
             nic.speed_used[0] -= pair.rx_core.nic_speed
             nic.speed_used[1] -= pair.tx_core.nic_speed
-            nic.pods_used -= 1
+            # one pods_used per distinct NIC, mirroring the claim side —
+            # the reference decrements per pair (Node.py:621-631), which
+            # underflows for multi-pair-per-NIC pods and later masks an
+            # in-use NIC as free
+            if pair.mac not in released_macs:
+                released_macs.add(pair.mac)
+                nic.pods_used -= 1
         if top.hugepages_gb > 0:
             self.mem.free_hugepages_gb += top.hugepages_gb
 

@@ -148,11 +148,16 @@ class FakeClusterBackend(ClusterBackend):
                            taints=list(node.taints), old_taints=list(node.taints))
             )
 
-    def update_node_labels(self, name: str, new_labels: Dict[str, str]) -> None:
+    def update_node_labels(self, name: str, new_labels: Dict[str, Optional[str]]) -> None:
+        """Merge label changes; a value of None removes the label."""
         with self._lock:
             node = self.nodes[name]
             old = dict(node.labels)
-            node.labels.update(new_labels)
+            for k, v in new_labels.items():
+                if v is None:
+                    node.labels.pop(k, None)
+                else:
+                    node.labels[k] = v
             self._watch.append(
                 WatchEvent(kind="node_update", name=name,
                            labels=dict(node.labels), old_labels=old,

@@ -23,13 +23,14 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 # Reference annotation/label/taint vocabulary (K8SMgr.py:139,160,182,496;
 # Node.py:108; TriadController.py:19-23)
+from nhd_tpu.core.node import MAINTENANCE_LABEL  # single source of truth
+
 DOMAIN = "sigproc.viasat.io"
 CFG_ANNOTATION = f"{DOMAIN}/nhd_config"
 CFG_TYPE_ANNOTATION = f"{DOMAIN}/cfg_type"
 GROUPS_ANNOTATION = f"{DOMAIN}/nhd_groups"
 GPU_MAP_ANNOTATION_PREFIX = f"{DOMAIN}/nhd_gpu_devices"
 SCHEDULER_TAINT = f"{DOMAIN}/nhd_scheduler"
-MAINTENANCE_LABEL = f"{DOMAIN}/maintenance"
 NAD_ANNOTATION = "k8s.v1.cni.cncf.io/networks"
 
 

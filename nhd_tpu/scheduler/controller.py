@@ -74,7 +74,13 @@ class Controller(threading.Thread):
 
         old_group = ev.old_labels.get(NHD_GROUP_LABEL)
         new_group = ev.labels.get(NHD_GROUP_LABEL)
-        if new_group is not None and new_group != old_group:
+        if new_group is None and old_group is not None:
+            # label removed: back to the default pool (reference sends
+            # 'default' explicitly on removal, TriadController.py:65-74)
+            self.queue.put(
+                WatchItem(WatchType.GROUP_UPDATE, node=ev.name, groups="default")
+            )
+        elif new_group is not None and new_group != old_group:
             self.queue.put(
                 WatchItem(WatchType.GROUP_UPDATE, node=ev.name, groups=new_group)
             )

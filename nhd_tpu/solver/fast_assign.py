@@ -333,53 +333,6 @@ class FastCluster:
 
     # ------------------------------------------------------------------
 
-    def refresh_row(self, arrays, n: int) -> None:
-        """Re-project node n's solver-visible state from the packed arrays
-        (replaces encode.refresh_node_row inside a fast batch)."""
-        P = int(self.phys[n])
-        if self.smt[n]:
-            free_pair = ~self.core_used[n, :P] & ~self.core_used[n, P : 2 * P]
-        else:
-            free_pair = ~self.core_used[n, :P]
-        socket = self.core_socket[n, :P]
-        arrays.cpu_free[n] = 0
-        arrays.gpu_free[n] = 0
-        for u in range(arrays.U):
-            arrays.cpu_free[n, u] = int(np.sum(free_pair & (socket == u)))
-        ng = int(self.n_gpus[n])
-        for u in range(arrays.U):
-            arrays.gpu_free[n, u] = int(
-                np.sum(~self.gpu_used[n, :ng] & (self.gpu_numa[n, :ng] == u))
-            )
-        arrays.hp_free[n] = self.hp_free[n]
-
-        # NIC headroom: sharing-disabled semantics (Node.py:283-296)
-        exists = self.nic_flat[n] >= 0
-        free_rx = np.where(
-            self.nic_pods[n] > 0, 0.0, self.nic_cap[n] - self.nic_rx_used[n]
-        )
-        free_tx = np.where(
-            self.nic_pods[n] > 0, 0.0, self.nic_cap[n] - self.nic_tx_used[n]
-        )
-        from nhd_tpu.core.node import ENABLE_NIC_SHARING
-
-        if ENABLE_NIC_SHARING:
-            free_rx = self.nic_cap[n] - self.nic_rx_used[n]
-            free_tx = self.nic_cap[n] - self.nic_tx_used[n]
-        arrays.nic_free[n, :, :, 0] = np.where(exists, free_rx, -1.0)
-        arrays.nic_free[n, :, :, 1] = np.where(exists, free_tx, -1.0)
-
-        # free GPUs per dense switch id must match encode_cluster's mapping
-        node = self.node_objs[n]
-        switches = sorted(
-            {g.pciesw for g in node.gpus} | {x.pciesw for x in node.nics}
-        )
-        sw_id = {sw: j for j, sw in enumerate(switches)}
-        arrays.gpu_free_sw[n] = 0
-        for j in range(ng):
-            if not self.gpu_used[n, j]:
-                arrays.gpu_free_sw[n, sw_id[int(self.gpu_sw[n, j])]] += 1
-
     def sync_to_nodes(self) -> None:
         """Write allocation changes back to the HostNode mirror."""
         for n in self._touched:

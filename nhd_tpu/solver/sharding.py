@@ -1,0 +1,98 @@
+"""Multi-chip sharding of the batched solve.
+
+The feasibility tensor [T types, N nodes, C combos, A picks] is
+embarrassingly parallel along the *node* axis — the natural mesh layout for
+a scheduler (SURVEY §2: "data parallelism over pods and nodes"). Node-state
+arrays shard along axis 0 of a 1-D ``nodes`` mesh; pod-type arrays are
+replicated (they are tiny after gang dedup). Each device evaluates its node
+shard; the per-(type, node) outputs come back sharded the same way, and the
+final argmax-over-nodes selection is a cheap reduction XLA lowers onto the
+mesh (an all-gather of [T, N_shard] rows over ICI).
+
+Scaling shape for the 100k federation config (BASELINE config 5): shard
+nodes over the mesh, stream pod-type chunks through (solver/streaming.py).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nhd_tpu.solver.combos import get_tables
+from nhd_tpu.solver.kernel import SolveOut, _pad_pow2, _solve
+
+
+def make_mesh(devices=None, axis: str = "nodes") -> Mesh:
+    """A 1-D device mesh over the node axis."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+# sharding layout per solver argument: True → shard along the node axis
+_NODE_ARGS = [True] * 14 + [False] * 9
+
+
+@lru_cache(maxsize=None)
+def get_sharded_solver(n_groups: int, n_numa: int, max_nic: int, mesh: Mesh):
+    """A pjit-compiled solver with node-sharded inputs/outputs on *mesh*."""
+    tables = get_tables(n_groups, n_numa, max_nic)
+    node_spec = NamedSharding(mesh, P("nodes"))
+    repl_spec = NamedSharding(mesh, P())
+    in_shardings = tuple(
+        node_spec if is_node else repl_spec for is_node in _NODE_ARGS
+    )
+    # outputs are [T, N]: sharded along the node axis (dim 1)
+    out_sharding = NamedSharding(mesh, P(None, "nodes"))
+
+    def fn(*args):
+        return _solve(tables, *args)
+
+    return jax.jit(
+        fn,
+        in_shardings=in_shardings,
+        out_shardings=SolveOut(*([out_sharding] * 6)),
+    )
+
+
+def solve_bucket_sharded(cluster, pods, mesh: Optional[Mesh] = None) -> SolveOut:
+    """Sharded counterpart of kernel.solve_bucket: same inputs/outputs,
+    node axis split across the mesh devices."""
+    mesh = mesh or make_mesh()
+    n_dev = mesh.devices.size
+    T, N = pods.n_types, cluster.n_nodes
+
+    # pad N to a multiple of the mesh size (and a power-of-two bucket so
+    # re-solves reuse the jit cache); padded rows are inactive
+    Np = max(_pad_pow2(N), n_dev)
+    if Np % n_dev:
+        Np += n_dev - (Np % n_dev)
+    Tp = _pad_pow2(T)
+
+    def pad(a, size):
+        if a.shape[0] == size:
+            return a
+        return np.concatenate(
+            [a, np.zeros((size - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
+        )
+
+    node_args = [
+        pad(cluster.numa_nodes, Np), pad(cluster.smt, Np), pad(cluster.active, Np),
+        pad(cluster.maintenance, Np), pad(cluster.busy, Np), pad(cluster.gpuless, Np),
+        pad(cluster.group_mask, Np), pad(cluster.hp_free, Np),
+        pad(cluster.cpu_free, Np), pad(cluster.gpu_free, Np),
+        pad(cluster.nic_count, Np), pad(cluster.nic_free, Np),
+        pad(cluster.nic_sw, Np), pad(cluster.gpu_free_sw, Np),
+    ]
+    pod_args = [
+        pad(pods.cpu_dem_smt, Tp), pad(pods.cpu_dem_raw, Tp), pad(pods.gpu_dem, Tp),
+        pad(pods.rx, Tp), pad(pods.tx, Tp), pad(pods.hp, Tp),
+        pad(pods.needs_gpu, Tp), pad(pods.map_pci, Tp), pad(pods.group_mask, Tp),
+    ]
+
+    solver = get_sharded_solver(pods.G, cluster.U, cluster.K, mesh)
+    out = solver(*node_args, *pod_args)
+    return SolveOut(*(np.asarray(x)[:T, :N] for x in out))

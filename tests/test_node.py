@@ -125,3 +125,55 @@ def test_free_cpu_batch_no_duplicates_on_overask():
     # SMT-averse request never receives both siblings of one physical core
     assert len(got2) == 2
     assert all(node.cores[c].sibling not in got2 for c in got2)
+
+
+def test_nic_pods_used_symmetric_multi_pair():
+    """Claim/release of a pod with two rx/tx pairs on one NIC keeps
+    pods_used balanced (deviation from reference Node.py:569-631, which
+    underflows)."""
+    from nhd_tpu.sim import make_triad_config
+    from nhd_tpu.config.triad import TriadCfgParser
+
+    node = default_node()
+    text = make_triad_config(nic_pairs_per_group=2, cpu_workers=0,
+                             gpus_per_group=0)
+    top = TriadCfgParser(text).to_topology(False)
+    mac = node.nics[0].mac
+    for pair in top.nic_pairs:
+        pair.mac = mac
+    for pg in top.proc_groups:
+        for i, c in enumerate(pg.proc_cores):
+            c.core = 2 + i
+        for i, c in enumerate(pg.misc_cores):
+            c.core = 6 + i
+    for i, c in enumerate(top.misc_cores):
+        c.core = 7 + i
+
+    assert node.claim_from_topology(top)
+    assert node.nics[0].pods_used == 1
+    node.release_from_topology(top)
+    assert node.nics[0].pods_used == 0
+
+
+def test_claim_from_topology_rejects_bad_cores_atomically():
+    from nhd_tpu.core.topology import Core, PodTopology
+
+    node = default_node(phys_cores=8, sockets=2, smt=False, reserved_cores=0)
+    top = PodTopology()
+    top.misc_cores = [Core("a", core=2), Core("b", core=999)]
+    before = [c.used for c in node.cores]
+    assert not node.claim_from_topology(top)
+    assert [c.used for c in node.cores] == before  # no partial claim
+    top2 = PodTopology()
+    top2.misc_cores = [Core("a", core=-1)]
+    assert not node.claim_from_topology(top2)  # negative ids rejected
+
+
+def test_reset_preserves_hugepage_reserve():
+    node = make_node(SynthNodeSpec(hugepages_gb=64, reserved_hugepages_gb=4),
+                     hugepage_free=60)
+    # capacity 64, allocatable 60, reserve 4 -> free 56
+    assert node.mem.free_hugepages_gb == 56
+    node.mem.free_hugepages_gb -= 10
+    node.reset_resources()
+    assert node.mem.free_hugepages_gb == 56  # not raw capacity 64

@@ -314,3 +314,17 @@ def test_uncordon_requires_scheduler_taint():
     while not sched.nqueue.empty():
         sched.run_once()
     assert not sched.nodes["foreign"].active
+
+
+def test_group_label_removal_resets_to_default():
+    backend = make_backend()
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+    backend.update_node_labels("node0", {"NHD_GROUP": "edge"})
+    ctrl.run_once(now=0.0)
+    sched.run_once()
+    assert sched.nodes["node0"].groups == ["edge"]
+    backend.update_node_labels("node0", {"NHD_GROUP": None})
+    ctrl.run_once(now=0.1)
+    sched.run_once()
+    assert sched.nodes["node0"].groups == ["default"]

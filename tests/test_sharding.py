@@ -1,0 +1,46 @@
+"""Sharded solve must agree with the single-device solve on an 8-device
+virtual CPU mesh (conftest forces xla_force_host_platform_device_count=8)."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from nhd_tpu.solver.encode import encode_cluster, encode_pods
+from nhd_tpu.solver.kernel import solve_bucket
+from nhd_tpu.solver.sharding import make_mesh, solve_bucket_sharded
+from tests.test_jax_matcher import random_cluster, random_request
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sharded_matches_single_device(seed):
+    rng = random.Random(seed)
+    nodes = random_cluster(rng, rng.randint(3, 12))
+    reqs = [random_request(rng) for _ in range(8)]
+    cluster = encode_cluster(nodes, now=1010.0)
+    mesh = make_mesh()
+    for G, pods in encode_pods(reqs, cluster.interner).items():
+        plain = solve_bucket(cluster, pods)
+        sharded = solve_bucket_sharded(cluster, pods, mesh)
+        np.testing.assert_array_equal(np.asarray(plain.cand), sharded.cand)
+        np.testing.assert_array_equal(np.asarray(plain.pref), sharded.pref)
+        np.testing.assert_array_equal(np.asarray(plain.best_c), sharded.best_c)
+        np.testing.assert_array_equal(np.asarray(plain.best_m), sharded.best_m)
+        np.testing.assert_array_equal(np.asarray(plain.best_a), sharded.best_a)
+
+
+def test_sharded_solve_with_node_count_not_divisible():
+    """N not divisible by the mesh size pads cleanly."""
+    rng = random.Random(99)
+    nodes = random_cluster(rng, 13)
+    reqs = [random_request(rng) for _ in range(3)]
+    cluster = encode_cluster(nodes, now=1010.0)
+    for G, pods in encode_pods(reqs, cluster.interner).items():
+        plain = solve_bucket(cluster, pods)
+        sharded = solve_bucket_sharded(cluster, pods)
+        np.testing.assert_array_equal(np.asarray(plain.cand), sharded.cand)
