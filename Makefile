@@ -2,7 +2,12 @@
 
 PROTO_DIR := nhd_tpu/rpc
 
-.PHONY: test proto bench wheel clean
+.PHONY: test proto bench wheel clean native
+
+# C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
+# auto-builds it on first import too)
+native:
+	g++ -O2 -shared -fPIC -o nhd_tpu/native/_libnhd.so native/nhd_assign.cc
 
 test:
 	python -m pytest tests/ -x -q

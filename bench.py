@@ -11,9 +11,13 @@ Prints exactly ONE JSON line to stdout:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 Everything else (per-config detail, platform notes) goes to stderr.
 
-Busy back-off (one GPU pod per node per 30 s, Matcher.py:103-111) is
-disabled on BOTH sides: it is an operational rate limit, not solver work,
-and with it on, neither side can schedule more than one pod per node.
+Environment knobs:
+    NHD_BENCH_PLATFORM=cpu    skip the TPU probe, run on CPU
+    NHD_BENCH_STRETCH=1       also run the 100k × 10k federation config
+
+Busy back-off (one GPU pod per node per 30 s, reference Matcher.py:103-111)
+is disabled on BOTH sides: it is an operational rate limit, not solver
+work, and with it on neither side can schedule more than one pod per node.
 """
 
 from __future__ import annotations
@@ -51,66 +55,25 @@ def _pick_platform() -> str:
     return "cpu"
 
 
-_PLATFORM = _pick_platform()
-if _PLATFORM == "cpu":
+def _init_jax(platform: str):
     import jax
 
-    try:
-        from jax._src import xla_bridge as _xb
+    if platform == "cpu":
+        try:
+            from jax._src import xla_bridge as _xb
 
-        for _name in [k for k in _xb._backend_factories if k != "cpu"]:
-            _xb._backend_factories.pop(_name, None)
-    except Exception:
-        pass
-    jax.config.update("jax_platforms", "cpu")
-else:
-    import jax
-
-jax.config.update("jax_compilation_cache_dir", "/tmp/nhd_tpu_jax_cache")
-
-from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest  # noqa: E402
-from nhd_tpu.core.topology import MapMode, SmtMode  # noqa: E402
-from nhd_tpu.sim import SynthNodeSpec, make_cluster  # noqa: E402
-from nhd_tpu.sim.requests import request_to_topology  # noqa: E402
-from nhd_tpu.solver import BatchItem, BatchScheduler, find_node  # noqa: E402
-
-
-def grp(proc, smt, misc, gpus, rx, tx):
-    return GroupRequest(
-        proc=CpuRequest(proc, smt), misc=CpuRequest(misc, SmtMode.ON),
-        gpus=gpus, nic_rx_gbps=rx, nic_tx_gbps=tx,
-    )
-
-
-def workload_mix(n_pods: int, groups_cycle):
-    """Deterministic mixed gang workload: cycles pod types and node groups."""
-    types = [
-        # GPU pod, one group
-        PodRequest(groups=(grp(4, SmtMode.ON, 1, 1, 10.0, 5.0),),
-                   misc=CpuRequest(1, SmtMode.ON), hugepages_gb=2,
-                   map_mode=MapMode.NUMA),
-        # CPU-only pod
-        PodRequest(groups=(grp(6, SmtMode.ON, 1, 0, 20.0, 10.0),),
-                   misc=CpuRequest(1, SmtMode.ON), hugepages_gb=2,
-                   map_mode=MapMode.NUMA),
-        # two-group GPU pod
-        PodRequest(groups=(grp(4, SmtMode.ON, 0, 1, 10.0, 5.0),
-                           grp(2, SmtMode.ON, 0, 0, 5.0, 2.0)),
-                   misc=CpuRequest(1, SmtMode.ON), hugepages_gb=4,
-                   map_mode=MapMode.NUMA),
-    ]
-    out = []
-    for i in range(n_pods):
-        base = types[i % len(types)]
-        out.append(PodRequest(
-            groups=base.groups, misc=base.misc, hugepages_gb=base.hugepages_gb,
-            map_mode=base.map_mode,
-            node_groups=frozenset({groups_cycle[i % len(groups_cycle)]}),
-        ))
-    return out
+            for name in [k for k in _xb._backend_factories if k != "cpu"]:
+                _xb._backend_factories.pop(name, None)
+        except Exception:
+            pass
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", "/tmp/nhd_tpu_jax_cache")
+    return jax
 
 
 def run_batch(nodes, reqs, *, warm: bool = True):
+    from nhd_tpu.solver import BatchItem, BatchScheduler
+
     sched = BatchScheduler(respect_busy=False, register_pods=False)
     items = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
     if warm:
@@ -125,10 +88,12 @@ def run_batch(nodes, reqs, *, warm: bool = True):
 
 
 def run_serial_baseline(nodes, reqs, sample: int):
-    """Time the serial oracle loop (match + physical assignment per pod) on
-    a workload sample; returns seconds-per-pod."""
+    """Seconds-per-pod of the serial oracle loop (match + physical
+    assignment), measured on a sample of the same workload."""
+    from nhd_tpu.sim.requests import request_to_topology
+    from nhd_tpu.solver import find_node
+
     t0 = time.perf_counter()
-    done = 0
     for r in reqs[:sample]:
         m = find_node(nodes, r, now=0.0, respect_busy=False)
         if m is None:
@@ -138,29 +103,18 @@ def run_serial_baseline(nodes, reqs, sample: int):
             nodes[m.node].assign_physical_ids(m.mapping, top)
         except Exception:
             continue
-        done += 1
-    wall = time.perf_counter() - t0
-    return wall / max(sample, 1), done
-
-
-def cluster_for(n_nodes, groups):
-    return make_cluster(
-        n_nodes,
-        SynthNodeSpec(phys_cores=24, gpus_per_numa=2, nics_per_numa=2,
-                      hugepages_gb=256),
-        groups=groups,
-    )
+    return (time.perf_counter() - t0) / max(sample, 1)
 
 
 def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40):
+    from nhd_tpu.sim.workloads import bench_cluster, workload_mix
+
     reqs = workload_mix(n_pods, groups)
-    batch_nodes = cluster_for(n_nodes, groups)
-    wall, placed, stats = run_batch(batch_nodes, reqs)
+    wall, placed, stats = run_batch(bench_cluster(n_nodes, groups), reqs)
 
-    serial_nodes = cluster_for(n_nodes, groups)
-    per_pod, _ = run_serial_baseline(serial_nodes, reqs, baseline_sample)
+    per_pod = run_serial_baseline(bench_cluster(n_nodes, groups), reqs,
+                                  baseline_sample)
     baseline_wall = per_pod * n_pods
-
     speedup = baseline_wall / wall if wall > 0 else 0.0
     _log(
         f"bench[{name}]: {n_pods} pods x {n_nodes} nodes -> "
@@ -174,17 +128,22 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40):
 
 
 def main() -> None:
-    _log(f"bench platform: {jax.devices()[0].platform} ({len(jax.devices())} device(s))")
+    platform = _pick_platform()
+    jax = _init_jax(platform)
+    _log(f"bench platform: {jax.devices()[0].platform} "
+         f"({len(jax.devices())} device(s))")
 
-    # smaller BASELINE configs (detail only)
     bench_config("cfg1:100x32", 100, 32, ["default"], baseline_sample=30)
     bench_config("cfg2:1kx256", 1000, 256, ["default"], baseline_sample=30)
-
-    # headline: 10k pods x 1k nodes, mixed node groups, gang batches
     result = bench_config(
         "cfg3:10kx1k", 10_000, 1_000, ["default", "edge", "batch"],
         baseline_sample=40,
     )
+    if os.environ.get("NHD_BENCH_STRETCH"):
+        bench_config(
+            "cfg4:100kx10k", 100_000, 10_000,
+            ["default", "edge", "batch", "fed1", "fed2"], baseline_sample=10,
+        )
 
     print(json.dumps({
         "metric": "pods_matched_per_sec_10k_pods_x_1k_nodes",

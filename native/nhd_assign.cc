@@ -1,0 +1,136 @@
+// Native physical-assignment core.
+//
+// The per-winner hot loop of batch scheduling (FastCluster.assign,
+// nhd_tpu/solver/fast_assign.py) spends most of its time in Python/numpy
+// call overhead: ~40 small vector ops per pod. This translation unit does
+// the whole pod assignment — first-fit core batches with SMT-pair
+// semantics, PCIe-switch-preferring GPU picks — in one call over raw
+// pointers into the FastCluster arrays, loaded via ctypes (no pybind11 in
+// this image). Policies are bit-identical to the Python path and pinned by
+// tests/test_native.py; the reference semantics they reproduce are
+// HostNode.free_cpu_batch / free_pci_gpu_for_nic / next_free_gpu
+// (reference Node.py:502-519,648-655,495-500).
+//
+// Build: make native   (g++ -O2 -shared -fPIC)
+
+#include <cstdint>
+
+namespace {
+
+// First-fit core batch on one NUMA node against an overlay row.
+// Mutates `used` for the cores handed out. Returns the number of core ids
+// written to `out`, or -1 on shortfall (overlay untouched on failure).
+int cpu_batch(uint8_t* used, const int8_t* socket, int P, int smt_enabled,
+              int numa, int num, int smt_on_request, int32_t* out) {
+  if (num == 0) return 0;
+  int n_out = 0;
+  if (smt_enabled) {
+    if (smt_on_request) {
+      int pairs = num / 2, odd = num % 2, got = 0;
+      // gather candidates first so a shortfall leaves the overlay untouched
+      for (int c = 0; c < P && got < pairs + odd; ++c) {
+        if (socket[c] == numa && !used[c] && !used[c + P]) {
+          if (got < pairs) {
+            out[n_out++] = c;
+            out[n_out++] = c + P;
+          } else {
+            out[n_out++] = c;  // odd single
+          }
+          ++got;
+        }
+      }
+      if (got < pairs + odd) return -1;
+    } else {
+      int got = 0;
+      for (int c = 0; c < P && got < num; ++c) {
+        if (socket[c] == numa && !used[c] && !used[c + P]) {
+          out[n_out++] = c;
+          ++got;
+        }
+      }
+      if (got < num) return -1;
+    }
+  } else {
+    int got = 0;
+    for (int c = 0; c < P && got < num; ++c) {
+      if (socket[c] == numa && !used[c]) {
+        out[n_out++] = c;
+        ++got;
+      }
+    }
+    if (got < num) return -1;
+  }
+  for (int i = 0; i < n_out; ++i) used[out[i]] = 1;
+  return n_out;
+}
+
+// First free GPU on PCIe switch `sw`; NUMA fallback unless PCI mode.
+int pick_gpu(const uint8_t* gpu_used, const int8_t* gpu_numa,
+             const int64_t* gpu_sw, int n_gpus, int64_t sw, int numa,
+             int pci_mode) {
+  for (int j = 0; j < n_gpus; ++j)
+    if (!gpu_used[j] && gpu_sw[j] == sw) return j;
+  if (pci_mode) return -1;
+  for (int j = 0; j < n_gpus; ++j)
+    if (!gpu_used[j] && gpu_numa[j] == numa) return j;
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Assign one pod on one node. All picks resolve against the overlay rows
+// (`core_used`, `gpu_used`), which the caller copies beforehand and commits
+// afterwards — failure leaves real state untouched by construction.
+//
+// Outputs:
+//   out_cores  — group 0 proc.., group 0 helpers.., group 1 ..., misc..
+//   out_counts — per group: [proc_n, helper_n], then [misc_n]
+//   out_gpus   — chosen GPU row indices, in group order
+// Returns 0, or a negative stage code: -1 proc shortfall, -2 no GPU,
+// -3 helper shortfall, -4 misc shortfall.
+int nhd_assign_pod(
+    uint8_t* core_used, const int8_t* core_socket, int P, int smt_enabled,
+    uint8_t* gpu_used, const int8_t* gpu_numa, const int64_t* gpu_sw,
+    int n_gpus,
+    int n_groups,
+    const int32_t* g_numa,      // [G] group NUMA assignment (mapping)
+    const int64_t* g_nic_sw,    // [G] PCIe switch of the group's NIC (-1 none)
+    const int32_t* g_proc, const int32_t* g_proc_smt,
+    const int32_t* g_helpers, const int32_t* g_helper_smt,
+    const int32_t* g_gpus,
+    int misc_numa, int misc_count, int misc_smt, int pci_mode,
+    int32_t* out_cores, int32_t* out_counts, int32_t* out_gpus) {
+  int cores_at = 0, gpus_at = 0;
+  for (int g = 0; g < n_groups; ++g) {
+    int numa = g_numa[g];
+    int n = cpu_batch(core_used, core_socket, P, smt_enabled, numa, g_proc[g],
+                      g_proc_smt[g], out_cores + cores_at);
+    if (n < 0) return -1;
+    out_counts[2 * g] = n;
+    cores_at += n;
+
+    for (int k = 0; k < g_gpus[g]; ++k) {
+      int j = pick_gpu(gpu_used, gpu_numa, gpu_sw, n_gpus, g_nic_sw[g], numa,
+                       pci_mode);
+      if (j < 0) return -2;
+      gpu_used[j] = 1;
+      out_gpus[gpus_at++] = j;
+    }
+
+    n = cpu_batch(core_used, core_socket, P, smt_enabled, numa, g_helpers[g],
+                  g_helper_smt[g], out_cores + cores_at);
+    if (n < 0) return -3;
+    out_counts[2 * g + 1] = n;
+    cores_at += n;
+  }
+
+  int n = cpu_batch(core_used, core_socket, P, smt_enabled, misc_numa,
+                    misc_count, misc_smt, out_cores + cores_at);
+  if (n < 0) return -4;
+  out_counts[2 * n_groups] = n;
+  return 0;
+}
+
+}  // extern "C"

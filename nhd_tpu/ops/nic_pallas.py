@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for the NIC feasibility predicate.
+
+The NIC check is the solver's deepest lattice — the reference's innermost
+deepcopy-per-combination nest (Matcher.py:242-268) becomes, in tensor form,
+``fit[T, N, C, A] = all_(u,k)( unchosen | (dem ≤ free) )`` reduced to
+``nic_any[T, N, C]`` and the first feasible pick ``first_a[T, N, C]``.
+
+XLA already fuses this well (kernel.py), so the Pallas version is an
+*optional* path (NHD_TPU_PALLAS=1): it streams node blocks through VMEM and
+never materializes the [T, N, C, A] intermediate in HBM, which matters when
+C·A grows (many groups × many NICs). The unrolled u/k loop is static and
+small (≤ U·K ≤ 16 for real topologies).
+
+Correctness is pinned against the jnp formulation in
+tests/test_nic_pallas.py (interpret mode on CPU; compiled on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+BN = 128  # node block per grid step
+
+
+def _kernel(U, K, C, A,
+            free_rx_ref, free_tx_ref, dem_rx_ref, dem_tx_ref,
+            unchosen_ref, valid_ref, pci_ok_ref, map_pci_ref,
+            any_ref, first_ref):
+    CA = C * A
+    fit = jnp.ones((BN, CA), dtype=jnp.bool_)
+    # static unroll over the (numa, nic) slots
+    for uk in range(U * K):
+        dem_rx = dem_rx_ref[0, :, uk]        # [CA]
+        dem_tx = dem_tx_ref[0, :, uk]
+        free_rx = free_rx_ref[:, uk]         # [BN]
+        free_tx = free_tx_ref[:, uk]
+        ok = (dem_rx[None, :] <= free_rx[:, None]) & (
+            dem_tx[None, :] <= free_tx[:, None]
+        )
+        fit = fit & (unchosen_ref[:, uk][None, :] | ok)
+
+    is_pci = map_pci_ref[0] != 0
+    fit = fit & valid_ref[:, :] & (pci_ok_ref[:, :] | ~is_pci)
+
+    fit3 = fit.reshape(BN, C, A)
+    any_ref[0] = jnp.any(fit3, axis=-1)
+    first_ref[0] = jnp.argmax(fit3, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("U", "K", "C", "A", "interpret"))
+def nic_any_first(
+    free_rx,      # [N, U*K] f32 — per-node NIC rx headroom, -1 where absent
+    free_tx,      # [N, U*K] f32
+    dem_rx,       # [T, C*A, U*K] f32 — demand each pick places on each slot
+    dem_tx,       # [T, C*A, U*K] f32
+    unchosen,     # [C*A, U*K] bool — slot not used by this pick (static)
+    valid,        # [N, C*A] bool — chosen ordinals exist on the node
+    pci_ok,       # [N, C*A] bool — PCI-switch GPUs available
+    map_pci,      # [T] int32 — pod type uses PCI map mode
+    *, U: int, K: int, C: int, A: int, interpret: bool = False,
+):
+    """Returns (nic_any[T, N, C] bool, first_a[T, N, C] int32)."""
+    T, N = dem_rx.shape[0], free_rx.shape[0]
+    assert N % BN == 0, f"node axis must be padded to {BN}"
+    grid = (T, N // BN)
+
+    kernel = functools.partial(_kernel, U, K, C, A)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BN, U * K), lambda t, nb: (nb, 0)),   # free_rx
+            pl.BlockSpec((BN, U * K), lambda t, nb: (nb, 0)),   # free_tx
+            pl.BlockSpec((1, C * A, U * K), lambda t, nb: (t, 0, 0)),  # dem_rx
+            pl.BlockSpec((1, C * A, U * K), lambda t, nb: (t, 0, 0)),  # dem_tx
+            pl.BlockSpec((C * A, U * K), lambda t, nb: (0, 0)),  # unchosen
+            pl.BlockSpec((BN, C * A), lambda t, nb: (nb, 0)),   # valid
+            pl.BlockSpec((BN, C * A), lambda t, nb: (nb, 0)),   # pci_ok
+            pl.BlockSpec((1,), lambda t, nb: (t,)),             # map_pci
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BN, C), lambda t, nb: (t, nb, 0)),
+            pl.BlockSpec((1, BN, C), lambda t, nb: (t, nb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, N, C), jnp.bool_),
+            jax.ShapeDtypeStruct((T, N, C), jnp.int32),
+        ],
+        interpret=interpret,
+    )(free_rx, free_tx, dem_rx, dem_tx, unchosen, valid, pci_ok, map_pci)
+
+
+def nic_any_first_reference(
+    free_rx, free_tx, dem_rx, dem_tx, unchosen, valid, pci_ok, map_pci,
+    *, U, K, C, A,
+):
+    """The jnp formulation (matches kernel.py's inline math) for parity."""
+    ok = (dem_rx[:, None] <= free_rx[None, :, None, :]) & (
+        dem_tx[:, None] <= free_tx[None, :, None, :]
+    )  # [T, N, CA, UK]
+    fit = jnp.all(unchosen[None, None] | ok, axis=-1)  # [T, N, CA]
+    fit = fit & valid[None] & (pci_ok[None] | ~(map_pci[:, None, None] != 0))
+    fit3 = fit.reshape(*fit.shape[:2], C, A)
+    return jnp.any(fit3, -1), jnp.argmax(fit3, -1).astype(jnp.int32)

@@ -1,0 +1,56 @@
+"""Canonical benchmark workloads (BASELINE.json configs).
+
+Deterministic pod mixes and cluster shapes shared by bench.py, tests and
+probes — no jax imports, no side effects.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+from nhd_tpu.core.topology import MapMode, SmtMode
+from nhd_tpu.sim.synth import SynthNodeSpec, make_cluster
+
+
+def _grp(proc, smt, misc, gpus, rx, tx):
+    return GroupRequest(
+        proc=CpuRequest(proc, smt), misc=CpuRequest(misc, SmtMode.ON),
+        gpus=gpus, nic_rx_gbps=rx, nic_tx_gbps=tx,
+    )
+
+
+def workload_mix(n_pods: int, groups_cycle: Sequence[str]) -> List[PodRequest]:
+    """Deterministic mixed gang workload cycling three pod types (GPU,
+    CPU-only, two-group GPU) and the given node groups."""
+    types = [
+        PodRequest(groups=(_grp(4, SmtMode.ON, 1, 1, 10.0, 5.0),),
+                   misc=CpuRequest(1, SmtMode.ON), hugepages_gb=2,
+                   map_mode=MapMode.NUMA),
+        PodRequest(groups=(_grp(6, SmtMode.ON, 1, 0, 20.0, 10.0),),
+                   misc=CpuRequest(1, SmtMode.ON), hugepages_gb=2,
+                   map_mode=MapMode.NUMA),
+        PodRequest(groups=(_grp(4, SmtMode.ON, 0, 1, 10.0, 5.0),
+                           _grp(2, SmtMode.ON, 0, 0, 5.0, 2.0)),
+                   misc=CpuRequest(1, SmtMode.ON), hugepages_gb=4,
+                   map_mode=MapMode.NUMA),
+    ]
+    out = []
+    for i in range(n_pods):
+        base = types[i % len(types)]
+        out.append(PodRequest(
+            groups=base.groups, misc=base.misc, hugepages_gb=base.hugepages_gb,
+            map_mode=base.map_mode,
+            node_groups=frozenset({groups_cycle[i % len(groups_cycle)]}),
+        ))
+    return out
+
+
+def bench_cluster(n_nodes: int, groups: Sequence[str]):
+    """The benchmark node shape: 24 phys cores, 4 GPUs, 4 NICs, 256G pages."""
+    return make_cluster(
+        n_nodes,
+        SynthNodeSpec(phys_cores=24, gpus_per_numa=2, nics_per_numa=2,
+                      hugepages_gb=256),
+        groups=list(groups),
+    )

@@ -129,6 +129,29 @@ class FastCluster:
         self._orig_gpu_used = self.gpu_used.copy()
         self._touched: set = set()
 
+        # native assignment core (ctypes; None → pure-numpy path)
+        from nhd_tpu import native as _native
+
+        self._lib = _native.LIB
+        if self._lib is not None:
+            self._req_cache: Dict[PodRequest, tuple] = {}
+            self._out_cores = np.zeros(self.L + 8, np.int32)
+            self._out_counts = np.zeros(64, np.int32)
+            self._out_gpus = np.zeros(max(GM, 1), np.int32)
+            # base addresses + row strides for raw-pointer passing
+            self._addr = {
+                name: (arr.ctypes.data, arr.strides[0])
+                for name, arr in (
+                    ("core_socket", self.core_socket),
+                    ("gpu_numa", self.gpu_numa),
+                    ("gpu_sw", self.gpu_sw),
+                )
+            }
+
+    def _row_addr(self, name: str, n: int) -> int:
+        base, stride = self._addr[name]
+        return base + n * stride
+
     # ------------------------------------------------------------------
 
     def _cpu_batch(
@@ -201,6 +224,12 @@ class FastCluster:
         nic_rx_add: Dict[Tuple[int, int], float] = {}
         nic_tx_add: Dict[Tuple[int, int], float] = {}
 
+        if self._lib is not None:
+            return self._assign_native(
+                n, node, mapping, req, used_row, gpu_row, rec,
+                nic_rx_add, nic_tx_add,
+            )
+
         for gi, g in enumerate(req.groups):
             numa = int(mapping["gpu"][gi])
             u, k = (int(x) for x in mapping["nic"][gi])
@@ -258,10 +287,17 @@ class FastCluster:
         used_row[misc] = True
         rec.misc_cpus = misc
 
+        return self._commit(
+            n, mapping, req, rec, used_row, gpu_row, nic_rx_add, nic_tx_add
+        )
+
+    def _commit(
+        self, n, mapping, req, rec, used_row, gpu_row, nic_rx_add, nic_tx_add
+    ) -> AssignRecord:
+        """Apply a fully-resolved assignment (shared by both pick paths)."""
         if req.hugepages_gb > self.hp_free[n]:
             raise FastAssignError("hugepages exhausted")
 
-        # ---- commit ----
         self.core_used[n] = used_row
         self.gpu_used[n] = gpu_row
         self.hp_free[n] -= req.hugepages_gb
@@ -292,6 +328,97 @@ class FastCluster:
         if self.arrays is not None:
             self._update_arrays(n, mapping, req, rec, claimed_uks)
         return rec
+
+    def _req_arrays(self, req: PodRequest) -> tuple:
+        """Flattened per-type demand arrays for the native call (cached —
+        gang batches share one entry)."""
+        got = self._req_cache.get(req)
+        if got is None:
+            G = req.n_groups
+            got = (
+                np.asarray([g.proc.count for g in req.groups], np.int32),
+                np.asarray([int(g.proc.smt) for g in req.groups], np.int32),
+                np.asarray([g.misc.count for g in req.groups], np.int32),
+                np.asarray([int(g.misc.smt) for g in req.groups], np.int32),
+                np.asarray([g.gpus for g in req.groups], np.int32),
+                np.zeros(G, np.int32),   # scratch: g_numa
+                np.zeros(G, np.int64),   # scratch: g_nic_sw
+            )
+            self._req_cache[req] = got
+        return got
+
+    def _assign_native(
+        self, n, node, mapping, req, used_row, gpu_row, rec,
+        nic_rx_add, nic_tx_add,
+    ) -> AssignRecord:
+        """One C call resolves every core/GPU pick (native/nhd_assign.cc)."""
+        g_proc, g_proc_smt, g_help, g_help_smt, g_gpus, g_numa, g_nic_sw = (
+            self._req_arrays(req)
+        )
+        flats = []
+        nic_flat_row = self.nic_flat[n]
+        nic_sw_row = self.nic_sw[n]
+        for gi, g in enumerate(req.groups):
+            u, k = mapping["nic"][gi]
+            flat = int(nic_flat_row[u, k])
+            if flat < 0 and (g.needs_nic or g.gpus):
+                raise FastAssignError(
+                    f"no NIC at numa {u} idx {k} on {rec.node_name}"
+                )
+            flats.append((u, k, flat))
+            g_numa[gi] = mapping["gpu"][gi]
+            g_nic_sw[gi] = int(nic_sw_row[u, k]) if flat >= 0 else -1
+
+        addr = self._row_addr
+        rc = self._lib.nhd_assign_pod(
+            used_row.ctypes.data, addr("core_socket", n),
+            int(self.phys[n]), int(self.smt[n]),
+            gpu_row.ctypes.data, addr("gpu_numa", n), addr("gpu_sw", n),
+            int(self.n_gpus[n]),
+            req.n_groups,
+            g_numa.ctypes.data, g_nic_sw.ctypes.data,
+            g_proc.ctypes.data, g_proc_smt.ctypes.data,
+            g_help.ctypes.data, g_help_smt.ctypes.data, g_gpus.ctypes.data,
+            int(mapping["cpu"][-1]), req.misc.count, int(req.misc.smt),
+            int(req.map_mode == MapMode.PCI),
+            self._out_cores.ctypes.data, self._out_counts.ctypes.data,
+            self._out_gpus.ctypes.data,
+        )
+        if rc < 0:
+            stage = {-1: "proc cores", -2: "free GPU", -3: "helper cores",
+                     -4: "misc cores"}.get(rc, "resources")
+            raise FastAssignError(f"short of {stage} on {rec.node_name}")
+
+        cores_at = 0
+        gpus_at = 0
+        for gi, g in enumerate(req.groups):
+            u, k, flat = flats[gi]
+            n_proc = int(self._out_counts[2 * gi])
+            n_help = int(self._out_counts[2 * gi + 1])
+            group_cpus = self._out_cores[cores_at : cores_at + n_proc].tolist()
+            cores_at += n_proc
+            helpers = self._out_cores[cores_at : cores_at + n_help].tolist()
+            cores_at += n_help
+            gpu_rows = [int(self._out_gpus[gpus_at + j]) for j in range(g.gpus)]
+            gpus_at += g.gpus
+            gpu_ids = [int(self.gpu_devid[n, j]) for j in gpu_rows]
+            if g.nic_rx_gbps > 0:
+                nic_rx_add[(u, k)] = nic_rx_add.get((u, k), 0.0) + g.nic_rx_gbps
+            if g.nic_tx_gbps > 0:
+                nic_tx_add[(u, k)] = nic_tx_add.get((u, k), 0.0) + g.nic_tx_gbps
+            mac = node.nics[flat].mac if flat >= 0 else ""
+            rec.groups.append(
+                GroupAssignment(
+                    int(g_numa[gi]), group_cpus, helpers, gpu_ids,
+                    (u, k), flat, mac, gpu_rows
+                )
+            )
+        n_misc = int(self._out_counts[2 * req.n_groups])
+        rec.misc_cpus = self._out_cores[cores_at : cores_at + n_misc].tolist()
+
+        return self._commit(
+            n, mapping, req, rec, used_row, gpu_row, nic_rx_add, nic_tx_add
+        )
 
     def _update_arrays(self, n, mapping, req, rec, claimed_uks) -> None:
         """Incrementally maintain the solver-visible ClusterArrays row —

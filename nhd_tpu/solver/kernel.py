@@ -15,6 +15,7 @@ first maximum.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 from typing import NamedTuple
 
@@ -42,6 +43,7 @@ def _solve(
     # pod-type arrays
     cpu_dem_smt, cpu_dem_raw, gpu_dem, rx, tx, hp, needs_gpu, map_pci,
     pod_gmask,
+    *, use_pallas: bool = False,
 ) -> SolveOut:
     C, A, U, K = tables.C, tables.A, tables.U, tables.K
     combo_onehot = jnp.asarray(tables.combo_onehot)          # [C,G,U]
@@ -121,13 +123,32 @@ def _solve(
     sw_need = jnp.einsum("cauk,nuks->ncas", chosen_cnt, sw_onehot)
     pci_ok = jnp.all(sw_need <= gpu_free_sw[:, None, None, :], axis=-1)  # [N,C,A]
 
-    nic_ok = (
-        fit
-        & pick_valid[None]
-        & (pci_ok[None] | ~map_pci[:, None, None, None])
-    )  # [T, N, C, A]
-    nic_any = jnp.any(nic_ok, axis=-1)  # [T, N, C]
-    first_a = jnp.argmax(nic_ok, axis=-1).astype(jnp.int32)  # [T, N, C]
+    if use_pallas:
+        # stream node blocks through VMEM instead of materializing the
+        # [T, N, C, A] lattice (nhd_tpu/ops/nic_pallas.py)
+        from nhd_tpu.ops.nic_pallas import nic_any_first
+
+        T, N = rx.shape[0], nic_free.shape[0]
+        nic_any, first_a = nic_any_first(
+            nic_free[..., 0].reshape(N, U * K),
+            nic_free[..., 1].reshape(N, U * K),
+            dem_rx.reshape(T, C * A, U * K),
+            dem_tx.reshape(T, C * A, U * K),
+            jnp.asarray(tables.chosen_cnt == 0).reshape(C * A, U * K),
+            pick_valid.reshape(N, C * A),
+            pci_ok.reshape(N, C * A),
+            map_pci.astype(jnp.int32),
+            U=U, K=K, C=C, A=A,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        nic_ok = (
+            fit
+            & pick_valid[None]
+            & (pci_ok[None] | ~map_pci[:, None, None, None])
+        )  # [T, N, C, A]
+        nic_any = jnp.any(nic_ok, axis=-1)  # [T, N, C]
+        first_a = jnp.argmax(nic_ok, axis=-1).astype(jnp.int32)  # [T, N, C]
 
     # ---- intersection on the group prefix (reference: Matcher.py:337-390) ----
     feasible = (
@@ -159,6 +180,9 @@ def _solve(
     return SolveOut(cand, pref, best_c, best_m, best_a, n_combos)
 
 
+USE_PALLAS = os.environ.get("NHD_TPU_PALLAS") == "1"
+
+
 @lru_cache(maxsize=None)
 def get_solver(n_groups: int, n_numa: int, max_nic: int):
     """A jitted solver specialized to one bucket shape; tables are closure
@@ -166,7 +190,7 @@ def get_solver(n_groups: int, n_numa: int, max_nic: int):
     tables = get_tables(n_groups, n_numa, max_nic)
 
     def fn(*args):
-        return _solve(tables, *args)
+        return _solve(tables, *args, use_pallas=USE_PALLAS)
 
     return jax.jit(fn)
 
@@ -188,7 +212,8 @@ def solve_bucket(cluster, pods, *, device=None) -> SolveOut:
     must slice off (outputs are [T, N] with the original sizes restored).
     """
     T, N = pods.n_types, cluster.n_nodes
-    Tp, Np = _pad_pow2(T), _pad_pow2(N)
+    # the pallas NIC path streams node blocks of 128 (ops/nic_pallas.py)
+    Tp, Np = _pad_pow2(T), _pad_pow2(N, floor=128 if USE_PALLAS else 8)
 
     def pad_n(a):  # pad axis 0 to Np
         if a.shape[0] == Np:

@@ -1,0 +1,69 @@
+"""Native (C++) assignment core parity vs the pure-numpy path."""
+
+import copy
+import random
+
+import pytest
+
+from nhd_tpu import native
+from nhd_tpu.solver.encode import encode_cluster
+from nhd_tpu.solver.fast_assign import FastCluster
+from nhd_tpu.solver.jax_matcher import JaxMatcher
+from tests.test_fast_assign import state_fingerprint
+from tests.test_jax_matcher import random_cluster, random_request
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native assignment core not built"
+)
+
+
+def run_path(nodes, plans, use_native: bool):
+    arrays = encode_cluster(nodes, now=1010.0)
+    fast = FastCluster(nodes, arrays.U, arrays.K, arrays=arrays)
+    if not use_native:
+        fast._lib = None
+    recs = []
+    for m, req in plans:
+        n = arrays.names.index(m.node)
+        try:
+            recs.append(fast.assign(n, m.mapping, req))
+        except Exception as exc:
+            recs.append(("FAIL", type(exc).__name__))
+    fast.sync_to_nodes()
+    return recs, state_fingerprint(nodes)
+
+
+def rec_essence(r):
+    if isinstance(r, tuple):
+        return r
+    return (
+        r.node_name,
+        [(g.numa, g.group_cpus, g.helper_cpus, g.gpu_devids, g.nic_uk,
+          g.nic_flat, g.gpu_rows) for g in r.groups],
+        r.misc_cpus,
+        r.nic_list,
+    )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_native_matches_numpy(seed):
+    rng = random.Random(1000 + seed)
+    nodes_a = random_cluster(rng, 4)
+    nodes_b = copy.deepcopy(nodes_a)
+    matcher = JaxMatcher()
+    plans = []
+    for _ in range(6):
+        req = random_request(rng)
+        m = matcher.find_node(nodes_a, req, now=1010.0, respect_busy=False)
+        if m is not None:
+            plans.append((m, req))
+    if not plans:
+        pytest.skip("no feasible pods this seed")
+
+    recs_native, fp_native = run_path(nodes_a, plans, use_native=True)
+    recs_numpy, fp_numpy = run_path(nodes_b, plans, use_native=False)
+
+    assert [rec_essence(r) for r in recs_native] == [
+        rec_essence(r) for r in recs_numpy
+    ]
+    assert fp_native == fp_numpy

@@ -1,0 +1,37 @@
+"""Pallas NIC kernel parity vs the jnp formulation (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+from nhd_tpu.ops.nic_pallas import BN, nic_any_first, nic_any_first_reference
+
+
+def make_case(rng, T, N, U, K, C, A):
+    UK, CA = U * K, C * A
+    free_rx = rng.uniform(-1, 90, (N, UK)).astype(np.float32)
+    free_tx = rng.uniform(-1, 90, (N, UK)).astype(np.float32)
+    dem_rx = rng.uniform(0, 50, (T, CA, UK)).astype(np.float32)
+    dem_tx = rng.uniform(0, 50, (T, CA, UK)).astype(np.float32)
+    unchosen = rng.random((CA, UK)) < 0.5
+    dem_rx[np.broadcast_to(unchosen, (T, CA, UK))] = 0.0
+    dem_tx[np.broadcast_to(unchosen, (T, CA, UK))] = 0.0
+    valid = rng.random((N, CA)) < 0.8
+    pci_ok = rng.random((N, CA)) < 0.7
+    map_pci = (rng.random(T) < 0.5).astype(np.int32)
+    return (free_rx, free_tx, dem_rx, dem_tx, unchosen, valid, pci_ok, map_pci)
+
+
+@pytest.mark.parametrize("shape", [(2, BN, 2, 2, 4, 4), (3, 2 * BN, 2, 4, 4, 16)])
+def test_pallas_matches_reference(shape):
+    T, N, U, K, C, A = shape
+    rng = np.random.default_rng(7)
+    args = make_case(rng, T, N, U, K, C, A)
+    dims = dict(U=U, K=K, C=C, A=A)
+    any_p, first_p = nic_any_first(*args, **dims, interpret=True)
+    any_r, first_r = nic_any_first_reference(*args, **dims)
+    np.testing.assert_array_equal(np.asarray(any_p), np.asarray(any_r))
+    # first_a only meaningful where any is True
+    mask = np.asarray(any_r)
+    np.testing.assert_array_equal(
+        np.asarray(first_p)[mask], np.asarray(first_r)[mask]
+    )
