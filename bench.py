@@ -135,10 +135,14 @@ def main() -> None:
 
     bench_config("cfg1:100x32", 100, 32, ["default"], baseline_sample=30)
     bench_config("cfg2:1kx256", 1000, 256, ["default"], baseline_sample=30)
-    result = bench_config(
-        "cfg3:10kx1k", 10_000, 1_000, ["default", "edge", "batch"],
-        baseline_sample=40,
-    )
+
+    from nhd_tpu.utils.tracing import profiler_trace
+
+    with profiler_trace(os.environ.get("NHD_BENCH_PROFILE")):
+        result = bench_config(
+            "cfg3:10kx1k", 10_000, 1_000, ["default", "edge", "batch"],
+            baseline_sample=40,
+        )
     if os.environ.get("NHD_BENCH_STRETCH"):
         bench_config(
             "cfg4:100kx10k", 100_000, 10_000,

@@ -27,11 +27,16 @@ from nhd_tpu.core.topology import (
 )
 from nhd_tpu.utils import get_logger
 
-# Tunables (reference: Node.py:18-20,107)
-NIC_BW_AVAIL_PERCENT = 0.9          # schedulable fraction of NIC line rate
-SCHEDULABLE_NIC_SPEED_THRESH_MBPS = 11000  # NICs below this are invisible
-ENABLE_NIC_SHARING = False          # allow pods to share one NIC
-MIN_BUSY_SECS = 30.0                # GPU-pod per-node placement back-off
+import os as _os
+
+# Tunables — compile-time constants in the reference (Node.py:18-20,107),
+# environment-configurable here (SURVEY §5.6). Read once at import.
+NIC_BW_AVAIL_PERCENT = float(_os.environ.get("NHD_NIC_BW_AVAIL_PERCENT", "0.9"))
+SCHEDULABLE_NIC_SPEED_THRESH_MBPS = int(
+    _os.environ.get("NHD_NIC_SPEED_THRESH_MBPS", "11000")
+)
+ENABLE_NIC_SHARING = _os.environ.get("NHD_NIC_SHARING", "0") == "1"
+MIN_BUSY_SECS = float(_os.environ.get("NHD_MIN_BUSY_SECS", "30"))
 
 MAINTENANCE_LABEL = "sigproc.viasat.io/maintenance"
 

@@ -40,6 +40,8 @@ from nhd_tpu.core.node import AssignmentError, HostNode
 from nhd_tpu.core.request import PodRequest
 from nhd_tpu.core.topology import MapMode, PodTopology
 from nhd_tpu.solver.encode import encode_cluster, encode_pods, refresh_node_row
+from nhd_tpu.solver.kernel import bucket_tractable
+from nhd_tpu.solver.oracle import find_node as oracle_find_node
 from nhd_tpu.solver.fast_assign import (
     AssignRecord,
     FastAssignError,
@@ -109,6 +111,40 @@ class BatchScheduler:
         self.use_fast = use_fast
         self.register_pods = register_pods
 
+    def _schedule_serial(
+        self, nodes, items, indices, results, stats, now, apply
+    ) -> None:
+        """Oracle-driven sequential scheduling for combo-oversized pods
+        (reference-exact semantics; claims hit the HostNode mirror)."""
+        from nhd_tpu.sim.requests import request_to_topology
+
+        for i in indices:
+            item = items[i]
+            m = oracle_find_node(
+                nodes, item.request, now=now, respect_busy=self.respect_busy
+            )
+            if m is None:
+                continue
+            if not apply:
+                results[i] = BatchAssignment(item.key, m.node, m.mapping)
+                continue
+            node = nodes[m.node]
+            try:
+                top = item.topology or request_to_topology(item.request)
+                node.set_busy(now)
+                nic_list = node.assign_physical_ids(m.mapping, top)
+            except (AssignmentError, ValueError) as exc:
+                self.logger.error(
+                    f"serial assignment failed for {item.key}: {exc}"
+                )
+                stats.failed += 1
+                continue
+            node.claim_nic_pods(sorted({x[0] for x in nic_list}))
+            if self.register_pods:
+                node.add_scheduled_pod(item.key[1], item.key[0], top)
+            results[i] = BatchAssignment(item.key, m.node, m.mapping, nic_list)
+            stats.scheduled += 1
+
     def schedule(
         self,
         nodes: Dict[str, HostNode],
@@ -140,6 +176,34 @@ class BatchScheduler:
         cluster = encode_cluster(nodes, now=now)
         if not self.respect_busy:
             cluster.busy[:] = False
+
+        # combo lattices too large for dense enumeration take the serial
+        # oracle path up front — claims land on the host mirror before the
+        # batched state is snapshotted below
+        oversized = [
+            i for i in pending
+            if not bucket_tractable(
+                items[i].request.n_groups, cluster.U, cluster.K
+            )
+        ]
+        if oversized:
+            # NOTE: the pre-pass gives oversized pods their claims before any
+            # greedy round, so in a capacity-contended mixed batch they win
+            # over lower-indexed tractable pods — a documented exception to
+            # the lowest-index conflict rule (every claim is still feasible
+            # when made; single-pod batches are unaffected)
+            self._schedule_serial(
+                nodes, items, oversized, results, stats, now, apply
+            )
+            ov = set(oversized)
+            pending = [i for i in pending if i not in ov]
+            if apply:  # serial claims mutated the mirror: re-project
+                cluster = encode_cluster(
+                    nodes, now=now, interner=cluster.interner
+                )
+                if not self.respect_busy:
+                    cluster.busy[:] = False
+
         fast = (
             FastCluster(nodes, cluster.U, cluster.K, arrays=cluster)
             if (self.use_fast and apply)

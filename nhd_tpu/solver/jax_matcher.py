@@ -23,8 +23,9 @@ from nhd_tpu.core.request import PodRequest
 from nhd_tpu.core.topology import MapMode, PodTopology
 from nhd_tpu.solver.combos import get_tables
 from nhd_tpu.solver.encode import encode_cluster, encode_pods
-from nhd_tpu.solver.kernel import solve_bucket
+from nhd_tpu.solver.kernel import bucket_tractable, solve_bucket
 from nhd_tpu.solver.oracle import MatchResult
+from nhd_tpu.solver.oracle import find_node as oracle_find_node
 from nhd_tpu.utils import get_logger
 
 
@@ -85,8 +86,20 @@ class JaxMatcher:
         cluster = encode_cluster(nodes, now=now)
         if not respect_busy:
             cluster.busy[:] = False
+
+        # pods whose combo lattice is too large for dense enumeration take
+        # the serial oracle (identical semantics, no tensor blow-up)
+        tractable = [
+            i for i in valid_idx
+            if bucket_tractable(reqs[i].n_groups, cluster.U, cluster.K)
+        ]
+        for i in set(valid_idx) - set(tractable):
+            results[i] = oracle_find_node(
+                nodes, reqs[i], now=now, respect_busy=respect_busy
+            )
+
         buckets = encode_pods(
-            [reqs[i] for i in valid_idx], cluster.interner, indices=valid_idx
+            [reqs[i] for i in tractable], cluster.interner, indices=tractable
         )
 
         for G, pods in buckets.items():

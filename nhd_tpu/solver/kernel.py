@@ -182,6 +182,16 @@ def _solve(
 
 USE_PALLAS = os.environ.get("NHD_TPU_PALLAS") == "1"
 
+# combo-lattice ceiling: (U^G) * (K^G) above this routes the bucket to the
+# serial oracle instead of enumerating a huge static axis (a 6-group pod on
+# a 4-NUMA/8-NIC cluster would otherwise demand a 2^30-wide tensor)
+MAX_LATTICE = int(os.environ.get("NHD_TPU_MAX_LATTICE", str(1 << 16)))
+
+
+def bucket_tractable(n_groups: int, n_numa: int, max_nic: int) -> bool:
+    """Whether a (G, U, K) bucket fits the dense-enumeration budget."""
+    return (n_numa ** n_groups) * (max(max_nic, 1) ** n_groups) <= MAX_LATTICE
+
 
 @lru_cache(maxsize=None)
 def get_solver(n_groups: int, n_numa: int, max_nic: int):

@@ -1,0 +1,39 @@
+"""Tracing and profiling hooks.
+
+The reference has no observability beyond logs (SURVEY §5.1). Here:
+
+* ``phase`` — a context-managed wall-clock phase timer accumulating into a
+  dict, for callers instrumenting multi-stage flows (BatchScheduler keeps
+  its own typed BatchStats fields for the solve/select/assign breakdown);
+* ``profiler_trace`` — wraps a block in ``jax.profiler.trace`` when a
+  directory is given (view with TensorBoard / xprof), no-op otherwise.
+  bench.py enables it via NHD_BENCH_PROFILE=<dir>.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+
+@contextlib.contextmanager
+def phase(acc: Dict[str, float], name: str) -> Iterator[None]:
+    """Accumulate the block's wall time into ``acc[name]``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        acc[name] = acc.get(name, 0.0) + time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler.trace(log_dir) when a directory is given; else no-op."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

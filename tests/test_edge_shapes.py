@@ -1,0 +1,87 @@
+"""Edge-shape coverage: >2 NUMA nodes, oversized combo lattices."""
+
+import random
+
+from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+from nhd_tpu.core.topology import MapMode, SmtMode
+from nhd_tpu.sim import SynthNodeSpec, make_cluster
+from nhd_tpu.solver import BatchItem, BatchScheduler, JaxMatcher, find_node
+
+
+def quad_numa_cluster(n=2):
+    """A 4-socket (4-NUMA) node shape — beyond the reference's 2-socket
+    Intel assumption, exercised through the same label path."""
+    return make_cluster(
+        n, SynthNodeSpec(sockets=4, phys_cores=32, nics_per_numa=1,
+                         gpus_per_numa=1, hugepages_gb=64),
+    )
+
+
+def gpu_req(n_groups=1, gpus=1):
+    return PodRequest(
+        groups=tuple(
+            GroupRequest(CpuRequest(4, SmtMode.ON), CpuRequest(1, SmtMode.ON),
+                         gpus, 10.0, 5.0)
+            for _ in range(n_groups)
+        ),
+        misc=CpuRequest(1, SmtMode.ON),
+        hugepages_gb=2,
+        map_mode=MapMode.NUMA,
+    )
+
+
+def test_quad_numa_parity():
+    nodes = quad_numa_cluster()
+    matcher = JaxMatcher()
+    for n_groups in (1, 2, 3):
+        req = gpu_req(n_groups=n_groups)
+        want = find_node(nodes, req, now=0.0, respect_busy=False)
+        got = matcher.find_node(nodes, req, now=0.0, respect_busy=False)
+        assert (want is None) == (got is None), f"G={n_groups}"
+        if want:
+            assert got.node == want.node and got.mapping == want.mapping
+
+
+def test_quad_numa_gpu_spread():
+    """4 GPU groups on a 4-NUMA node with 1 GPU each → all four NUMA nodes."""
+    nodes = quad_numa_cluster(1)
+    req = gpu_req(n_groups=4)
+    m = find_node(nodes, req, now=0.0, respect_busy=False)
+    assert m is not None
+    assert sorted(m.mapping["gpu"]) == [0, 1, 2, 3]
+    got = JaxMatcher().find_node(nodes, req, now=0.0, respect_busy=False)
+    assert got.mapping == m.mapping
+
+
+def test_oversized_bucket_falls_back_to_oracle(monkeypatch):
+    """A pod whose U^G * K^G lattice exceeds the budget still schedules —
+    via the serial oracle — in both matcher and batch paths. The budget is
+    shrunk so a 3-group pod counts as oversized (a real 10-group pod takes
+    the same path, just slowly on both sides)."""
+    from nhd_tpu.solver import kernel
+
+    monkeypatch.setattr(kernel, "MAX_LATTICE", 16)
+    nodes = quad_numa_cluster()
+    big = gpu_req(n_groups=3, gpus=0)
+    assert not kernel.bucket_tractable(3, 4, 1)
+
+    got = JaxMatcher().find_node(nodes, big, now=0.0, respect_busy=False)
+    want = find_node(nodes, big, now=0.0, respect_busy=False)
+    assert (want is None) == (got is None)
+    if want:
+        assert got.node == want.node and got.mapping == want.mapping
+
+    sched = BatchScheduler(respect_busy=False)
+    mixed = [
+        BatchItem(("ns", "small"), gpu_req()),          # tractable path
+        BatchItem(("ns", "big"), big),                  # serial pre-pass
+    ]
+    results, stats = sched.schedule(nodes, mixed, now=0.0)
+    assert results[0].node is not None
+    assert results[1].node is not None
+    assert stats.scheduled == 2
+    total_used = sum(
+        1 for node in nodes.values() for c in node.cores
+        if c.used and c.core not in node.reserved_cores
+    )
+    assert total_used > 0
