@@ -1,0 +1,21 @@
+# Scheduler image (reference: Makefile docker rules). The TPU backend is
+# only needed where the solver runs; CPU-only deployments work out of the
+# box with jax[cpu].
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/nhd-tpu
+COPY pyproject.toml README.md ./
+COPY nhd_tpu ./nhd_tpu
+COPY native ./native
+
+# compile the native core BEFORE install so the .so ships inside the
+# installed package (pyproject package-data includes nhd_tpu/native/*.so)
+RUN g++ -O2 -shared -fPIC -o nhd_tpu/native/_libnhd.so native/nhd_assign.cc \
+    && pip install --no-cache-dir "jax[cpu]" kubernetes grpcio protobuf \
+    && pip install --no-cache-dir .
+
+EXPOSE 45655
+ENTRYPOINT ["nhd-tpu"]

@@ -1,0 +1,174 @@
+"""Chaos simulation: randomized cluster churn against the full scheduler.
+
+The reference has no fault injection of any kind (SURVEY §5.3); its
+resilience claims rest on the crash-only design being exercised in
+production. This module drives the controller+scheduler stack on the fake
+backend through randomized event storms — pod creates/deletes, cordons,
+maintenance flips, group moves, bind failures, scheduler restarts — while
+checking conservation invariants after every step.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+from dataclasses import dataclass, field
+from typing import List
+
+from nhd_tpu.k8s.fake import FakeClusterBackend
+from nhd_tpu.scheduler.controller import Controller
+from nhd_tpu.scheduler.core import Scheduler
+from nhd_tpu.scheduler.events import WatchQueue
+from nhd_tpu.sim.synth import SynthNodeSpec, make_node_labels, make_triad_config
+
+
+@dataclass
+class ChaosStats:
+    steps: int = 0
+    created: int = 0
+    deleted: int = 0
+    cordons: int = 0
+    maint_flips: int = 0
+    bind_failures: int = 0
+    restarts: int = 0
+    violations: List[str] = field(default_factory=list)
+
+
+class ChaosSim:
+    """One reproducible chaos run (seeded)."""
+
+    def __init__(self, seed: int = 0, n_nodes: int = 4):
+        self.rng = random.Random(seed)
+        self.backend = FakeClusterBackend()
+        for i in range(n_nodes):
+            spec = SynthNodeSpec(name=f"node{i}")
+            self.backend.add_node(
+                spec.name, make_node_labels(spec), hugepages_gb=spec.hugepages_gb
+            )
+        self.stats = ChaosStats()
+        self._pod_seq = 0
+        self._fresh_scheduler()
+
+    def _fresh_scheduler(self) -> None:
+        self.sched = Scheduler(
+            self.backend, WatchQueue(), queue.Queue(), respect_busy=False
+        )
+        self.controller = Controller(self.backend, self.sched.nqueue)
+        self.sched.build_initial_node_list()
+        self.sched.load_deployed_configs()
+
+    # ------------------------------------------------------------------
+    # chaos actions
+    # ------------------------------------------------------------------
+
+    def _act_create(self) -> None:
+        self._pod_seq += 1
+        cfg = make_triad_config(
+            n_groups=self.rng.choice([1, 1, 2]),
+            gpus_per_group=self.rng.choice([0, 1]),
+            cpu_workers=self.rng.choice([1, 2]),
+            hugepages_gb=self.rng.choice([2, 4]),
+        )
+        self.backend.create_pod(f"chaos-{self._pod_seq}", cfg_text=cfg)
+        self.stats.created += 1
+
+    def _act_delete(self) -> None:
+        bound = [p for p in self.backend.pods.values() if p.node]
+        if bound:
+            victim = self.rng.choice(bound)
+            self.backend.delete_pod(victim.name, victim.namespace)
+            self.stats.deleted += 1
+
+    def _act_cordon(self) -> None:
+        name = self.rng.choice(list(self.backend.nodes))
+        self.backend.cordon_node(name, self.rng.random() < 0.5)
+        self.stats.cordons += 1
+
+    def _act_maintenance(self) -> None:
+        name = self.rng.choice(list(self.backend.nodes))
+        # include clearing states, or long soaks would monotonically drain
+        # every node and stop exercising scheduling
+        value = self.rng.choice(["draining", "not_scheduled", None])
+        self.backend.update_node_labels(
+            name, {"sigproc.viasat.io/maintenance": value}
+        )
+        self.stats.maint_flips += 1
+
+    def _act_bind_failure(self) -> None:
+        # next unbound pod's bind will fail once
+        pending = [p for p in self.backend.pods.values() if p.node is None]
+        if pending:
+            victim = self.rng.choice(pending)
+            self.backend.fail_bind_for.add((victim.namespace, victim.name))
+            self.stats.bind_failures += 1
+
+    def _act_restart(self) -> None:
+        """Scheduler crash + restart: state must replay from annotations."""
+        self._fresh_scheduler()
+        self.stats.restarts += 1
+
+    # ------------------------------------------------------------------
+
+    def step(self) -> None:
+        self.stats.steps += 1
+        action = self.rng.choices(
+            [self._act_create, self._act_delete, self._act_cordon,
+             self._act_maintenance, self._act_bind_failure, self._act_restart],
+            weights=[40, 15, 10, 10, 10, 5],
+        )[0]
+        action()
+        # let the control plane catch up
+        self.controller.run_once(now=float(self.stats.steps * 10))
+        for _ in range(8):
+            if self.sched.nqueue.empty():
+                break
+            self.sched.run_once()
+        self.sched.check_pending_pods()
+        # clear one-shot bind failures so pods eventually land
+        self.backend.fail_bind_for.clear()
+        self.check_invariants()
+
+    def check_invariants(self) -> None:
+        """Conservation laws that must hold after every step."""
+        v = self.stats.violations
+        for name, node in self.sched.nodes.items():
+            if node.mem.free_hugepages_gb < 0:
+                v.append(f"step {self.stats.steps}: {name} negative hugepages")
+            for nic in node.nics:
+                if nic.pods_used < 0:
+                    v.append(f"step {self.stats.steps}: {name} negative pods_used")
+                if nic.speed_used[0] < -1e-9 or nic.speed_used[1] < -1e-9:
+                    v.append(f"step {self.stats.steps}: {name} negative NIC bw")
+            # every bound pod's claims replayable: cores used >= pods' demand
+            used = sum(
+                1 for c in node.cores
+                if c.used and c.core not in node.reserved_cores
+            )
+            if node.pod_info and used == 0:
+                v.append(f"step {self.stats.steps}: {name} has pods but no cores")
+            if not node.pod_info and used > 0:
+                v.append(
+                    f"step {self.stats.steps}: {name} leaked {used} cores "
+                    f"with no pods"
+                )
+
+        # backend and mirror agree on placements
+        bound = {
+            (p.namespace, p.name): p.node
+            for p in self.backend.pods.values() if p.node
+        }
+        mirrored = {
+            (ns, pod): name
+            for name, node in self.sched.nodes.items()
+            for (pod, ns) in node.pod_info
+        }
+        for key, node_name in mirrored.items():
+            if key not in bound:
+                v.append(f"step {self.stats.steps}: mirror has unbound {key}")
+            elif bound[key] != node_name:
+                v.append(f"step {self.stats.steps}: {key} mirror/backend differ")
+
+    def run(self, steps: int) -> ChaosStats:
+        for _ in range(steps):
+            self.step()
+        return self.stats

@@ -39,6 +39,7 @@ import numpy as np
 from nhd_tpu.core.node import AssignmentError, HostNode
 from nhd_tpu.core.request import PodRequest
 from nhd_tpu.core.topology import MapMode, PodTopology
+from nhd_tpu.solver.device_state import DeviceClusterState
 from nhd_tpu.solver.encode import encode_cluster, encode_pods, refresh_node_row
 from nhd_tpu.solver.kernel import bucket_tractable
 from nhd_tpu.solver.oracle import find_node as oracle_find_node
@@ -77,6 +78,15 @@ from collections import namedtuple
 SolveHost = namedtuple("SolveHost", "cand pref best_c best_m best_a n_combos")
 
 
+def _accelerator_backend() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
 @dataclass
 class BatchStats:
     rounds: int = 0
@@ -104,12 +114,22 @@ class BatchScheduler:
         max_rounds: int = 10_000,
         use_fast: bool = True,
         register_pods: bool = True,
+        device_state: str = "auto",
     ):
         self.logger = get_logger(__name__)
         self.respect_busy = respect_busy
         self.max_rounds = max_rounds
         self.use_fast = use_fast
         self.register_pods = register_pods
+        # "auto": resident device arrays + per-round row scatters pay off on
+        # real accelerators (especially across a tunnel/PCIe) but are pure
+        # overhead on the CPU backend, where solve inputs are already host
+        # memory
+        if device_state not in (True, False, "auto"):
+            raise ValueError(
+                f"device_state must be True, False or 'auto', got {device_state!r}"
+            )
+        self.device_state = device_state
 
     def _schedule_serial(
         self, nodes, items, indices, results, stats, now, apply
@@ -209,6 +229,13 @@ class BatchScheduler:
             if (self.use_fast and apply)
             else None
         )
+        # keep node arrays resident on device across rounds; per-round
+        # uploads shrink to the claimed rows (solver/device_state.py)
+        use_dev = (
+            self.device_state is True
+            or (self.device_state == "auto" and _accelerator_backend())
+        )
+        dev = DeviceClusterState(cluster) if use_dev else None
         records: Dict[int, AssignRecord] = {}
 
         for round_no in range(self.max_rounds):
@@ -226,7 +253,7 @@ class BatchScheduler:
             claims: Dict[int, Tuple[int, int, int]] = {}
             bucket_out = {}
             for G, pods in buckets.items():
-                out = solve_bucket(cluster, pods)
+                out = dev.solve(pods) if dev else solve_bucket(cluster, pods)
                 # pull results to host once — element reads off jax arrays
                 # cost ~0.2 ms each and the winner loop does three per pod
                 bucket_out[G] = (pods, SolveHost(*map(np.asarray, out)))
@@ -353,13 +380,15 @@ class BatchScheduler:
 
             # incremental device-state update: the fast path maintained the
             # arrays at assign time; the object path re-projects claimed rows
+            t0 = time.perf_counter()
             if fast is None:
-                t0 = time.perf_counter()
                 for n in node_claimed:
                     refresh_node_row(cluster, n, node_list[n], now=now)
                     if not self.respect_busy:
                         cluster.busy[n] = False
-                stats.assign_seconds += time.perf_counter() - t0
+            if dev is not None and apply:
+                dev.update_rows(node_claimed.keys())
+            stats.assign_seconds += time.perf_counter() - t0
 
             done = set(newly_scheduled)
             pending = [i for i in pending if i not in done]

@@ -1,0 +1,107 @@
+"""Device-resident cluster state for multi-round batch scheduling.
+
+solve_bucket re-ships every node array host→device per call — harmless for
+an on-package CPU backend, wasteful for a real accelerator (and painful
+when the TPU sits across a network tunnel, as on this dev image). This
+keeps the padded node arrays resident on device for a whole batch and
+applies each round's claims as small donated scatters: upload is O(claimed
+rows), download is the compact per-(type, node) decision tensors
+(SURVEY §7 hard part 5: host↔device state coherence without re-upload).
+
+Scatter index vectors are padded to power-of-two lengths (repeating the
+last index — idempotent for row `set`) so round-to-round claim counts reuse
+the jit cache.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nhd_tpu.solver.encode import ClusterArrays
+from nhd_tpu.solver.kernel import (
+    SolveOut,
+    USE_PALLAS,
+    _pad_pow2,
+    get_solver,
+)
+
+# node arrays that claims mutate; the rest are uploaded once and never touched
+_MUTABLE = ("busy", "hp_free", "cpu_free", "gpu_free", "nic_free", "gpu_free_sw")
+_STATIC = (
+    "numa_nodes", "smt", "active", "maintenance", "gpuless", "group_mask",
+    "nic_count", "nic_sw",
+)
+_ARG_ORDER = (
+    "numa_nodes", "smt", "active", "maintenance", "busy", "gpuless",
+    "group_mask", "hp_free", "cpu_free", "gpu_free", "nic_count",
+    "nic_free", "nic_sw", "gpu_free_sw",
+)
+
+
+def _pad_rows(a: np.ndarray, size: int) -> np.ndarray:
+    if a.shape[0] == size:
+        return a
+    return np.concatenate(
+        [a, np.zeros((size - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
+    )
+
+
+from functools import partial
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(a, idx, rows):
+    # the caller rebinds the result over `a`, so donating lets XLA update
+    # the buffer in place instead of copying the full padded array
+    return a.at[idx].set(rows)
+
+
+class DeviceClusterState:
+    """Padded node arrays living on device for the duration of a batch."""
+
+    def __init__(self, cluster: ClusterArrays):
+        self.cluster = cluster
+        self.N = cluster.n_nodes
+        self.Np = _pad_pow2(self.N, floor=128 if USE_PALLAS else 8)
+        self._dev: Dict[str, jax.Array] = {}
+        for name in _ARG_ORDER:
+            self._dev[name] = jnp.asarray(
+                _pad_rows(getattr(cluster, name), self.Np)
+            )
+
+    def update_rows(self, indices: Iterable[int]) -> None:
+        """Re-ship the claimed nodes' rows (host ClusterArrays → device)."""
+        idx_list = sorted(set(indices))
+        if not idx_list:
+            return
+        padded_len = _pad_pow2(len(idx_list), floor=8)
+        idx = np.full(padded_len, idx_list[-1], np.int32)
+        idx[: len(idx_list)] = idx_list
+        idx_dev = jnp.asarray(idx)
+        for name in _MUTABLE:
+            rows = getattr(self.cluster, name)[idx]
+            # donate-free .at[].set: XLA updates in place when the buffer
+            # isn't aliased elsewhere
+            self._dev[name] = _scatter_rows(self._dev[name], idx_dev, rows)
+
+    def solve(self, pods) -> SolveOut:
+        """solve_bucket against the resident arrays (same outputs)."""
+        T = pods.n_types
+        Tp = _pad_pow2(T)
+
+        def pad_t(a):
+            return _pad_rows(a, Tp)
+
+        solver = get_solver(pods.G, self.cluster.U, self.cluster.K)
+        out = solver(
+            *[self._dev[name] for name in _ARG_ORDER],
+            pad_t(pods.cpu_dem_smt), pad_t(pods.cpu_dem_raw),
+            pad_t(pods.gpu_dem), pad_t(pods.rx), pad_t(pods.tx),
+            pad_t(pods.hp), pad_t(pods.needs_gpu), pad_t(pods.map_pci),
+            pad_t(pods.group_mask),
+        )
+        return SolveOut(*(x[:T, : self.N] if x.ndim == 2 else x for x in out))

@@ -192,3 +192,28 @@ def test_unrepresentable_request_fails_cleanly():
     # the weird pod is still *scheduled* on the fast path (claims applied);
     # only its bookkeeping registration is skipped
     assert results[1].node is not None
+
+
+def test_device_state_path_equivalent():
+    """Forced device-resident state must match the default path exactly
+    (on CPU it is pure overhead, but the code path must stay correct —
+    'auto' disables it under the CPU backend, so CI would otherwise never
+    execute it)."""
+    import pytest
+
+    reqs = [simple_request(gpus=i % 2) for i in range(40)]
+    outs = {}
+    for ds in ("auto", True):
+        nodes = make_cluster(4)
+        results, stats = BatchScheduler(
+            respect_busy=False, device_state=ds
+        ).schedule(nodes, items(reqs), now=0.0)
+        outs[str(ds)] = (
+            [r.node for r in results],
+            [r.mapping for r in results],
+            stats.scheduled,
+        )
+    assert outs["auto"] == outs["True"]
+
+    with pytest.raises(ValueError):
+        BatchScheduler(device_state="true")
