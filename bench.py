@@ -127,11 +127,63 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40):
     return {"wall": wall, "placed": placed, "speedup": speedup}
 
 
+def bench_bind_latency(n_pods: int = 200) -> None:
+    """Event-driven single-pod path latency (p50/p99): pod create → bound,
+    through the full scheduler on the fake backend — config parse, batched
+    solve of one, physical assignment, annotations, bind. The reference's
+    north-star metric is p99 bind latency (BASELINE.md)."""
+    import queue as queue_mod
+
+    import numpy as np
+
+    from nhd_tpu.k8s.fake import FakeClusterBackend
+    from nhd_tpu.scheduler.core import Scheduler
+    from nhd_tpu.scheduler.events import WatchQueue
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+
+    backend = FakeClusterBackend()
+    for i in range(32):
+        spec = SynthNodeSpec(name=f"lat-node{i}", hugepages_gb=256)
+        backend.add_node(spec.name, make_node_labels(spec), hugepages_gb=256)
+    sched = Scheduler(backend, WatchQueue(), queue_mod.Queue(),
+                      respect_busy=False)
+    sched.build_initial_node_list()
+
+    lat = []
+    failed = 0
+    for i in range(n_pods):
+        cfg = make_triad_config(gpus_per_group=i % 2, cpu_workers=2,
+                                hugepages_gb=2)
+        backend.create_pod(f"lat-{i}", cfg_text=cfg)
+        t0 = time.perf_counter()
+        sched.attempt_scheduling_batch([(f"lat-{i}", "default", f"uid{i}")])
+        dt = time.perf_counter() - t0
+        # only successful binds count toward the latency distribution; the
+        # pod is then released so the cluster never saturates mid-run
+        if backend.pods[("default", f"lat-{i}")].node is None:
+            failed += 1
+        else:
+            lat.append(dt)
+            sched.release_pod_resources(f"lat-{i}", "default")
+        backend.delete_pod(f"lat-{i}", emit_watch=False)
+        sched.pod_state.pop(("default", f"lat-{i}"), None)
+    lat_ms = np.asarray(lat[10:]) * 1e3  # drop warmup
+    _log(
+        f"bench[bind-latency]: single-pod create→bind over {len(lat_ms)} "
+        f"binds ({failed} unschedulable excluded): "
+        f"p50={np.percentile(lat_ms, 50):.2f}ms "
+        f"p99={np.percentile(lat_ms, 99):.2f}ms "
+        f"max={lat_ms.max():.2f}ms"
+    )
+
+
 def main() -> None:
     platform = _pick_platform()
     jax = _init_jax(platform)
     _log(f"bench platform: {jax.devices()[0].platform} "
          f"({len(jax.devices())} device(s))")
+
+    bench_bind_latency()
 
     bench_config("cfg1:100x32", 100, 32, ["default"], baseline_sample=30)
     bench_config("cfg2:1kx256", 1000, 256, ["default"], baseline_sample=30)

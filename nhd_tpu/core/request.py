@@ -57,12 +57,15 @@ class GroupRequest:
         return self.nic_rx_gbps > 0 or self.nic_tx_gbps > 0
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=False)
 class PodRequest:
     """Flat, hashable pod resource request.
 
     Hashability is load-bearing: gang batches of identical replicas (e.g. a
-    TriadSet scaling out) dedupe to one solver row via this hash.
+    TriadSet scaling out) dedupe to one solver row via this hash — so the
+    hash is computed once and cached (a frozen dataclass would otherwise
+    re-hash the whole tuple tree on every dict probe; at 10k-pod batches
+    that showed up as ~15% of scheduling time).
     """
 
     groups: Tuple[GroupRequest, ...]
@@ -70,6 +73,22 @@ class PodRequest:
     hugepages_gb: int
     map_mode: MapMode
     node_groups: FrozenSet[str] = frozenset({"default"})
+
+    def _key(self) -> tuple:
+        return (self.groups, self.misc, self.hugepages_gb, self.map_mode,
+                self.node_groups)
+
+    def __hash__(self) -> int:
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash(self._key())
+            object.__setattr__(self, "_hash", h)
+        return h
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, PodRequest):
+            return NotImplemented
+        return self._key() == other._key()
 
     @property
     def n_groups(self) -> int:

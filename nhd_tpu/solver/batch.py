@@ -31,7 +31,7 @@ back-off, not the solver, becomes the rate limit.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -237,6 +237,8 @@ class BatchScheduler:
         )
         dev = DeviceClusterState(cluster) if use_dev else None
         records: Dict[int, AssignRecord] = {}
+        all_buckets = None
+        is_pending = None
 
         for round_no in range(self.max_rounds):
             if not pending:
@@ -244,15 +246,30 @@ class BatchScheduler:
             stats.rounds = round_no + 1
 
             t0 = time.perf_counter()
-            buckets = encode_pods(
-                [items[i].request for i in pending],
-                cluster.interner,
-                indices=pending,
-            )
+            if all_buckets is None:
+                # type-level tensors never change across rounds — encode the
+                # whole pending set once and only filter membership below
+                all_buckets = encode_pods(
+                    [items[i].request for i in pending],
+                    cluster.interner,
+                    indices=pending,
+                )
+                is_pending = np.zeros(len(items), bool)
+            is_pending[:] = False
+            is_pending[pending] = True
+
             # pod index → (node index, bucket G, type) chosen this round
             claims: Dict[int, Tuple[int, int, int]] = {}
             bucket_out = {}
-            for G, pods in buckets.items():
+            for G, full in all_buckets.items():
+                mask = is_pending[full.pod_index]
+                if not mask.any():
+                    continue
+                pods = replace(
+                    full,
+                    pod_type=full.pod_type[mask],
+                    pod_index=full.pod_index[mask],
+                )
                 out = dev.solve(pods) if dev else solve_bucket(cluster, pods)
                 # pull results to host once — element reads off jax arrays
                 # cost ~0.2 ms each and the winner loop does three per pod

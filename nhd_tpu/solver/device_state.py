@@ -54,10 +54,13 @@ from functools import partial
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _scatter_rows(a, idx, rows):
-    # the caller rebinds the result over `a`, so donating lets XLA update
-    # the buffer in place instead of copying the full padded array
-    return a.at[idx].set(rows)
+def _scatter_all(arrays, idx, rows):
+    # one dispatch updates every mutable array (a tunnel-attached TPU pays
+    # per-call latency); donation lets XLA update buffers in place since the
+    # caller rebinds the results over the inputs
+    return {
+        name: arrays[name].at[idx].set(rows[name]) for name in arrays
+    }
 
 
 class DeviceClusterState:
@@ -81,12 +84,10 @@ class DeviceClusterState:
         padded_len = _pad_pow2(len(idx_list), floor=8)
         idx = np.full(padded_len, idx_list[-1], np.int32)
         idx[: len(idx_list)] = idx_list
-        idx_dev = jnp.asarray(idx)
-        for name in _MUTABLE:
-            rows = getattr(self.cluster, name)[idx]
-            # donate-free .at[].set: XLA updates in place when the buffer
-            # isn't aliased elsewhere
-            self._dev[name] = _scatter_rows(self._dev[name], idx_dev, rows)
+        mutable = {name: self._dev[name] for name in _MUTABLE}
+        rows = {name: getattr(self.cluster, name)[idx] for name in _MUTABLE}
+        updated = _scatter_all(mutable, jnp.asarray(idx), rows)
+        self._dev.update(updated)
 
     def solve(self, pods) -> SolveOut:
         """solve_bucket against the resident arrays (same outputs)."""
