@@ -1,0 +1,18 @@
+"""Multi-device / multi-host execution of the batched solve.
+
+In an ML framework this package would hold DP/TP/PP shardings; in a
+scheduler the data-parallel axis is the *cluster itself* (SURVEY §2): the
+feasibility tensor [types × nodes × combos × picks] shards along the node
+axis, pod types replicate, and selection is a cross-device reduction.
+
+* sharding  — pjit solve over a 1-D ``nodes`` Mesh (single- or multi-host)
+* multihost — jax.distributed bootstrap helpers for DCN-spanning meshes
+"""
+
+from nhd_tpu.parallel.sharding import (
+    get_sharded_solver,
+    make_mesh,
+    solve_bucket_sharded,
+)
+
+__all__ = ["get_sharded_solver", "make_mesh", "solve_bucket_sharded"]

@@ -1,0 +1,72 @@
+"""Multi-host bootstrap for federation-scale scheduling.
+
+The 100k-pod × 10k-node federation config (BASELINE config 5) fits one
+chip's memory comfortably (node state is ~KBs/row), so multi-host is about
+*locality and throughput*, not capacity: each host's devices own a node
+shard (its region/cluster of the federation), solves ride ICI within a
+slice and DCN across slices, and only the compact per-(type, node)
+decisions travel.
+
+The reference's analog is its API-server-centric distribution (SURVEY
+§5.8): state in one place, one worker. Here the worker itself scales out.
+
+Usage on each host of a multi-host deployment:
+
+    from nhd_tpu.parallel import multihost, make_mesh
+    multihost.initialize(coordinator="host0:9999", num_processes=4,
+                         process_id=RANK)
+    mesh = make_mesh()          # global mesh over every host's devices
+    # BatchScheduler/solve_bucket_sharded proceed unchanged: pjit handles
+    # cross-host collectives; each host feeds its local node shard.
+
+Cannot be exercised on this single-host dev image; the virtual 8-device
+CPU mesh (tests/conftest.py) covers the sharded code path itself.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from nhd_tpu.utils import get_logger
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """jax.distributed.initialize with explicit or env-provided topology.
+
+    With no arguments, defers to JAX's environment auto-detection
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID or the
+    cluster plugin). Idempotent: re-initialization is a no-op.
+    """
+    import jax
+
+    logger = get_logger(__name__)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as exc:
+        if "already initialized" in str(exc).lower():
+            logger.warning("jax.distributed already initialized; ignoring")
+            return
+        raise
+    logger.warning(
+        f"multihost: process {jax.process_index()}/{jax.process_count()}, "
+        f"{jax.local_device_count()} local of {jax.device_count()} devices"
+    )
+
+
+def local_node_slice(n_nodes: int) -> slice:
+    """The contiguous node-index range this process's devices own under a
+    1-D nodes mesh (block layout, matching sharding.solve_bucket_sharded
+    padding)."""
+    import jax
+
+    per = -(-n_nodes // jax.process_count())  # ceil division
+    start = per * jax.process_index()
+    return slice(start, min(start + per, n_nodes))

@@ -9,7 +9,7 @@ import pytest
 
 from nhd_tpu.solver.encode import encode_cluster, encode_pods
 from nhd_tpu.solver.kernel import solve_bucket
-from nhd_tpu.solver.sharding import make_mesh, solve_bucket_sharded
+from nhd_tpu.parallel.sharding import make_mesh, solve_bucket_sharded
 from tests.test_jax_matcher import random_cluster, random_request
 
 
