@@ -25,7 +25,13 @@ from nhd_tpu.scheduler.events import WatchQueue
 from nhd_tpu.utils import get_logger
 
 
-def build_threads(backend, *, rpc_port: int = 45655, respect_busy: bool = True):
+def build_threads(
+    backend,
+    *,
+    rpc_port: int = 45655,
+    metrics_port: int = 0,
+    respect_busy: bool = True,
+):
     """Wire up the thread set for a backend; returns (threads, rpc_queue)."""
     watch_q = WatchQueue()
     rpc_q: queue.Queue = queue.Queue(maxsize=128)  # reference: bin/nhd:21
@@ -41,6 +47,11 @@ def build_threads(backend, *, rpc_port: int = 45655, respect_busy: bool = True):
     except ImportError as exc:
         get_logger(__name__).warning(f"stats RPC plane disabled: {exc}")
 
+    if metrics_port:
+        from nhd_tpu.rpc.metrics import MetricsServer
+
+        threads.append(MetricsServer(rpc_q, port=metrics_port))
+
     return threads, rpc_q
 
 
@@ -49,10 +60,20 @@ def main(argv=None) -> int:
     parser.add_argument("--fake", action="store_true",
                         help="use the in-memory backend (demo mode)")
     parser.add_argument("--rpc-port", type=int, default=45655)
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="Prometheus /metrics port (0 = disabled)")
     args = parser.parse_args(argv)
 
     logger = get_logger(__name__)
     logger.warning(f"nhd_tpu version {__version__}")
+
+    # honor an explicit JAX_PLATFORMS choice at the *config* level: some
+    # hosts' PJRT plugins (e.g. tunneled TPUs) override jax_platforms in
+    # sitecustomize, and a dead tunnel would hang the scheduler's first solve
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     if args.fake:
         from nhd_tpu.k8s.fake import FakeClusterBackend
@@ -74,7 +95,9 @@ def main(argv=None) -> int:
 
         backend = KubeClusterBackend()
 
-    threads, _ = build_threads(backend, rpc_port=args.rpc_port)
+    threads, _ = build_threads(
+        backend, rpc_port=args.rpc_port, metrics_port=args.metrics_port
+    )
     for t in threads:
         t.start()
 

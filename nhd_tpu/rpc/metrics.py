@@ -1,0 +1,112 @@
+"""Prometheus-format metrics endpoint.
+
+The reference's only metrics plane is its gRPC service (SURVEY §5.5 — "No
+Prometheus"). This adds a stdlib-only HTTP exporter: GET /metrics renders
+the same scheduler-owned stats (via the single-writer RPC queue, like the
+gRPC plane) in Prometheus text exposition format, so standard scrapers work
+without a sidecar. Opt-in via ``nhd-tpu --metrics-port``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List
+
+from nhd_tpu.rpc import ask_scheduler
+from nhd_tpu.scheduler.core import RpcMsgType
+from nhd_tpu.utils import get_logger
+
+
+def render_metrics(nodes: List[dict], failed_count: int) -> str:
+    """Scheduler stats → Prometheus text exposition format."""
+    lines = [
+        "# HELP nhd_failed_schedule_total Pods that failed to schedule",
+        "# TYPE nhd_failed_schedule_total counter",
+        f"nhd_failed_schedule_total {failed_count}",
+        "# HELP nhd_node_free_cpus Free logical CPU cores per node",
+        "# TYPE nhd_node_free_cpus gauge",
+        "# HELP nhd_node_free_gpus Free GPUs per node",
+        "# TYPE nhd_node_free_gpus gauge",
+        "# HELP nhd_node_free_hugepages_gb Free 1Gi hugepages per node",
+        "# TYPE nhd_node_free_hugepages_gb gauge",
+        "# HELP nhd_node_pods Scheduled pods per node",
+        "# TYPE nhd_node_pods gauge",
+        "# HELP nhd_node_active Node schedulable by NHD",
+        "# TYPE nhd_node_active gauge",
+        "# HELP nhd_nic_used_gbps NIC bandwidth booked per node/nic/direction",
+        "# TYPE nhd_nic_used_gbps gauge",
+    ]
+    for n in nodes:
+        label = f'node="{n["name"]}"'
+        lines.append(f'nhd_node_free_cpus{{{label}}} {n["freecpu"]}')
+        lines.append(f'nhd_node_free_gpus{{{label}}} {n["freegpu"]}')
+        lines.append(
+            f'nhd_node_free_hugepages_gb{{{label}}} {max(n["freehuge_gb"], 0)}'
+        )
+        lines.append(f'nhd_node_pods{{{label}}} {n["totalpods"]}')
+        lines.append(f'nhd_node_active{{{label}}} {int(n["active"])}')
+        for i, (rx, tx) in enumerate(n["nicstats"]):
+            lines.append(
+                f'nhd_nic_used_gbps{{{label},nic="{i}",dir="rx"}} {rx}'
+            )
+            lines.append(
+                f'nhd_nic_used_gbps{{{label},nic="{i}",dir="tx"}} {tx}'
+            )
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer(threading.Thread):
+    """HTTP thread serving /metrics off the scheduler's RPC queue."""
+
+    def __init__(self, sched_queue: queue.Queue, *, port: int = 9464):
+        super().__init__(name="nhd-metrics", daemon=True)
+        self.logger = get_logger(__name__)
+        self.mainq = sched_queue
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = outer._collect().encode()
+                except Exception as exc:  # scheduler unavailable
+                    self.send_error(503, str(exc))
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass  # keep scrapes out of the logs
+
+        self.server = ThreadingHTTPServer(("", port), Handler)
+        self.port = self.server.server_address[1]
+
+    def _collect(self) -> str:
+        nodes = ask_scheduler(self.mainq, RpcMsgType.NODE_INFO)
+        failed = ask_scheduler(self.mainq, RpcMsgType.SCHEDULER_INFO)
+        return render_metrics(nodes, failed)
+
+    def run(self) -> None:
+        self._serving = True
+        self.logger.warning(f"metrics endpoint on :{self.port}/metrics")
+        self.server.serve_forever()
+
+    def stop(self) -> None:
+        """Idempotent, and safe on a never-started server (shutdown() would
+        otherwise block forever waiting for the serve loop)."""
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
+        if getattr(self, "_serving", False):
+            self.server.shutdown()
+        self.server.server_close()  # release the listening socket

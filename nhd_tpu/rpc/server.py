@@ -39,9 +39,9 @@ class NHDControlHandler:
         self.mainq = sched_queue
 
     def _ask(self, msg_type: RpcMsgType):
-        tmpq: queue.Queue = queue.Queue()
-        self.mainq.put((msg_type, tmpq))
-        return tmpq.get(timeout=RPC_TIMEOUT_SEC)
+        from nhd_tpu.rpc import ask_scheduler
+
+        return ask_scheduler(self.mainq, msg_type)
 
     # ------------------------------------------------------------------
 

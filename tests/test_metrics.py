@@ -1,0 +1,81 @@
+"""Prometheus metrics endpoint tests — deliberately grpc-free: the exporter
+is stdlib-only and must keep working without the optional cluster extras."""
+
+import queue
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nhd_tpu.rpc.metrics import MetricsServer, render_metrics
+from tests.test_scheduler import make_backend, make_scheduler, pod_cfg
+
+
+@pytest.fixture
+def metrics_stack():
+    backend = make_backend(n_nodes=2)
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                item = sched.rpcq.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            sched._parse_rpc_req(item[0], item[1])
+
+    threading.Thread(target=pump, daemon=True).start()
+    server = MetricsServer(sched.rpcq, port=0)
+    server.start()
+    yield server
+    server.stop()
+    stop.set()
+
+
+def test_metrics_endpoint(metrics_stack):
+    body = urllib.request.urlopen(
+        f"http://localhost:{metrics_stack.port}/metrics", timeout=5
+    ).read().decode()
+    assert "nhd_failed_schedule_total 0" in body
+    assert 'nhd_node_pods{node="node0"} 1' in body
+    assert 'nhd_node_active{node="node1"} 1' in body
+    assert 'dir="rx"' in body
+
+
+def test_metrics_query_string_ok(metrics_stack):
+    """Prometheus params add a query string; still a valid scrape."""
+    body = urllib.request.urlopen(
+        f"http://localhost:{metrics_stack.port}/metrics?collect=node", timeout=5
+    ).read().decode()
+    assert "nhd_node_free_cpus" in body
+
+
+def test_metrics_404(metrics_stack):
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            f"http://localhost:{metrics_stack.port}/nope", timeout=5
+        )
+
+
+def test_stop_releases_port(metrics_stack):
+    port = metrics_stack.port
+    metrics_stack.stop()          # fixture teardown will re-stop: idempotent
+    # rebinding the same fixed port must succeed immediately
+    server2 = MetricsServer(queue.Queue(), port=port)
+    server2.stop()                # never started: must not block
+
+
+def test_render_escapes_nothing_unexpected():
+    out = render_metrics(
+        [{"name": "n0", "freecpu": 1, "freegpu": 2, "freehuge_gb": -3,
+          "totalpods": 0, "active": False, "nicstats": [[1.5, 0.0]]}],
+        failed_count=7,
+    )
+    assert "nhd_failed_schedule_total 7" in out
+    assert 'nhd_node_free_hugepages_gb{node="n0"} 0' in out  # clamped
+    assert 'nhd_node_active{node="n0"} 0' in out
