@@ -14,6 +14,8 @@ Selection semantics mirror the oracle exactly for a single pod:
 
 from __future__ import annotations
 
+from functools import lru_cache
+from types import MappingProxyType
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -29,16 +31,25 @@ from nhd_tpu.solver.oracle import find_node as oracle_find_node
 from nhd_tpu.utils import get_logger
 
 
-def decode_mapping(G: int, U: int, K: int, c: int, m: int, a: int) -> Dict[str, tuple]:
-    """(combo, misc-numa, pick) indices → the oracle's mapping dict."""
+@lru_cache(maxsize=65536)
+def decode_mapping(G: int, U: int, K: int, c: int, m: int, a: int):
+    """(combo, misc-numa, pick) indices → the oracle's mapping, as a
+    read-only view.
+
+    Memoized: gang batches decode the same few (combo, pick) points tens of
+    thousands of times. The MappingProxyType return enforces immutability —
+    a mutation would otherwise corrupt the shared cache entry for every
+    later pod decoding the same point (OracleMatcher returns fresh dicts;
+    values are tuples either way, so reads are interchangeable).
+    """
     tables = get_tables(G, U, K)
     combo = tuple(int(x) for x in tables.combo[c])
     pick = tuple(int(x) for x in tables.pick[a])
-    return {
+    return MappingProxyType({
         "gpu": combo,
         "cpu": combo + (int(m),),
         "nic": tuple(zip(combo, pick)),
-    }
+    })
 
 
 class JaxMatcher:

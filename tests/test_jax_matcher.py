@@ -121,3 +121,45 @@ def test_batch_matches_singles():
         assert (want is None) == (got is None)
         if want:
             assert got.node == want.node and got.mapping == want.mapping
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_feasible_set_parity(seed):
+    """Beyond choice parity: the kernel's per-node candidacy and feasible
+    NUMA-combo *counts* must equal the oracle's filter→intersect output on
+    every node (SURVEY §7: property-test the feasible sets themselves)."""
+    import numpy as np
+
+    from nhd_tpu.core.request import PodRequest as PR
+    from nhd_tpu.solver.encode import encode_cluster, encode_pods
+    from nhd_tpu.solver.kernel import solve_bucket
+    from nhd_tpu.solver.oracle import OracleMatcher
+
+    rng = random.Random(3000 + seed)
+    nodes = random_cluster(rng, rng.randint(2, 5))
+    reqs = [random_request(rng) for _ in range(3)]
+    matcher = OracleMatcher()
+
+    cluster = encode_cluster(nodes, now=1010.0)
+    for G, pods in encode_pods(reqs, cluster.interner).items():
+        out = solve_bucket(cluster, pods)
+        cand = np.asarray(out.cand)
+        n_combos = np.asarray(out.n_combos)
+        for t, pod_i in zip(pods.pod_type, pods.pod_index):
+            req = reqs[int(pod_i)]
+            filt = matcher.filter_pod_resources(nodes, req)
+            filts = matcher.filter_numa_topology(filt, req, now=1010.0)
+            matcher.intersect_resources(filt, filts, req.map_mode)
+            oracle_counts = {
+                name: len(filts.gpu[name]) for name in filts.candidates
+            }
+            for n_idx, name in enumerate(cluster.names):
+                want = oracle_counts.get(name, 0)
+                assert bool(cand[t, n_idx]) == (want > 0), (
+                    f"seed {seed} pod {pod_i} node {name}: candidacy differs"
+                )
+                if want > 0:
+                    assert int(n_combos[t, n_idx]) == want, (
+                        f"seed {seed} pod {pod_i} node {name}: "
+                        f"combo count {int(n_combos[t, n_idx])} != {want}"
+                    )
