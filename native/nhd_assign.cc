@@ -14,6 +14,9 @@
 // Build: make native   (g++ -O2 -shared -fPIC)
 
 #include <cstdint>
+#include <cstddef>
+
+using std::size_t;
 
 namespace {
 
@@ -130,6 +133,196 @@ int nhd_assign_pod(
                     misc_count, misc_smt, out_cores + cores_at);
   if (n < 0) return -4;
   out_counts[2 * n_groups] = n;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Round-level assignment: one call places every winner of a greedy round.
+//
+// Winners are on distinct nodes (the batch scheduler's one-claim-per-node
+// rule), so the loop is sequential but independent. Mutates the FastCluster
+// occupancy arrays AND the solver-visible ClusterArrays increments (the
+// same deltas fast_assign._update_arrays applies), eliminating the
+// per-winner Python round trips entirely.
+//
+// Combo/pick decoding matches solver/combos.py: index digits base U (resp.
+// K), slot 0 most significant. CPU physical-core demand replicates
+// CpuRequest.physical_cores: ceil(n/2) for SMT-tolerant requests on SMT
+// nodes, n otherwise.
+//
+// Per-winner status: 0 ok; -1 proc, -2 gpu, -3 helper, -4 misc shortfall;
+// -5 hugepages; -6 missing NIC. Failures leave all state untouched.
+
+static inline int phys_cores(int count, int smt_req, int node_smt) {
+  return (node_smt && smt_req) ? (count + 1) / 2 : count;
+}
+
+int nhd_assign_round(
+    // FastCluster occupancy (mutated)
+    uint8_t* core_used_all, const int8_t* core_socket_all,
+    const int32_t* phys_all, const uint8_t* smt_all, int L,
+    uint8_t* gpu_used_all, const int8_t* gpu_numa_all,
+    const int64_t* gpu_sw_all, const int32_t* gpu_sw_dense_all,
+    const int32_t* n_gpus_all, int GM,
+    const int32_t* nic_flat_all, const int64_t* nic_sw_all,
+    double* nic_rx_used_all, double* nic_tx_used_all, int32_t* nic_pods_all,
+    const double* nic_cap_all, int U, int K,
+    int64_t* hp_free_all,
+    // solver-visible ClusterArrays (mutated incrementally)
+    int32_t* cpu_free_all, int32_t* gpu_free_all, int32_t* gpu_free_sw_all,
+    float* nic_free_all, int32_t* hp_free32_all, uint8_t* busy_all,
+    int S, int set_busy, int enable_sharing,
+    // bucket type data ([T, G] row-major; scalars [T])
+    int G, const int32_t* t_proc, const int32_t* t_proc_smt,
+    const int32_t* t_help, const int32_t* t_help_smt, const int32_t* t_gpus,
+    const float* t_rx, const float* t_tx, const int32_t* t_misc,
+    const int32_t* t_misc_smt, const int32_t* t_hp, const uint8_t* t_pci,
+    // winners
+    int W, const int32_t* w_node, const int32_t* w_type, const int32_t* w_c,
+    const int32_t* w_m, const int32_t* w_a,
+    // outputs ([W, MAXC] / [W, 2G+1] / [W, G] / [W, GMX])
+    int32_t* out_status, int32_t* out_cores, int32_t* out_counts,
+    int32_t* out_nic_flat, int32_t* out_gpus, int MAXC, int GMX) {
+  const int UK = U * K;
+  uint8_t core_overlay[4096];
+  uint8_t gpu_overlay[512];
+  // size guards — the Python caller (round_ok_for) checks the same limits
+  // and falls back to the per-pod path; this is defense in depth
+  if (L > 4096 || GM > 512 || G > 16) return -100;
+
+  for (int w = 0; w < W; ++w) {
+    const int n = w_node[w];
+    const int t = w_type[w];
+    const int node_smt = smt_all[n];
+    const int P = phys_all[n];
+    uint8_t* core_used = core_used_all + (size_t)n * L;
+    uint8_t* gpu_used = gpu_used_all + (size_t)n * GM;
+    const int8_t* core_socket = core_socket_all + (size_t)n * L;
+    const int8_t* gpu_numa = gpu_numa_all + (size_t)n * GM;
+    const int64_t* gpu_sw = gpu_sw_all + (size_t)n * GM;
+    const int32_t* gpu_sw_dense = gpu_sw_dense_all + (size_t)n * GM;
+    const int n_gpus = n_gpus_all[n];
+    const int32_t* nic_flat = nic_flat_all + (size_t)n * UK;
+    const int64_t* nic_sw = nic_sw_all + (size_t)n * UK;
+
+    int32_t* cores_row = out_cores + (size_t)w * MAXC;
+    int32_t* counts_row = out_counts + (size_t)w * (2 * G + 1);
+    int32_t* nic_row = out_nic_flat + (size_t)w * (G > 0 ? G : 1);
+    int32_t* gpus_row = out_gpus + (size_t)w * GMX;
+
+    if (t_hp[t] > hp_free_all[n]) { out_status[w] = -5; continue; }
+
+    for (int i = 0; i < L; ++i) core_overlay[i] = core_used[i];
+    for (int i = 0; i < GM; ++i) gpu_overlay[i] = gpu_used[i];
+
+    // decode combo/pick digits
+    int numa_of[16], pick_of[16];
+    {
+      int c = w_c[w], a = w_a[w];
+      for (int g = G - 1; g >= 0; --g) {
+        numa_of[g] = c % U; c /= U;
+        pick_of[g] = a % K; a /= K;
+      }
+    }
+
+    int status = 0, cores_at = 0, gpus_at = 0;
+    for (int g = 0; g < G && status == 0; ++g) {
+      const int numa = numa_of[g];
+      const int uk = numa * K + pick_of[g];
+      const int flat = nic_flat[uk];
+      const float rx = t_rx[(size_t)t * G + g], tx = t_tx[(size_t)t * G + g];
+      const int needs_nic = (rx > 0.0f) || (tx > 0.0f);
+      const int gpus = t_gpus[(size_t)t * G + g];
+      if (flat < 0 && (needs_nic || gpus)) { status = -6; break; }
+      nic_row[g] = flat;
+
+      int nres = cpu_batch(core_overlay, core_socket, P, node_smt, numa,
+                           t_proc[(size_t)t * G + g],
+                           t_proc_smt[(size_t)t * G + g],
+                           cores_row + cores_at);
+      if (nres < 0) { status = -1; break; }
+      counts_row[2 * g] = nres;
+      cores_at += nres;
+
+      for (int j = 0; j < gpus; ++j) {
+        const int64_t sw = flat >= 0 ? nic_sw[uk] : -1;
+        int gi = pick_gpu(gpu_overlay, gpu_numa, gpu_sw, n_gpus, sw, numa,
+                          t_pci[t]);
+        if (gi < 0) { status = -2; break; }
+        gpu_overlay[gi] = 1;
+        gpus_row[gpus_at++] = gi;
+      }
+      if (status != 0) break;
+
+      nres = cpu_batch(core_overlay, core_socket, P, node_smt, numa,
+                       t_help[(size_t)t * G + g],
+                       t_help_smt[(size_t)t * G + g], cores_row + cores_at);
+      if (nres < 0) { status = -3; break; }
+      counts_row[2 * g + 1] = nres;
+      cores_at += nres;
+    }
+    if (status == 0) {
+      int nres = cpu_batch(core_overlay, core_socket, P, node_smt, w_m[w],
+                           t_misc[t], t_misc_smt[t], cores_row + cores_at);
+      if (nres < 0) status = -4;
+      else counts_row[2 * G] = nres;
+    }
+    out_status[w] = status;
+    if (status != 0) continue;
+
+    // ---- commit occupancy ----
+    for (int i = 0; i < L; ++i) core_used[i] = core_overlay[i];
+    for (int i = 0; i < GM; ++i) gpu_used[i] = gpu_overlay[i];
+    hp_free_all[n] -= t_hp[t];
+
+    // ---- solver-array increments (fast_assign._update_arrays) ----
+    int32_t* cpu_free = cpu_free_all + (size_t)n * U;
+    int32_t* gpu_free = gpu_free_all + (size_t)n * U;
+    int32_t* gpu_free_sw = gpu_free_sw_all + (size_t)n * S;
+    float* nic_free = nic_free_all + (size_t)n * UK * 2;
+    double* nic_rx_used = nic_rx_used_all + (size_t)n * UK;
+    double* nic_tx_used = nic_tx_used_all + (size_t)n * UK;
+    int32_t* nic_pods = nic_pods_all + (size_t)n * UK;
+    const double* nic_cap = nic_cap_all + (size_t)n * UK;
+
+    for (int g = 0; g < G; ++g) {
+      const int numa = numa_of[g];
+      cpu_free[numa] -= phys_cores(t_proc[(size_t)t * G + g],
+                                   t_proc_smt[(size_t)t * G + g], node_smt) +
+                        phys_cores(t_help[(size_t)t * G + g],
+                                   t_help_smt[(size_t)t * G + g], node_smt);
+    }
+    cpu_free[w_m[w]] -= phys_cores(t_misc[t], t_misc_smt[t], node_smt);
+    for (int j = 0; j < gpus_at; ++j) {
+      const int gi = gpus_row[j];
+      gpu_free[gpu_numa[gi]] -= 1;
+      gpu_free_sw[gpu_sw_dense[gi]] -= 1;
+    }
+    // NIC bandwidth: joint per (u,k); pods_used once per distinct claimed NIC
+    for (int g = 0; g < G; ++g) {
+      const float rx = t_rx[(size_t)t * G + g], tx = t_tx[(size_t)t * G + g];
+      if (rx <= 0.0f && tx <= 0.0f) continue;
+      const int uk = numa_of[g] * K + pick_of[g];
+      nic_rx_used[uk] += rx;
+      nic_tx_used[uk] += tx;
+      int first = 1;  // claimed already this pod?
+      for (int h = 0; h < g; ++h) {
+        const float hrx = t_rx[(size_t)t * G + h], htx = t_tx[(size_t)t * G + h];
+        if ((hrx > 0.0f || htx > 0.0f) &&
+            numa_of[h] * K + pick_of[h] == uk) { first = 0; break; }
+      }
+      if (first) nic_pods[uk] += 1;
+      if (enable_sharing) {
+        nic_free[uk * 2] = (float)(nic_cap[uk] - nic_rx_used[uk]);
+        nic_free[uk * 2 + 1] = (float)(nic_cap[uk] - nic_tx_used[uk]);
+      } else {
+        nic_free[uk * 2] = 0.0f;
+        nic_free[uk * 2 + 1] = 0.0f;
+      }
+    }
+    hp_free32_all[n] -= t_hp[t];
+    if (set_busy) busy_all[n] = 1;
+  }
   return 0;
 }
 

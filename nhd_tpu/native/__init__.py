@@ -59,8 +59,8 @@ def _load() -> Optional[ctypes.CDLL]:
     # ctypes pointers per call
     p = ctypes.c_void_p
     i = ctypes.c_int
-    if not hasattr(lib, "nhd_assign_pod"):
-        return None  # stale/foreign library without our symbol
+    if not hasattr(lib, "nhd_assign_pod") or not hasattr(lib, "nhd_assign_round"):
+        return None  # stale/foreign library without our symbols
     lib.nhd_assign_pod.restype = ctypes.c_int
     lib.nhd_assign_pod.argtypes = [
         p, p, i, i,          # core overlay, sockets, P, smt
@@ -71,6 +71,19 @@ def _load() -> Optional[ctypes.CDLL]:
         i, i, i, i,          # misc numa/count/smt, pci
         p, p, p,             # out cores/counts/gpus
     ]
+    lib.nhd_assign_round.restype = ctypes.c_int
+    lib.nhd_assign_round.argtypes = (
+        [p, p, p, p, i]          # core_used, socket, phys, smt, L
+        + [p, p, p, p, p, i]     # gpu used/numa/sw/sw_dense/n_gpus, GM
+        + [p, p, p, p, p, p, i, i]  # nic flat/sw/rx/tx/pods/cap, U, K
+        + [p]                    # hp_free (int64)
+        + [p, p, p, p, p, p, i, i, i]  # cpu_free, gpu_free, gpu_free_sw,
+                                       # nic_free, hp_free32, busy, S,
+                                       # set_busy, enable_sharing
+        + [i, p, p, p, p, p, p, p, p, p, p, p]  # G + 11 type arrays
+        + [i, p, p, p, p, p]     # W, w_node/type/c/m/a
+        + [p, p, p, p, p, i, i]  # out status/cores/counts/nic/gpus, MAXC, GMX
+    )
     return lib
 
 

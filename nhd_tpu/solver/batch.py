@@ -237,6 +237,7 @@ class BatchScheduler:
         )
         dev = DeviceClusterState(cluster) if use_dev else None
         records: Dict[int, AssignRecord] = {}
+        busy_nodes: set = set()
         all_buckets = None
         is_pending = None
 
@@ -323,6 +324,69 @@ class BatchScheduler:
 
             t0 = time.perf_counter()
             newly_scheduled: List[int] = []
+
+            round_ok = (
+                apply
+                and fast is not None
+                and fast.round_supported()
+                and all(
+                    fast.round_ok_for(bucket_out[G][0]) for G in bucket_out
+                )
+            )
+            if round_ok:
+                # one native call places every winner of the round
+                # (native/nhd_assign.cc::nhd_assign_round)
+                by_bucket: Dict[int, List[Tuple[int, int, int]]] = {}
+                for pod_i, (n, G, t) in claims.items():
+                    by_bucket.setdefault(G, []).append((pod_i, n, t))
+                for G, winners in by_bucket.items():
+                    pods, out = bucket_out[G]
+                    w_node = np.asarray([w[1] for w in winners], np.int32)
+                    w_type = np.asarray([w[2] for w in winners], np.int32)
+                    w_c = np.ascontiguousarray(out.best_c[w_type, w_node], np.int32)
+                    w_m = np.ascontiguousarray(out.best_m[w_type, w_node], np.int32)
+                    w_a = np.ascontiguousarray(out.best_a[w_type, w_node], np.int32)
+                    buffers = fast.assign_round(
+                        pods, w_node, w_type, w_c, w_m, w_a,
+                        set_busy=self.respect_busy,
+                    )
+                    status = buffers[0]
+                    for w, (pod_i, n, t) in enumerate(winners):
+                        item = items[pod_i]
+                        newly_scheduled.append(pod_i)
+                        if status[w] < 0:
+                            self.logger.error(
+                                f"assignment failed for {item.key} on "
+                                f"{cluster.names[n]}: stage {int(status[w])}"
+                            )
+                            results[pod_i] = BatchAssignment(item.key, None)
+                            stats.failed += 1
+                            continue
+                        mapping = decode_mapping(
+                            G, cluster.U, cluster.K,
+                            int(w_c[w]), int(w_m[w]), int(w_a[w]),
+                        )
+                        if item.topology is not None or self.register_pods:
+                            rec = fast.record_from_round(pods, w, n, t, buffers)
+                            records[pod_i] = rec
+                            nic_list = rec.nic_list
+                        else:
+                            nic_list = fast.nic_list_from_round(
+                                pods, w, t, buffers
+                            )
+                        busy_nodes.add(n)
+                        results[pod_i] = BatchAssignment(
+                            item.key, cluster.names[n], mapping, nic_list,
+                            round_no,
+                        )
+                        stats.scheduled += 1
+                if dev is not None:
+                    dev.update_rows(node_claimed.keys())
+                stats.assign_seconds += time.perf_counter() - t0
+                done = set(newly_scheduled)
+                pending = [i for i in pending if i not in done]
+                continue
+
             for pod_i, (n, G, t) in claims.items():
                 pods, out = bucket_out[G]
                 mapping = decode_mapping(
@@ -352,6 +416,7 @@ class BatchScheduler:
                         stats.failed += 1
                         continue
                     records[pod_i] = rec
+                    busy_nodes.add(n)
                     if self.respect_busy:
                         cluster.busy[n] = True
                     results[pod_i] = BatchAssignment(
@@ -416,10 +481,14 @@ class BatchScheduler:
         if fast is not None:
             t0 = time.perf_counter()
             fast.sync_to_nodes()
+            # every scheduled pod stamps its node busy (reference:
+            # NHDScheduler.py:289) — tracked independently of records, since
+            # headless round-path winners don't materialize one
+            for n in busy_nodes:
+                node_list[n].set_busy(now)
             for pod_i, rec in records.items():
                 item = items[pod_i]
                 node = node_list[rec.node_index]
-                node.set_busy(now)
                 if item.topology is not None:
                     apply_record_to_topology(rec, item.topology)
                     if self.register_pods:

@@ -135,6 +135,7 @@ class FastCluster:
         self._lib = _native.LIB
         if self._lib is not None:
             self._req_cache: Dict[PodRequest, tuple] = {}
+            self._bucket_cache: Dict[int, tuple] = {}
             self._out_cores = np.zeros(self.L + 8, np.int32)
             self._out_counts = np.zeros(64, np.int32)
             self._out_gpus = np.zeros(max(GM, 1), np.int32)
@@ -151,6 +152,165 @@ class FastCluster:
     def _row_addr(self, name: str, n: int) -> int:
         base, stride = self._addr[name]
         return base + n * stride
+
+    # ------------------------------------------------------------------
+    # round-level native assignment
+    # ------------------------------------------------------------------
+
+    def round_supported(self) -> bool:
+        return self._lib is not None and self.arrays is not None
+
+    def round_ok_for(self, pods) -> bool:
+        """Bucket within the native round call's fixed-buffer limits
+        (mirrors the -100 guard in nhd_assign_round); callers fall back to
+        the per-pod path otherwise."""
+        return (
+            self.round_supported()
+            and pods.G <= 16
+            and self.L <= 4096
+            and self.gpu_used.shape[1] <= 512
+        )
+
+    def _bucket_arrays(self, pods) -> tuple:
+        """[T, G] raw demand arrays for a bucket (cached across rounds —
+        dataclasses.replace shares the underlying requests list)."""
+        key = id(pods.requests)
+        got = self._bucket_cache.get(key)
+        if got is not None:
+            return got
+        T, G = len(pods.requests), pods.G
+        t_proc = np.zeros((T, G), np.int32)
+        t_proc_smt = np.zeros((T, G), np.int32)
+        t_help = np.zeros((T, G), np.int32)
+        t_help_smt = np.zeros((T, G), np.int32)
+        t_gpus = np.zeros((T, G), np.int32)
+        t_misc = np.zeros(T, np.int32)
+        t_misc_smt = np.zeros(T, np.int32)
+        for t, r in enumerate(pods.requests):
+            for g, grp in enumerate(r.groups):
+                t_proc[t, g] = grp.proc.count
+                t_proc_smt[t, g] = int(grp.proc.smt)
+                t_help[t, g] = grp.misc.count
+                t_help_smt[t, g] = int(grp.misc.smt)
+                t_gpus[t, g] = grp.gpus
+            t_misc[t] = r.misc.count
+            t_misc_smt[t] = int(r.misc.smt)
+        maxc = int((t_proc.sum(1) + t_help.sum(1) + t_misc).max(initial=1)) + 2
+        gmx = max(int(t_gpus.sum(1).max(initial=0)), 1)
+        got = (t_proc, t_proc_smt, t_help, t_help_smt, t_gpus,
+               t_misc, t_misc_smt, maxc, gmx)
+        self._bucket_cache[key] = got
+        return got
+
+    def assign_round(self, pods, w_node, w_type, w_c, w_m, w_a, *,
+                     set_busy: bool):
+        """Place one round's winners in a single native call; returns
+        (status[W], cores[W,MAXC], counts[W,2G+1], nic_flat[W,G], gpus[W,GMX]).
+
+        Mutates occupancy AND the attached solver ClusterArrays exactly as
+        per-pod assign + _update_arrays would (parity-tested)."""
+        from nhd_tpu.core.node import ENABLE_NIC_SHARING
+
+        (t_proc, t_proc_smt, t_help, t_help_smt, t_gpus,
+         t_misc, t_misc_smt, maxc, gmx) = self._bucket_arrays(pods)
+        G = pods.G
+        W = len(w_node)
+        a = self.arrays
+        d = lambda arr: arr.ctypes.data
+        status = np.zeros(W, np.int32)
+        out_cores = np.zeros((W, maxc), np.int32)
+        out_counts = np.zeros((W, 2 * G + 1), np.int32)
+        out_nic = np.zeros((W, max(G, 1)), np.int32)
+        out_gpus = np.zeros((W, gmx), np.int32)
+        t_pci = pods.map_pci.astype(np.uint8)
+
+        rc = self._lib.nhd_assign_round(
+            d(self.core_used), d(self.core_socket), d(self.phys),
+            d(self.smt), self.L,
+            d(self.gpu_used), d(self.gpu_numa), d(self.gpu_sw),
+            d(self.gpu_sw_dense), d(self.n_gpus), self.gpu_used.shape[1],
+            d(self.nic_flat), d(self.nic_sw), d(self.nic_rx_used),
+            d(self.nic_tx_used), d(self.nic_pods), d(self.nic_cap),
+            self.U, self.K,
+            d(self.hp_free),
+            d(a.cpu_free), d(a.gpu_free), d(a.gpu_free_sw), d(a.nic_free),
+            d(a.hp_free), d(a.busy), a.gpu_free_sw.shape[1],
+            int(set_busy), int(ENABLE_NIC_SHARING),
+            G, d(t_proc), d(t_proc_smt), d(t_help), d(t_help_smt),
+            d(t_gpus), d(pods.rx), d(pods.tx), d(t_misc), d(t_misc_smt),
+            d(pods.hp), d(t_pci),
+            W, d(w_node), d(w_type), d(w_c), d(w_m), d(w_a),
+            d(status), d(out_cores), d(out_counts), d(out_nic), d(out_gpus),
+            maxc, gmx,
+        )
+        if rc != 0:
+            raise FastAssignError(f"native round call failed: rc={rc}")
+        self._touched.update(int(n) for n in w_node)
+        return status, out_cores, out_counts, out_nic, out_gpus
+
+    def nic_list_from_round(self, pods, w, t, buffers) -> List[Tuple[int, float, NicDir]]:
+        """Consumed-NIC list for winner ``w`` (cheap; no record needed)."""
+        out_nic = buffers[3]
+        out = []
+        for g, grp in enumerate(pods.requests[t].groups):
+            flat = int(out_nic[w, g])
+            if grp.nic_rx_gbps > 0:
+                out.append((flat, grp.nic_rx_gbps, NicDir.RX))
+            if grp.nic_tx_gbps > 0:
+                out.append((flat, grp.nic_tx_gbps, NicDir.TX))
+        return out
+
+    def _build_record(
+        self, n, req, cores_row, counts_row, gpu_rows_flat, nic_flats
+    ) -> AssignRecord:
+        """Unpack flat assignment buffers (one pod's worth — identical
+        layout for the per-pod and round-level native calls) into an
+        AssignRecord. Single definition keeps both paths bit-identical."""
+        node = self.node_objs[n]
+        rec = AssignRecord(
+            node_index=n, node_name=self.names[n],
+            data_vlan=node.data_vlan, gwip=node.gwip,
+        )
+        cores_at = 0
+        gpus_at = 0
+        for g, grp in enumerate(req.groups):
+            n_proc = int(counts_row[2 * g])
+            n_help = int(counts_row[2 * g + 1])
+            group_cpus = [int(c) for c in cores_row[cores_at : cores_at + n_proc]]
+            cores_at += n_proc
+            helpers = [int(c) for c in cores_row[cores_at : cores_at + n_help]]
+            cores_at += n_help
+            gpu_rows = [int(gpu_rows_flat[gpus_at + j]) for j in range(grp.gpus)]
+            gpus_at += grp.gpus
+            flat = int(nic_flats[g])
+            uk = (-1, -1)
+            mac = ""
+            if flat >= 0:
+                nic = node.nics[flat]
+                uk = (nic.numa_node, nic.idx)
+                mac = nic.mac
+            rec.groups.append(
+                GroupAssignment(
+                    uk[0], group_cpus, helpers,
+                    [int(self.gpu_devid[n, j]) for j in gpu_rows],
+                    uk, flat, mac, gpu_rows,
+                )
+            )
+            if grp.nic_rx_gbps > 0:
+                rec.nic_list.append((flat, grp.nic_rx_gbps, NicDir.RX))
+            if grp.nic_tx_gbps > 0:
+                rec.nic_list.append((flat, grp.nic_tx_gbps, NicDir.TX))
+        n_misc = int(counts_row[2 * req.n_groups])
+        rec.misc_cpus = [int(c) for c in cores_row[cores_at : cores_at + n_misc]]
+        return rec
+
+    def record_from_round(self, pods, w, n, t, buffers) -> AssignRecord:
+        """Materialize an AssignRecord for winner ``w`` from round buffers."""
+        _, out_cores, out_counts, out_nic, out_gpus = buffers
+        return self._build_record(
+            n, pods.requests[t], out_cores[w], out_counts[w],
+            out_gpus[w], out_nic[w],
+        )
 
     # ------------------------------------------------------------------
 
@@ -305,14 +465,14 @@ class FastCluster:
             self.nic_rx_used[n, u, k] += add
         for (u, k), add in nic_tx_add.items():
             self.nic_tx_used[n, u, k] += add
-        for ga in rec.groups:
-            if ga.nic_flat >= 0:
-                rx = nic_rx_add.get(ga.nic_uk, 0.0)
-                tx = nic_tx_add.get(ga.nic_uk, 0.0)
-                if rx:
-                    rec.nic_list.append((ga.nic_flat, rx, NicDir.RX))
-                if tx:
-                    rec.nic_list.append((ga.nic_flat, tx, NicDir.TX))
+        if not rec.nic_list:  # _build_record-produced records arrive filled
+            for ga, g in zip(rec.groups, req.groups):
+                if ga.nic_flat < 0:
+                    continue
+                if g.nic_rx_gbps > 0:
+                    rec.nic_list.append((ga.nic_flat, g.nic_rx_gbps, NicDir.RX))
+                if g.nic_tx_gbps > 0:
+                    rec.nic_list.append((ga.nic_flat, g.nic_tx_gbps, NicDir.TX))
         # only NICs actually serving rx/tx cores are claimed — a zero-
         # bandwidth group's mapped NIC stays free (the reference's nic_list
         # only carries NIC-serving cores, NHDScheduler.py:302-304)

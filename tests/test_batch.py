@@ -217,3 +217,23 @@ def test_device_state_path_equivalent():
 
     with pytest.raises(ValueError):
         BatchScheduler(device_state="true")
+
+
+def test_headless_round_path_preserves_busy_and_niclist():
+    """register_pods=False + no topologies (the benchmark shape): scheduled
+    pods must still stamp their nodes busy on the HostNode mirror and carry
+    a consumed-NIC list."""
+    nodes = make_cluster(2)
+    reqs = [simple_request(gpus=1) for _ in range(2)]
+    sched = BatchScheduler(respect_busy=True, register_pods=False)
+    results, _ = sched.schedule(nodes, items(reqs), now=1000.0)
+    placed = [r for r in results if r.node]
+    assert len(placed) == 2
+    for r in placed:
+        assert r.nic_list, "consumed-NIC list missing in headless mode"
+        assert nodes[r.node].is_busy(now=1010.0), "node not stamped busy"
+    # a second GPU batch inside the busy window schedules nothing
+    results2, _ = sched.schedule(
+        nodes, [BatchItem(("ns", "late"), simple_request(gpus=1))], now=1010.0
+    )
+    assert results2[0].node is None
