@@ -84,7 +84,7 @@ def run_batch(nodes, reqs, *, warm: bool = True):
     results, stats = sched.schedule(nodes, items, now=0.0)
     wall = time.perf_counter() - t0
     placed = sum(1 for r in results if r.node)
-    return wall, placed, stats
+    return wall, placed, stats, results
 
 
 def run_serial_baseline(nodes, reqs, sample: int):
@@ -110,7 +110,7 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40):
     from nhd_tpu.sim.workloads import bench_cluster, workload_mix
 
     reqs = workload_mix(n_pods, groups)
-    wall, placed, stats = run_batch(bench_cluster(n_nodes, groups), reqs)
+    wall, placed, stats, results = run_batch(bench_cluster(n_nodes, groups), reqs)
 
     per_pod = run_serial_baseline(bench_cluster(n_nodes, groups), reqs,
                                   baseline_sample)
@@ -120,7 +120,8 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40):
         f"bench[{name}]: {n_pods} pods x {n_nodes} nodes -> "
         f"placed {placed} in {wall:.3f}s ({placed / wall:.0f} pods/s, "
         f"rounds={stats.rounds}, solve={stats.solve_seconds:.3f}s, "
-        f"select={stats.select_seconds:.3f}s, assign={stats.assign_seconds:.3f}s); "
+        f"select={stats.select_seconds:.3f}s, assign={stats.assign_seconds:.3f}s, "
+        f"p99 bind {stats.bind_latency_percentile(results, 99) * 1e3:.0f}ms); "
         f"serial baseline {per_pod * 1e3:.2f} ms/pod -> est {baseline_wall:.1f}s; "
         f"speedup {speedup:.0f}x"
     )

@@ -95,6 +95,20 @@ class BatchStats:
     assign_seconds: float = 0.0
     scheduled: int = 0
     failed: int = 0
+    # elapsed seconds from batch start to the end of each round — a pod
+    # placed in round r has bind latency <= round_end_seconds[r]
+    round_end_seconds: List[float] = field(default_factory=list)
+
+    def bind_latency_percentile(self, results, q: float) -> float:
+        """p-th percentile bind latency over placed pods (seconds)."""
+        lats = sorted(
+            self.round_end_seconds[r.round_no]
+            for r in results
+            if r.node is not None and 0 <= r.round_no < len(self.round_end_seconds)
+        )
+        if not lats:
+            return 0.0
+        return lats[min(int(len(lats) * q / 100.0), len(lats) - 1)]
 
 
 class BatchScheduler:
@@ -241,6 +255,7 @@ class BatchScheduler:
         all_buckets = None
         is_pending = None
 
+        t_batch = time.perf_counter()
         for round_no in range(self.max_rounds):
             if not pending:
                 break
@@ -383,6 +398,7 @@ class BatchScheduler:
                 if dev is not None:
                     dev.update_rows(node_claimed.keys())
                 stats.assign_seconds += time.perf_counter() - t0
+                stats.round_end_seconds.append(time.perf_counter() - t_batch)
                 done = set(newly_scheduled)
                 pending = [i for i in pending if i not in done]
                 continue
@@ -471,6 +487,7 @@ class BatchScheduler:
             if dev is not None and apply:
                 dev.update_rows(node_claimed.keys())
             stats.assign_seconds += time.perf_counter() - t0
+            stats.round_end_seconds.append(time.perf_counter() - t_batch)
 
             done = set(newly_scheduled)
             pending = [i for i in pending if i not in done]
