@@ -157,6 +157,78 @@ static inline int phys_cores(int count, int smt_req, int node_smt) {
   return (node_smt && smt_req) ? (count + 1) / 2 : count;
 }
 
+// Select the first NIC pick (product order, matching the solver/oracle
+// tie-break) feasible against LIVE per-NIC state. Needed because several
+// winners may share a node in one round: the solver's snapshot pick can be
+// consumed by an earlier claim. In PCI map mode the pick also has to admit
+// the GPU assignment (each GPU must come off the chosen NIC's PCIe switch),
+// so that leg is simulated too. Returns the pick index, or -1.
+static int select_pick(int G, int U, int K, const int* numa_of,
+                       const int32_t* nic_flat, const int64_t* nic_sw,
+                       const float* rx_dem, const float* tx_dem,
+                       const double* nic_cap, const double* nic_rx_used,
+                       const double* nic_tx_used, const int32_t* nic_pods,
+                       int enable_sharing, int pci_mode,
+                       const uint8_t* gpu_used, const int8_t* gpu_numa,
+                       const int64_t* gpu_sw, int n_gpus,
+                       const int32_t* gpus_dem, int* pick_out) {
+  long A = 1;
+  for (int g = 0; g < G; ++g) A *= K;
+  double joint_rx[128], joint_tx[128];
+  uint8_t gpu_sim[512];
+  for (long a = 0; a < A; ++a) {
+    // decode digits, check ordinal existence
+    int pick[16];
+    {
+      long v = a;
+      for (int g = G - 1; g >= 0; --g) { pick[g] = (int)(v % K); v /= K; }
+    }
+    int ok = 1;
+    for (int g = 0; g < G && ok; ++g)
+      if (nic_flat[numa_of[g] * K + pick[g]] < 0) ok = 0;
+    if (!ok) continue;
+    // joint demand per (numa, nic)
+    for (int i = 0; i < U * K; ++i) { joint_rx[i] = 0.0; joint_tx[i] = 0.0; }
+    for (int g = 0; g < G; ++g) {
+      const int uk = numa_of[g] * K + pick[g];
+      joint_rx[uk] += rx_dem[g];
+      joint_tx[uk] += tx_dem[g];
+    }
+    for (int i = 0; i < U * K && ok; ++i) {
+      if (joint_rx[i] <= 0.0 && joint_tx[i] <= 0.0) continue;
+      double free_rx, free_tx;
+      if (enable_sharing) {
+        free_rx = nic_cap[i] - nic_rx_used[i];
+        free_tx = nic_cap[i] - nic_tx_used[i];
+      } else if (nic_pods[i] > 0) {
+        free_rx = 0.0; free_tx = 0.0;
+      } else {
+        free_rx = nic_cap[i]; free_tx = nic_cap[i];
+      }
+      if (joint_rx[i] > free_rx || joint_tx[i] > free_tx) ok = 0;
+    }
+    if (ok && pci_mode) {
+      // PCI mode: every GPU must come off the chosen NIC's switch —
+      // simulate the sequential picks so the assignment cannot dead-end
+      for (int i = 0; i < n_gpus; ++i) gpu_sim[i] = gpu_used[i];
+      for (int g = 0; g < G && ok; ++g) {
+        const int uk = numa_of[g] * K + pick[g];
+        for (int j = 0; j < gpus_dem[g] && ok; ++j) {
+          int gi = pick_gpu(gpu_sim, gpu_numa, gpu_sw, n_gpus, nic_sw[uk],
+                            numa_of[g], 1);
+          if (gi < 0) ok = 0;
+          else gpu_sim[gi] = 1;
+        }
+      }
+    }
+    if (ok) {
+      for (int g = 0; g < G; ++g) pick_out[g] = pick[g];
+      return (int)a;
+    }
+  }
+  return -1;
+}
+
 int nhd_assign_round(
     // FastCluster occupancy (mutated)
     uint8_t* core_used_all, const int8_t* core_socket_all,
@@ -179,16 +251,17 @@ int nhd_assign_round(
     const int32_t* t_misc_smt, const int32_t* t_hp, const uint8_t* t_pci,
     // winners
     int W, const int32_t* w_node, const int32_t* w_type, const int32_t* w_c,
-    const int32_t* w_m, const int32_t* w_a,
-    // outputs ([W, MAXC] / [W, 2G+1] / [W, G] / [W, GMX])
+    const int32_t* w_m,
+    // outputs ([W, MAXC] / [W, 2G+1] / [W, G] / [W, GMX] / [W])
     int32_t* out_status, int32_t* out_cores, int32_t* out_counts,
-    int32_t* out_nic_flat, int32_t* out_gpus, int MAXC, int GMX) {
+    int32_t* out_nic_flat, int32_t* out_gpus, int32_t* out_pick,
+    int MAXC, int GMX) {
   const int UK = U * K;
   uint8_t core_overlay[4096];
   uint8_t gpu_overlay[512];
   // size guards — the Python caller (round_ok_for) checks the same limits
   // and falls back to the per-pod path; this is defense in depth
-  if (L > 4096 || GM > 512 || G > 16) return -100;
+  if (L > 4096 || GM > 512 || G > 16 || UK > 128) return -100;
 
   for (int w = 0; w < W; ++w) {
     const int n = w_node[w];
@@ -212,17 +285,40 @@ int nhd_assign_round(
 
     if (t_hp[t] > hp_free_all[n]) { out_status[w] = -5; continue; }
 
+    // multiple winners may share a node this round: a GPU pod arriving
+    // after the node was stamped busy within the round is retryable
+    // (-8); the snapshot-busy case never reaches here (solver filters it)
+    if (set_busy && busy_all[n]) {
+      int any_gpu = 0;
+      for (int g = 0; g < G; ++g)
+        if (t_gpus[(size_t)t * G + g] > 0) any_gpu = 1;
+      if (any_gpu) { out_status[w] = -8; continue; }
+    }
+
     for (int i = 0; i < L; ++i) core_overlay[i] = core_used[i];
     for (int i = 0; i < GM; ++i) gpu_overlay[i] = gpu_used[i];
 
-    // decode combo/pick digits
+    // decode the combo; re-select the NIC pick against live state (the
+    // solver's pick is a snapshot an earlier same-node winner may have
+    // consumed)
     int numa_of[16], pick_of[16];
     {
-      int c = w_c[w], a = w_a[w];
-      for (int g = G - 1; g >= 0; --g) {
-        numa_of[g] = c % U; c /= U;
-        pick_of[g] = a % K; a /= K;
-      }
+      int c = w_c[w];
+      for (int g = G - 1; g >= 0; --g) { numa_of[g] = c % U; c /= U; }
+    }
+    {
+      const double* nic_cap = nic_cap_all + (size_t)n * UK;
+      const double* rx_used = nic_rx_used_all + (size_t)n * UK;
+      const double* tx_used = nic_tx_used_all + (size_t)n * UK;
+      const int32_t* pods_used = nic_pods_all + (size_t)n * UK;
+      int a = select_pick(G, U, K, numa_of, nic_flat, nic_sw,
+                          t_rx + (size_t)t * G, t_tx + (size_t)t * G,
+                          nic_cap, rx_used, tx_used, pods_used,
+                          enable_sharing, t_pci[t],
+                          gpu_used, gpu_numa, gpu_sw, n_gpus,
+                          t_gpus + (size_t)t * G, pick_of);
+      if (a < 0) { out_status[w] = -7; continue; }
+      out_pick[w] = a;
     }
 
     int status = 0, cores_at = 0, gpus_at = 0;

@@ -81,8 +81,9 @@ def _load() -> Optional[ctypes.CDLL]:
                                        # nic_free, hp_free32, busy, S,
                                        # set_busy, enable_sharing
         + [i, p, p, p, p, p, p, p, p, p, p, p]  # G + 11 type arrays
-        + [i, p, p, p, p, p]     # W, w_node/type/c/m/a
-        + [p, p, p, p, p, i, i]  # out status/cores/counts/nic/gpus, MAXC, GMX
+        + [i, p, p, p, p]        # W, w_node/type/c/m
+        + [p, p, p, p, p, p, i, i]  # out status/cores/counts/nic/gpus/pick,
+                                    # MAXC, GMX
     )
     return lib
 

@@ -54,7 +54,7 @@ def get_sharded_solver(n_groups: int, n_numa: int, max_nic: int, mesh: Mesh):
     return jax.jit(
         fn,
         in_shardings=in_shardings,
-        out_shardings=SolveOut(*([out_sharding] * 6)),
+        out_shardings=SolveOut(*([out_sharding] * len(SolveOut._fields))),
     )
 
 

@@ -75,7 +75,9 @@ class BatchAssignment:
 
 from collections import namedtuple
 
-SolveHost = namedtuple("SolveHost", "cand pref best_c best_m best_a n_combos")
+SolveHost = namedtuple(
+    "SolveHost", "cand pref best_c best_m best_a n_combos n_picks"
+)
 
 
 def _accelerator_backend() -> bool:
@@ -108,7 +110,9 @@ class BatchStats:
         )
         if not lats:
             return 0.0
-        return lats[min(int(len(lats) * q / 100.0), len(lats) - 1)]
+        # nearest-rank percentile: ceil(q/100 * n) - 1
+        rank = max(0, -(-int(q * len(lats)) // 100) - 1)
+        return lats[min(rank, len(lats) - 1)]
 
 
 class BatchScheduler:
@@ -144,6 +148,47 @@ class BatchScheduler:
                 f"device_state must be True, False or 'auto', got {device_state!r}"
             )
         self.device_state = device_state
+
+    def _capacity_estimate(self, cluster, pods, out) -> np.ndarray:
+        """Optimistic copies-per-node estimate cap[T, N] for one round.
+
+        Built from node-total aggregates (cheap, may overestimate — the
+        assignment re-verifies and stale claims retry; underestimates only
+        cost extra rounds): feasible NIC picks at the best combo, total free
+        GPUs / cores / hugepages over per-pod demand. GPU pods cap at 1 per
+        node whenever the busy back-off applies (reference: one placement
+        per node per window, Matcher.py:103-111).
+        """
+        INF = np.int32(1 << 30)
+        cap = np.where(out.cand, np.maximum(out.n_picks, 1), 0).astype(np.int64)
+
+        gpus_tot = pods.gpu_dem.sum(axis=1)
+        free_gpu = cluster.gpu_free.sum(axis=1)
+        with np.errstate(divide="ignore"):
+            gpu_cap = np.where(
+                gpus_tot[:, None] > 0,
+                free_gpu[None, :] // np.maximum(gpus_tot, 1)[:, None],
+                INF,
+            )
+        cpu_tot = np.minimum(
+            pods.cpu_dem_smt.sum(axis=1), pods.cpu_dem_raw.sum(axis=1)
+        )
+        free_cpu = cluster.cpu_free.sum(axis=1)
+        cpu_cap = np.where(
+            cpu_tot[:, None] > 0,
+            free_cpu[None, :] // np.maximum(cpu_tot, 1)[:, None],
+            INF,
+        )
+        hp_cap = np.where(
+            pods.hp[:, None] > 0,
+            cluster.hp_free[None, :] // np.maximum(pods.hp, 1)[:, None],
+            INF,
+        )
+        cap = np.minimum(cap, np.minimum(gpu_cap, np.minimum(cpu_cap, hp_cap)))
+        if self.respect_busy:
+            cap = np.where(pods.needs_gpu[:, None], np.minimum(cap, 1), cap)
+        cap = np.where(out.cand, np.maximum(cap, 1), 0)
+        return cap
 
     def _schedule_serial(
         self, nodes, items, indices, results, stats, now, apply
@@ -274,8 +319,8 @@ class BatchScheduler:
             is_pending[:] = False
             is_pending[pending] = True
 
-            # pod index → (node index, bucket G, type) chosen this round
-            claims: Dict[int, Tuple[int, int, int]] = {}
+            # (pod index, node index, bucket G, type) chosen this round
+            claims: List[Tuple[int, int, int, int]] = []
             bucket_out = {}
             for G, full in all_buckets.items():
                 mask = is_pending[full.pod_index]
@@ -293,7 +338,7 @@ class BatchScheduler:
             stats.solve_seconds += time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            node_claimed: Dict[int, int] = {}  # node index → pod index
+            node_claimed: Dict[int, int] = {}  # node index → claims this round
             for G, (pods, out) in bucket_out.items():
                 cand = out.cand
                 pref = out.pref
@@ -308,30 +353,39 @@ class BatchScheduler:
                 if not apply:
                     # dry-run: every pod reports its own snapshot match (the
                     # reference's FindNode answer), with no contention model —
-                    # a conflict "loser" here would wrongly read as
-                    # unschedulable when capacity exists elsewhere
+                    # a conflict "loser" would wrongly read as unschedulable
                     for t, pod_i in zip(pods.pod_type, pods.pod_index):
                         t = int(t)
                         if n_cands[t] > 0:
-                            claims[int(pod_i)] = (int(order[t, 0]), G, t)
+                            claims.append((int(pod_i), int(order[t, 0]), G, t))
                     continue
 
-                # fan pods of one type across its candidates by rank
-                rank_in_type: Dict[int, int] = {}
+                # capacity-aware packing (the reference's first-fit shape):
+                # each type fills its best candidate up to an optimistic
+                # per-node capacity estimate before moving on — claims are
+                # re-verified against live state at assignment, so an
+                # overestimate just costs a retry
+                cap = self._capacity_estimate(cluster, pods, out)
+                cursor: Dict[int, list] = {}   # type → [rank, used_on_rank]
                 for t, pod_i in zip(pods.pod_type, pods.pod_index):
                     t = int(t)
-                    r = rank_in_type.get(t, 0)
-                    if r >= n_cands[t]:
-                        continue  # no node left for this pod this round
-                    rank_in_type[t] = r + 1
-                    n = int(order[t, r])
-                    pod_i = int(pod_i)
-                    prev = node_claimed.get(n)
-                    if prev is None or pod_i < prev:
-                        if prev is not None:
-                            claims.pop(prev)
-                        node_claimed[n] = pod_i
-                        claims[pod_i] = (n, G, t)
+                    cur = cursor.setdefault(t, [0, 0])
+                    while cur[0] < n_cands[t]:
+                        n = int(order[t, cur[0]])
+                        if cur[1] < cap[t, n]:
+                            cur[1] += 1
+                            node_claimed[n] = node_claimed.get(n, 0) + 1
+                            claims.append((int(pod_i), n, G, t))
+                            break
+                        cur[0] += 1
+                        cur[1] = 0
+            # assignment order = pod index order: per node this is a valid
+            # sequential execution (claims re-verified as they apply); the
+            # first claim a node actually processes ran against fresh
+            # feasibility, so its failure is final — later same-node
+            # failures are stale contention and retry next round
+            claims.sort()
+            applied_on_node: set = set()
             stats.select_seconds += time.perf_counter() - t0
 
             if not claims:
@@ -352,7 +406,7 @@ class BatchScheduler:
                 # one native call places every winner of the round
                 # (native/nhd_assign.cc::nhd_assign_round)
                 by_bucket: Dict[int, List[Tuple[int, int, int]]] = {}
-                for pod_i, (n, G, t) in claims.items():
+                for pod_i, n, G, t in claims:
                     by_bucket.setdefault(G, []).append((pod_i, n, t))
                 for G, winners in by_bucket.items():
                     pods, out = bucket_out[G]
@@ -360,26 +414,33 @@ class BatchScheduler:
                     w_type = np.asarray([w[2] for w in winners], np.int32)
                     w_c = np.ascontiguousarray(out.best_c[w_type, w_node], np.int32)
                     w_m = np.ascontiguousarray(out.best_m[w_type, w_node], np.int32)
-                    w_a = np.ascontiguousarray(out.best_a[w_type, w_node], np.int32)
                     buffers = fast.assign_round(
-                        pods, w_node, w_type, w_c, w_m, w_a,
+                        pods, w_node, w_type, w_c, w_m,
                         set_busy=self.respect_busy,
                     )
                     status = buffers[0]
+                    picks = buffers[5]
                     for w, (pod_i, n, t) in enumerate(winners):
                         item = items[pod_i]
-                        newly_scheduled.append(pod_i)
+                        is_first = n not in applied_on_node
+                        applied_on_node.add(n)
                         if status[w] < 0:
+                            if not is_first:
+                                continue  # stale same-node claim: retry
                             self.logger.error(
                                 f"assignment failed for {item.key} on "
                                 f"{cluster.names[n]}: stage {int(status[w])}"
                             )
                             results[pod_i] = BatchAssignment(item.key, None)
+                            newly_scheduled.append(pod_i)
                             stats.failed += 1
                             continue
+                        newly_scheduled.append(pod_i)
+                        # the NIC pick is re-selected against live state in
+                        # the native call — decode the actual choice
                         mapping = decode_mapping(
                             G, cluster.U, cluster.K,
-                            int(w_c[w]), int(w_m[w]), int(w_a[w]),
+                            int(w_c[w]), int(w_m[w]), int(picks[w]),
                         )
                         if item.topology is not None or self.register_pods:
                             rec = fast.record_from_round(pods, w, n, t, buffers)
@@ -403,7 +464,7 @@ class BatchScheduler:
                 pending = [i for i in pending if i not in done]
                 continue
 
-            for pod_i, (n, G, t) in claims.items():
+            for pod_i, n, G, t in claims:
                 pods, out = bucket_out[G]
                 mapping = decode_mapping(
                     G, cluster.U, cluster.K,
@@ -420,10 +481,25 @@ class BatchScheduler:
                     newly_scheduled.append(pod_i)
                     continue
 
+                if (
+                    self.respect_busy
+                    and item.request.needs_gpu
+                    and cluster.busy[n]
+                ):
+                    # node took a placement earlier this round (snapshot-busy
+                    # nodes are never selected for GPU pods): defer, like the
+                    # native round path's -8 (reference: Matcher.py:103-111)
+                    continue
+
+                is_first = n not in applied_on_node
+                applied_on_node.add(n)
+
                 if fast is not None:
                     try:
                         rec = fast.assign(n, mapping, item.request)
                     except FastAssignError as exc:
+                        if not is_first:
+                            continue  # stale same-node claim: retry
                         self.logger.error(
                             f"assignment failed for {item.key} on {node.name}: {exc}"
                         )
@@ -435,8 +511,15 @@ class BatchScheduler:
                     busy_nodes.add(n)
                     if self.respect_busy:
                         cluster.busy[n] = True
+                    # report the realized NIC picks (assign may re-select
+                    # against live state under multi-claim)
+                    realized = {
+                        "gpu": mapping["gpu"],
+                        "cpu": mapping["cpu"],
+                        "nic": tuple(ga.nic_uk for ga in rec.groups),
+                    }
                     results[pod_i] = BatchAssignment(
-                        item.key, node.name, mapping, rec.nic_list, round_no
+                        item.key, node.name, realized, rec.nic_list, round_no
                     )
                     newly_scheduled.append(pod_i)
                     stats.scheduled += 1
@@ -457,6 +540,8 @@ class BatchScheduler:
                 try:
                     nic_list = node.assign_physical_ids(mapping, top)
                 except AssignmentError as exc:
+                    if not is_first:
+                        continue  # stale same-node claim: retry
                     # promised mapping didn't materialize (PCI quirk etc.):
                     # fail the pod like the reference (NHDScheduler.py:296-299)
                     self.logger.error(
@@ -469,6 +554,8 @@ class BatchScheduler:
                 nidx = sorted({x[0] for x in nic_list})
                 node.claim_nic_pods(nidx)
                 node.add_scheduled_pod(item.key[1], item.key[0], top)
+                if self.respect_busy:
+                    cluster.busy[n] = True
                 results[pod_i] = BatchAssignment(
                     item.key, node.name, mapping, nic_list, round_no
                 )

@@ -169,6 +169,7 @@ class FastCluster:
             and pods.G <= 16
             and self.L <= 4096
             and self.gpu_used.shape[1] <= 512
+            and self.U * self.K <= 128
         )
 
     def _bucket_arrays(self, pods) -> tuple:
@@ -202,7 +203,7 @@ class FastCluster:
         self._bucket_cache[key] = got
         return got
 
-    def assign_round(self, pods, w_node, w_type, w_c, w_m, w_a, *,
+    def assign_round(self, pods, w_node, w_type, w_c, w_m, *,
                      set_busy: bool):
         """Place one round's winners in a single native call; returns
         (status[W], cores[W,MAXC], counts[W,2G+1], nic_flat[W,G], gpus[W,GMX]).
@@ -222,6 +223,7 @@ class FastCluster:
         out_counts = np.zeros((W, 2 * G + 1), np.int32)
         out_nic = np.zeros((W, max(G, 1)), np.int32)
         out_gpus = np.zeros((W, gmx), np.int32)
+        out_pick = np.zeros(W, np.int32)
         t_pci = pods.map_pci.astype(np.uint8)
 
         rc = self._lib.nhd_assign_round(
@@ -239,14 +241,14 @@ class FastCluster:
             G, d(t_proc), d(t_proc_smt), d(t_help), d(t_help_smt),
             d(t_gpus), d(pods.rx), d(pods.tx), d(t_misc), d(t_misc_smt),
             d(pods.hp), d(t_pci),
-            W, d(w_node), d(w_type), d(w_c), d(w_m), d(w_a),
+            W, d(w_node), d(w_type), d(w_c), d(w_m),
             d(status), d(out_cores), d(out_counts), d(out_nic), d(out_gpus),
-            maxc, gmx,
+            d(out_pick), maxc, gmx,
         )
         if rc != 0:
             raise FastAssignError(f"native round call failed: rc={rc}")
         self._touched.update(int(n) for n in w_node)
-        return status, out_cores, out_counts, out_nic, out_gpus
+        return status, out_cores, out_counts, out_nic, out_gpus, out_pick
 
     def nic_list_from_round(self, pods, w, t, buffers) -> List[Tuple[int, float, NicDir]]:
         """Consumed-NIC list for winner ``w`` (cheap; no record needed)."""
@@ -306,7 +308,7 @@ class FastCluster:
 
     def record_from_round(self, pods, w, n, t, buffers) -> AssignRecord:
         """Materialize an AssignRecord for winner ``w`` from round buffers."""
-        _, out_cores, out_counts, out_nic, out_gpus = buffers
+        out_cores, out_counts, out_nic, out_gpus = buffers[1:5]
         return self._build_record(
             n, pods.requests[t], out_cores[w], out_counts[w],
             out_gpus[w], out_nic[w],
@@ -367,13 +369,85 @@ class FastCluster:
 
     # ------------------------------------------------------------------
 
+    def _reselect_picks(self, n: int, combo, req: PodRequest):
+        """First NIC pick (product order) feasible against LIVE state — the
+        mapping's pick is a solve-time snapshot that an earlier claim on the
+        same node may have consumed (mirrors select_pick in the C core).
+        Returns per-group ordinals, or None."""
+        from nhd_tpu.core.node import ENABLE_NIC_SHARING
+        from nhd_tpu.solver.combos import get_tables
+
+        G = req.n_groups
+        if G == 0:
+            return ()
+        bw = req.nic_bw()
+        for pick in get_tables(G, self.U, self.K).pick:
+            ok = True
+            joint: Dict[Tuple[int, int], List[float]] = {}
+            for g in range(G):
+                u, k = int(combo[g]), int(pick[g])
+                if self.nic_flat[n, u, k] < 0:
+                    ok = False
+                    break
+                acc = joint.setdefault((u, k), [0.0, 0.0])
+                acc[0] += bw[g][0]
+                acc[1] += bw[g][1]
+            if not ok:
+                continue
+            for (u, k), (rx, tx) in joint.items():
+                if rx <= 0 and tx <= 0:
+                    continue
+                if ENABLE_NIC_SHARING:
+                    free_rx = self.nic_cap[n, u, k] - self.nic_rx_used[n, u, k]
+                    free_tx = self.nic_cap[n, u, k] - self.nic_tx_used[n, u, k]
+                elif self.nic_pods[n, u, k] > 0:
+                    free_rx = free_tx = 0.0
+                else:
+                    free_rx = free_tx = self.nic_cap[n, u, k]
+                if rx > free_rx or tx > free_tx:
+                    ok = False
+                    break
+            if ok and req.map_mode == MapMode.PCI:
+                # PCI mode: the pick must also admit the GPU assignment
+                # (every GPU off the chosen NIC's switch) — simulate it
+                gpu_sim = self.gpu_used[n].copy()
+                for g in range(G):
+                    if not ok:
+                        break
+                    u, k = int(combo[g]), int(pick[g])
+                    for _ in range(req.groups[g].gpus):
+                        j = self._pick_gpu(
+                            gpu_sim, n, int(self.nic_sw[n, u, k]),
+                            int(combo[g]), True,
+                        )
+                        if j is None:
+                            ok = False
+                            break
+                        gpu_sim[j] = True
+            if ok:
+                return tuple(int(p) for p in pick)
+        return None
+
     def assign(
         self, n: int, mapping: Dict[str, tuple], req: PodRequest
     ) -> AssignRecord:
         """Resolve and commit one pod's physical assignment on node row n.
 
-        Raises FastAssignError with no state change when any pick fails.
+        The NIC pick is re-selected against live state (multi-claim rounds
+        can consume the solve-time pick); the realized choice is visible in
+        the returned record's nic_uk fields. Raises FastAssignError with no
+        state change when any pick fails.
         """
+        picks = self._reselect_picks(n, mapping["gpu"], req)
+        if picks is None:
+            raise FastAssignError(
+                f"no feasible NIC pick on {self.names[n]} (stale claim)"
+            )
+        mapping = {
+            "gpu": mapping["gpu"],
+            "cpu": mapping["cpu"],
+            "nic": tuple(zip(mapping["gpu"], picks)),
+        }
         node = self.node_objs[n]
         used_row = self.core_used[n].copy()
         gpu_row = self.gpu_used[n].copy()

@@ -33,6 +33,8 @@ class SolveOut(NamedTuple):
     best_m: jax.Array    # [T, N] int32 — first feasible misc NUMA for best_c
     best_a: jax.Array    # [T, N] int32 — first feasible NIC pick for best_c
     n_combos: jax.Array  # [T, N] int32 — feasible combo count (introspection)
+    n_picks: jax.Array   # [T, N] int32 — feasible NIC picks at best_c (a
+    #                      capacity hint for multi-claim rounds)
 
 
 def _solve(
@@ -141,6 +143,9 @@ def _solve(
             U=U, K=K, C=C, A=A,
             interpret=jax.default_backend() != "tpu",
         )
+        # the pallas kernel reduces picks away; a capacity hint of 1 keeps
+        # multi-claim correct (just more rounds) on this path
+        nic_pick_count = nic_any.astype(jnp.int32)
     else:
         nic_ok = (
             fit
@@ -149,6 +154,7 @@ def _solve(
         )  # [T, N, C, A]
         nic_any = jnp.any(nic_ok, axis=-1)  # [T, N, C]
         first_a = jnp.argmax(nic_ok, axis=-1).astype(jnp.int32)  # [T, N, C]
+        nic_pick_count = jnp.sum(nic_ok, axis=-1).astype(jnp.int32)
 
     # ---- intersection on the group prefix (reference: Matcher.py:337-390) ----
     feasible = (
@@ -171,13 +177,14 @@ def _solve(
         axis=-1,
     ).astype(jnp.int32)  # [T, N] first feasible misc NUMA
     best_a = take(first_a)  # [T, N]
+    n_picks = take(nic_pick_count)  # [T, N]
 
     # ---- selection preference (reference: Matcher.py:393-421) ----
     pref = jnp.where(
         cand, 1 + (~needs_gpu[:, None] & gpuless[None, :]).astype(jnp.int32), 0
     )
 
-    return SolveOut(cand, pref, best_c, best_m, best_a, n_combos)
+    return SolveOut(cand, pref, best_c, best_m, best_a, n_combos, n_picks)
 
 
 USE_PALLAS = os.environ.get("NHD_TPU_PALLAS") == "1"

