@@ -6,21 +6,22 @@ A 10k-pod batch can't afford 10k serial solves, so this module runs
 *greedy rounds* (SURVEY §7 hard part 2):
 
   round:  1. one batched feasibility solve against the current state
-          2. every pending pod picks a candidate by the reference's
-             selection rule; pods of the same type fan out across that
-             type's candidate list by rank (distinct nodes)
-          3. conflicts (two pods → one node) go to the lowest pod index;
-             losers retry next round
-          4. winners' physical assignments are applied (host mirror is
-             authoritative), state re-encoded, next round
+          2. every pending pod takes its type's best candidate node, packing
+             each node up to an optimistic capacity estimate before
+             spilling to the next (the reference's first-fit packing shape)
+          3. claims apply in pod-index order, re-verified against live
+             state (NIC picks re-selected; see fast_assign/select_pick) —
+             a node's first claim ran on fresh feasibility so its failure
+             is final, later same-node failures are stale and retry
+          4. applied claims update the solver arrays incrementally; next
+             round
 
-Serializability: at most one pod claims any node per round and each
-assignment was feasible at round start, so applying a round's winners in
-pod-index order is a valid sequential execution — every claim was feasible
-when made. Placement can differ from the reference's strict order (pod k
-may land on a node the reference would have filled with pod k-1's
-neighbors), which is the documented semantic extension that buys the
-~100× throughput; single-pod batches reproduce the oracle exactly.
+Serializability: claims are applied one at a time against live state, so
+the batch equals *a* sequential execution in pod-index-per-node order —
+every applied claim was feasible when made. Placement can still differ
+from the reference's strict global order (capacity estimates decide when a
+gang spills to the next node), the documented extension that buys the
+~1000× throughput; single-pod batches reproduce the oracle exactly.
 
 Busy back-off note: with respect_busy=True (live default) a node accepts
 at most one GPU pod per MIN_BUSY_SECS, exactly like the reference
