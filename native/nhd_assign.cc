@@ -139,19 +139,24 @@ int nhd_assign_pod(
 // ---------------------------------------------------------------------------
 // Round-level assignment: one call places every winner of a greedy round.
 //
-// Winners are on distinct nodes (the batch scheduler's one-claim-per-node
-// rule), so the loop is sequential but independent. Mutates the FastCluster
-// occupancy arrays AND the solver-visible ClusterArrays increments (the
-// same deltas fast_assign._update_arrays applies), eliminating the
-// per-winner Python round trips entirely.
+// Several winners may share a node (capacity-aware multi-claim); claims
+// apply sequentially against LIVE arrays, with each claim's NIC pick
+// re-selected (select_pick) since earlier same-node claims may have
+// consumed the solver's snapshot choice. Mutates the FastCluster occupancy
+// arrays AND the solver-visible ClusterArrays increments (the same deltas
+// fast_assign._update_arrays applies), eliminating the per-winner Python
+// round trips entirely.
 //
-// Combo/pick decoding matches solver/combos.py: index digits base U (resp.
-// K), slot 0 most significant. CPU physical-core demand replicates
+// Combo decoding matches solver/combos.py: index digits base U, slot 0
+// most significant. CPU physical-core demand replicates
 // CpuRequest.physical_cores: ceil(n/2) for SMT-tolerant requests on SMT
 // nodes, n otherwise.
 //
 // Per-winner status: 0 ok; -1 proc, -2 gpu, -3 helper, -4 misc shortfall;
-// -5 hugepages; -6 missing NIC. Failures leave all state untouched.
+// -5 hugepages; -6 missing NIC; -7 no feasible NIC pick against live
+// state; -8 node already busied this round (GPU pod back-off) — the
+// caller retries -7/-8-style stale failures next round. Failures leave
+// all state untouched.
 
 static inline int phys_cores(int count, int smt_req, int node_smt) {
   return (node_smt && smt_req) ? (count + 1) / 2 : count;
@@ -187,25 +192,36 @@ static int select_pick(int G, int U, int K, const int* numa_of,
     for (int g = 0; g < G && ok; ++g)
       if (nic_flat[numa_of[g] * K + pick[g]] < 0) ok = 0;
     if (!ok) continue;
-    // joint demand per (numa, nic)
-    for (int i = 0; i < U * K; ++i) { joint_rx[i] = 0.0; joint_tx[i] = 0.0; }
+    // joint demand per (numa, nic) — touch (and afterwards clear) only the
+    // <= G slots this pick uses, keeping the scan O(A*G), not O(A*U*K)
+    int touched[16];
+    int n_touched = 0;
     for (int g = 0; g < G; ++g) {
       const int uk = numa_of[g] * K + pick[g];
+      int seen = 0;
+      for (int i = 0; i < n_touched; ++i)
+        if (touched[i] == uk) seen = 1;
+      if (!seen) {
+        touched[n_touched++] = uk;
+        joint_rx[uk] = 0.0;
+        joint_tx[uk] = 0.0;
+      }
       joint_rx[uk] += rx_dem[g];
       joint_tx[uk] += tx_dem[g];
     }
-    for (int i = 0; i < U * K && ok; ++i) {
-      if (joint_rx[i] <= 0.0 && joint_tx[i] <= 0.0) continue;
+    for (int i = 0; i < n_touched && ok; ++i) {
+      const int uk = touched[i];
+      if (joint_rx[uk] <= 0.0 && joint_tx[uk] <= 0.0) continue;
       double free_rx, free_tx;
       if (enable_sharing) {
-        free_rx = nic_cap[i] - nic_rx_used[i];
-        free_tx = nic_cap[i] - nic_tx_used[i];
-      } else if (nic_pods[i] > 0) {
+        free_rx = nic_cap[uk] - nic_rx_used[uk];
+        free_tx = nic_cap[uk] - nic_tx_used[uk];
+      } else if (nic_pods[uk] > 0) {
         free_rx = 0.0; free_tx = 0.0;
       } else {
-        free_rx = nic_cap[i]; free_tx = nic_cap[i];
+        free_rx = nic_cap[uk]; free_tx = nic_cap[uk];
       }
-      if (joint_rx[i] > free_rx || joint_tx[i] > free_tx) ok = 0;
+      if (joint_rx[uk] > free_rx || joint_tx[uk] > free_tx) ok = 0;
     }
     if (ok && pci_mode) {
       // PCI mode: every GPU must come off the chosen NIC's switch —

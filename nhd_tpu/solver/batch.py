@@ -21,7 +21,9 @@ the batch equals *a* sequential execution in pod-index-per-node order —
 every applied claim was feasible when made. Placement can still differ
 from the reference's strict global order (capacity estimates decide when a
 gang spills to the next node), the documented extension that buys the
-~1000× throughput; single-pod batches reproduce the oracle exactly.
+~1000× throughput. Single-pod batches reproduce the oracle exactly in NUMA
+map mode; in PCI mode the live pick re-selection can place pods the oracle
+fail-then-bails on (docs/PARITY.md "Batch-mode extensions").
 
 Busy back-off note: with respect_busy=True (live default) a node accepts
 at most one GPU pod per MIN_BUSY_SECS, exactly like the reference
@@ -165,12 +167,11 @@ class BatchScheduler:
 
         gpus_tot = pods.gpu_dem.sum(axis=1)
         free_gpu = cluster.gpu_free.sum(axis=1)
-        with np.errstate(divide="ignore"):
-            gpu_cap = np.where(
-                gpus_tot[:, None] > 0,
-                free_gpu[None, :] // np.maximum(gpus_tot, 1)[:, None],
-                INF,
-            )
+        gpu_cap = np.where(
+            gpus_tot[:, None] > 0,
+            free_gpu[None, :] // np.maximum(gpus_tot, 1)[:, None],
+            INF,
+        )
         cpu_tot = np.minimum(
             pods.cpu_dem_smt.sum(axis=1), pods.cpu_dem_raw.sum(axis=1)
         )
@@ -339,7 +340,7 @@ class BatchScheduler:
             stats.solve_seconds += time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            node_claimed: Dict[int, int] = {}  # node index → claims this round
+            node_claimed: set = set()  # node indices claimed this round
             for G, (pods, out) in bucket_out.items():
                 cand = out.cand
                 pref = out.pref
@@ -375,7 +376,7 @@ class BatchScheduler:
                         n = int(order[t, cur[0]])
                         if cur[1] < cap[t, n]:
                             cur[1] += 1
-                            node_claimed[n] = node_claimed.get(n, 0) + 1
+                            node_claimed.add(n)
                             claims.append((int(pod_i), n, G, t))
                             break
                         cur[0] += 1
@@ -458,7 +459,7 @@ class BatchScheduler:
                         )
                         stats.scheduled += 1
                 if dev is not None:
-                    dev.update_rows(node_claimed.keys())
+                    dev.update_rows(node_claimed)
                 stats.assign_seconds += time.perf_counter() - t0
                 stats.round_end_seconds.append(time.perf_counter() - t_batch)
                 done = set(newly_scheduled)
@@ -573,7 +574,7 @@ class BatchScheduler:
                     if not self.respect_busy:
                         cluster.busy[n] = False
             if dev is not None and apply:
-                dev.update_rows(node_claimed.keys())
+                dev.update_rows(node_claimed)
             stats.assign_seconds += time.perf_counter() - t0
             stats.round_end_seconds.append(time.perf_counter() - t_batch)
 

@@ -1,0 +1,16 @@
+"""The driver entry points must stay importable and runnable."""
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.cand.shape[0] >= 1
+    assert out.cand.shape == out.best_c.shape
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)

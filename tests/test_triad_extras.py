@@ -1,0 +1,116 @@
+"""Coverage for the less-traveled Triad parser paths: standalone nic_cores
+modules (no dp_group), legacy deployed configs without rx_mbufs, and
+pod-spec hugepage reservations overriding the config."""
+
+from nhd_tpu.config import libconfig
+from nhd_tpu.config.triad import TriadCfgParser
+from nhd_tpu.core.request import PodRequest
+from nhd_tpu.core.topology import NicDir
+
+NIC_CORES_CFG = """
+TopologyCfg : {
+  cpu_arch = "SKYLAKE";
+  ext_cores = [ "CtrlCores[0]" ];
+  ext_cores_smt = false;
+  kni_vlan = "KniVlan";
+  map_type = "NUMA";
+  mod_defs = ( {
+    module = "routers";
+    data_vlan = "vlan";
+    nic_cores = [ "rx", "rx_speeds", "tx", "tx_speeds", true ];
+  } );
+};
+routers = (
+  { module = "r0"; vlan = 0;
+    rx = [ -1, -1 ]; rx_speeds = [ 12.5, 12.5 ];
+    tx = [ -1, -1 ]; tx_speeds = [ 7.5, 7.5 ]; },
+  { module = "r1"; vlan = 0;
+    rx = [ -1 ]; rx_speeds = [ 25.0 ];
+    tx = [ -1 ]; tx_speeds = [ 10.0 ]; }
+);
+CtrlCores = [ -1 ];
+KniVlan = 0;
+Hugepages_GB = 2;
+"""
+
+
+def test_nic_cores_module_parses():
+    """The reference's non-data-path NIC module form
+    (TriadCfgParser.py:266-302): a 5-tuple naming rx/speeds/tx/speeds/smt."""
+    p = TriadCfgParser(NIC_CORES_CFG)
+    top = p.to_topology(False)
+    assert top is not None
+    assert len(top.proc_groups) == 2
+    g0, g1 = top.proc_groups
+    assert len(g0.proc_cores) == 4  # 2 rx + 2 tx
+    assert len(g1.proc_cores) == 2
+    assert len(top.nic_pairs) == 3
+
+    req = PodRequest.from_topology(top)
+    assert req.groups[0].nic_rx_gbps == 25.0
+    assert req.groups[0].nic_tx_gbps == 15.0
+    assert req.groups[1].nic_rx_gbps == 25.0
+
+    rx = [c for c in g0.proc_cores if c.nic_dir == NicDir.RX]
+    assert [c.nic_speed for c in rx] == [12.5, 12.5]
+
+
+def test_nic_cores_roundtrip_and_legacy_replay():
+    """Write-back and deployed-config replay for the nic_cores form; the
+    replay also exercises the legacy no-rx_mbufs branch
+    (TriadCfgParser.py:329-333)."""
+    p = TriadCfgParser(NIC_CORES_CFG)
+    top = p.to_topology(False)
+    core_iter = iter(range(20, 40))
+    for pg in top.proc_groups:
+        pg.vlan.vlan = 7
+        for c in pg.proc_cores:
+            c.core = next(core_iter)
+    for c in top.misc_cores:
+        c.core = next(core_iter)
+    top.ctrl_vlan.vlan = 7
+    top.set_data_default_gw("10.9.0.1/32")
+    for pair in top.nic_pairs:
+        pair.mac = "AA:BB:CC:00:00:01"
+    out = p.to_config()
+
+    cfg = libconfig.loads(out)
+    assert cfg.routers[0].rx == [20, 22]
+    assert cfg.routers[1].rx == [24]
+    assert len(cfg.Network_Config) == 1
+
+    # strip rx_mbufs to simulate an old deployed config
+    stripped = dict(cfg)
+    net0 = dict(cfg.Network_Config[0])
+    net0.pop("rx_mbufs")
+    stripped["Network_Config"] = (net0,)
+    legacy_text = libconfig.dumps(stripped)
+
+    p2 = TriadCfgParser(legacy_text)
+    top2 = p2.to_topology(True)
+    assert top2 is not None
+    assert all(pair.mac == "AA:BB:CC:00:00:01" for pair in top2.nic_pairs)
+    assert all(pair.rx_ring_size == 4096 for pair in top2.nic_pairs)  # default kept
+
+
+def test_pod_spec_hugepages_override():
+    """Pod-spec hugepages-1Gi requests override the config value
+    (reference: CfgTopology.py:146-149 via NHDScheduler.py:214-225)."""
+    from nhd_tpu.scheduler.core import Scheduler
+    from nhd_tpu.scheduler.events import WatchQueue
+    from tests.test_scheduler import make_backend, pod_cfg
+    import queue
+
+    backend = make_backend()
+    backend.create_pod(
+        "hp-pod", cfg_text=pod_cfg(hugepages_gb=4),
+        resources={"hugepages-1Gi": "8Gi"},
+    )
+    sched = Scheduler(backend, WatchQueue(), queue.Queue(), respect_busy=False)
+    sched.build_initial_node_list()
+    sched.check_pending_pods()
+    pod = backend.pods[("default", "hp-pod")]
+    assert pod.node is not None
+    node = sched.nodes[pod.node]
+    # 8 (spec) not 4 (config) got deducted
+    assert node.mem.free_hugepages_gb == node.mem.ttl_hugepages_gb - 8
