@@ -49,7 +49,7 @@ class Controller(threading.Thread):
         self.queue = watch_queue
         self.sched_name = sched_name
         self.poll_interval = poll_interval
-        self._stop = threading.Event()
+        self._stop_event = threading.Event()
         self._last_triadset = 0.0
 
     # ------------------------------------------------------------------
@@ -143,9 +143,9 @@ class Controller(threading.Thread):
             self.reconcile_triadsets()
 
     def run(self) -> None:
-        while not self._stop.is_set():
+        while not self._stop_event.is_set():
             self.run_once()
             time.sleep(self.poll_interval)
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_event.set()

@@ -70,7 +70,7 @@ class Scheduler(threading.Thread):
         self.pod_state: Dict[Tuple[str, str], dict] = {}
         self.failed_schedule_count = 0
         self.batch = BatchScheduler(respect_busy=respect_busy)
-        self._stop = threading.Event()
+        self._stop_event = threading.Event()
 
     # ------------------------------------------------------------------
     # startup / node inventory
@@ -527,8 +527,8 @@ class Scheduler(threading.Thread):
     def run(self) -> None:
         self.startup()
         idle = 0
-        while not self._stop.is_set():
+        while not self._stop_event.is_set():
             idle = self.run_once(idle_count=idle)
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_event.set()

@@ -344,3 +344,32 @@ def test_kube_backend_gated_import():
         pass
     with pytest.raises(RuntimeError, match="requires the 'kubernetes'"):
         kube.KubeClusterBackend()
+
+
+def test_threaded_scheduler_lifecycle():
+    """The real thread entry points: scheduler + controller threads bind a
+    pod end to end, then stop cleanly (reference process model, bin/nhd)."""
+    import time as time_mod
+
+    backend = make_backend(n_nodes=2)
+    backend.add_triadset("ts", "default", replicas=2,
+                         service_name="live", cfg_text=pod_cfg())
+    sched = Scheduler(backend, WatchQueue(), queue.Queue(),
+                      respect_busy=False)
+    ctrl = Controller(backend, sched.nqueue, poll_interval=0.01)
+    sched.start()
+    ctrl.start()
+    try:
+        deadline = time_mod.time() + 20
+        while time_mod.time() < deadline:
+            pods = [p for p in backend.pods.values() if p.node]
+            if len(pods) == 2:
+                break
+            time_mod.sleep(0.05)
+        assert len([p for p in backend.pods.values() if p.node]) == 2
+    finally:
+        sched.stop()
+        ctrl.stop()
+        sched.join(timeout=5)
+        ctrl.join(timeout=5)
+    assert not sched.is_alive() and not ctrl.is_alive()
