@@ -173,7 +173,15 @@ class KubeClusterBackend(ClusterBackend):
         for vol in obj.spec.volumes or []:
             if vol.config_map is None:
                 continue
-            cm = self.v1.read_namespaced_config_map(vol.config_map.name, ns)
+            try:
+                cm = self.v1.read_namespaced_config_map(vol.config_map.name, ns)
+            except self._client.exceptions.ApiException as exc:
+                # a pod can reference a ConfigMap that doesn't exist (yet);
+                # that fails the pod (FailedCfgParse), never the scheduler
+                self.logger.error(
+                    f"configmap {ns}/{vol.config_map.name} unreadable: {exc}"
+                )
+                continue
             if cm.data:
                 return (vol.config_map.name, next(iter(cm.data.values())))
         return (None, None)

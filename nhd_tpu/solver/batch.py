@@ -340,7 +340,12 @@ class BatchScheduler:
             stats.solve_seconds += time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            node_claimed: set = set()  # node indices claimed this round
+            # node index → bucket G of its claims this round. A node only
+            # accepts claims from ONE bucket per round so the native round
+            # calls (one per bucket) preserve pod-index application order
+            # per node — cross-bucket interleaving on a node would otherwise
+            # break the documented serialization order
+            node_claimed: Dict[int, int] = {}
             for G, (pods, out) in bucket_out.items():
                 cand = out.cand
                 pref = out.pref
@@ -374,9 +379,11 @@ class BatchScheduler:
                     cur = cursor.setdefault(t, [0, 0])
                     while cur[0] < n_cands[t]:
                         n = int(order[t, cur[0]])
-                        if cur[1] < cap[t, n]:
+                        if (
+                            cur[1] < cap[t, n]
+                            and node_claimed.setdefault(n, G) == G
+                        ):
                             cur[1] += 1
-                            node_claimed.add(n)
                             claims.append((int(pod_i), n, G, t))
                             break
                         cur[0] += 1

@@ -458,7 +458,11 @@ class FastCluster:
         nic_rx_add: Dict[Tuple[int, int], float] = {}
         nic_tx_add: Dict[Tuple[int, int], float] = {}
 
-        if self._lib is not None:
+        # the native per-pod call shares the round path's fixed-buffer
+        # limits (its out_counts scratch holds 2G+1 entries; a >16-group pod
+        # is possible on small-lattice clusters) — larger pods take the
+        # numpy path below
+        if self._lib is not None and req.n_groups <= 16:
             return self._assign_native(
                 n, node, mapping, req, used_row, gpu_row, rec,
                 nic_rx_add, nic_tx_add,

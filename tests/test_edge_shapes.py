@@ -85,3 +85,31 @@ def test_oversized_bucket_falls_back_to_oracle(monkeypatch):
         if c.used and c.core not in node.reserved_cores
     )
     assert total_used > 0
+
+
+def test_many_group_pod_single_numa_no_overflow():
+    """A 33-group pod on a single-NUMA cluster stays tractable (lattice =
+    1) but exceeds the native fixed buffers — it must take the numpy path
+    and schedule without memory corruption (previously heap-overflowed)."""
+    nodes = make_cluster(
+        1, SynthNodeSpec(sockets=1, phys_cores=96, nics_per_numa=1,
+                         gpus_per_numa=0, hugepages_gb=64),
+    )
+    big = PodRequest(
+        groups=tuple(
+            GroupRequest(CpuRequest(2, SmtMode.ON), CpuRequest(0, SmtMode.OFF),
+                         0, 0.5, 0.2)
+            for _ in range(33)
+        ),
+        misc=CpuRequest(1, SmtMode.ON),
+        hugepages_gb=1,
+        map_mode=MapMode.NUMA,
+    )
+    results, stats = BatchScheduler(respect_busy=False).schedule(
+        nodes, [BatchItem(("ns", "huge"), big)], now=0.0
+    )
+    assert results[0].node == "node00000"
+    node = nodes["node00000"]
+    used = sum(1 for c in node.cores
+               if c.used and c.core not in node.reserved_cores)
+    assert used > 33  # all groups' cores actually claimed
