@@ -368,26 +368,36 @@ class BatchScheduler:
                     continue
 
                 # capacity-aware packing (the reference's first-fit shape):
-                # each type fills its best candidate up to an optimistic
-                # per-node capacity estimate before moving on — claims are
-                # re-verified against live state at assignment, so an
-                # overestimate just costs a retry
+                # each type fills its ranked candidates up to an optimistic
+                # per-node capacity estimate — vectorized as a repeat of the
+                # ranked nodes by capacity (claims are re-verified against
+                # live state at assignment, so an overestimate just costs a
+                # retry). Pods of one type are in pod-index order already.
                 cap = self._capacity_estimate(cluster, pods, out)
-                cursor: Dict[int, list] = {}   # type → [rank, used_on_rank]
+                # one-bucket-per-node rule: nodes another bucket claimed
+                # this round are blocked — static within a bucket, so
+                # computed once as a vector mask
+                blocked = np.asarray(
+                    [n for n, g in node_claimed.items() if g != G], np.int64
+                )
+                by_type: Dict[int, List[int]] = {}
                 for t, pod_i in zip(pods.pod_type, pods.pod_index):
-                    t = int(t)
-                    cur = cursor.setdefault(t, [0, 0])
-                    while cur[0] < n_cands[t]:
-                        n = int(order[t, cur[0]])
-                        if (
-                            cur[1] < cap[t, n]
-                            and node_claimed.setdefault(n, G) == G
-                        ):
-                            cur[1] += 1
-                            claims.append((int(pod_i), n, G, t))
-                            break
-                        cur[0] += 1
-                        cur[1] = 0
+                    by_type.setdefault(int(t), []).append(int(pod_i))
+                for t, pod_ids in by_type.items():
+                    if n_cands[t] == 0:
+                        continue
+                    ranked = order[t, : n_cands[t]]
+                    caps_r = cap[t, ranked]
+                    if len(blocked):
+                        caps_r[np.isin(ranked, blocked)] = 0
+                    need = len(pod_ids)
+                    caps_r = np.minimum(caps_r, need)
+                    cut = int(np.searchsorted(np.cumsum(caps_r), need)) + 1
+                    assigned = np.repeat(ranked[:cut], caps_r[:cut])[:need]
+                    for pod_i, n in zip(pod_ids, assigned):
+                        n = int(n)
+                        node_claimed.setdefault(n, G)
+                        claims.append((pod_i, n, G, t))
             # assignment order = pod index order: per node this is a valid
             # sequential execution (claims re-verified as they apply); the
             # first claim a node actually processes ran against fresh
