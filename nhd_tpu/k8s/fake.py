@@ -339,3 +339,9 @@ class FakeClusterBackend(ClusterBackend):
         pod.hostname = name
         pod.subdomain = ts["service_name"]
         return True
+
+    def update_triadset_status(self, ts: dict, replicas: int) -> None:
+        with self._lock:
+            for item in self.triadsets:
+                if item["name"] == ts["name"] and item["ns"] == ts["ns"]:
+                    item["status_replicas"] = replicas

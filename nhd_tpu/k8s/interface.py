@@ -186,3 +186,9 @@ class ClusterBackend(ABC):
     def create_pod_for_triadset(self, ts: dict, ordinal: int) -> bool:
         """Create the missing '{service}-{ordinal}' pod with hostname/
         subdomain patched in (TriadController.py:101-120)."""
+
+    @abstractmethod
+    def update_triadset_status(self, ts: dict, replicas: int) -> None:
+        """Write status.replicas — backs the CRD's scale subresource
+        (deploy/triadset-crd.yaml; the reference declares the subresource,
+        triad-crd.1.16.yaml:57-62, but never updates it)."""

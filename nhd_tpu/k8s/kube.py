@@ -379,3 +379,14 @@ class KubeClusterBackend(ClusterBackend):
         except self._client.exceptions.ApiException as exc:
             self.logger.error(f"TriadSet pod create failed for {name}: {exc}")
             return False
+
+    def update_triadset_status(self, ts: dict, replicas: int) -> None:
+        """status.replicas for the scale subresource."""
+        try:
+            self.crd.patch_namespaced_custom_object_status(
+                self._CRD_GROUP, self._CRD_VERSION, ts["ns"],
+                self._CRD_PLURAL, ts["name"],
+                {"status": {"replicas": replicas}},
+            )
+        except self._client.exceptions.ApiException as exc:
+            self.logger.error(f"TriadSet status update failed: {exc}")

@@ -51,6 +51,7 @@ class Controller(threading.Thread):
         self.poll_interval = poll_interval
         self._stop_event = threading.Event()
         self._last_triadset = 0.0
+        self._last_status: Dict[tuple, int] = {}
 
     # ------------------------------------------------------------------
 
@@ -123,11 +124,21 @@ class Controller(threading.Thread):
         (reference: TriadController.py:87-120)."""
         for ts in self.backend.list_triadsets():
             existing = set(self.backend.list_pods_of_triadset(ts))
+            created = 0
             for ordinal in range(int(ts.get("replicas", 0))):
                 name = f"{ts['service_name']}-{ordinal}"
                 if name not in existing:
                     self.logger.info(f"TriadSet {ts['name']}: creating pod {name}")
-                    self.backend.create_pod_for_triadset(ts, ordinal)
+                    if self.backend.create_pod_for_triadset(ts, ordinal):
+                        created += 1
+            # scale-subresource status: observed count incl. this pass's
+            # creations; skip no-op patches (each would bump the object's
+            # resourceVersion and wake every CRD watcher)
+            observed = len(existing) + created
+            key = (ts["ns"], ts["name"])
+            if self._last_status.get(key) != observed:
+                self.backend.update_triadset_status(ts, observed)
+                self._last_status[key] = observed
 
     # ------------------------------------------------------------------
 

@@ -373,3 +373,15 @@ def test_threaded_scheduler_lifecycle():
         sched.join(timeout=5)
         ctrl.join(timeout=5)
     assert not sched.is_alive() and not ctrl.is_alive()
+
+
+def test_triadset_status_updated():
+    """The controller writes status.replicas for the scale subresource
+    (declared but never updated in the reference)."""
+    backend = make_backend(n_nodes=2)
+    backend.add_triadset("ts1", "default", replicas=2,
+                         service_name="st", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+    ctrl.run_once(now=10.0)   # creates pods AND reports them immediately
+    assert backend.triadsets[0]["status_replicas"] == 2
