@@ -39,9 +39,11 @@ def _pick_platform() -> str:
     if os.environ.get("NHD_BENCH_PLATFORM"):
         return os.environ["NHD_BENCH_PLATFORM"]
     try:
+        # healthy accelerator init takes single-digit seconds (compiles come
+        # later and hit the persistent cache); a wedged tunnel blocks forever
         probe = subprocess.run(
             [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=240,
+            capture_output=True, text=True, timeout=90,
         )
     except subprocess.TimeoutExpired:
         _log("bench: TPU probe timed out (tunnel wedged?); falling back to CPU")

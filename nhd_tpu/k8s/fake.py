@@ -340,8 +340,10 @@ class FakeClusterBackend(ClusterBackend):
         pod.subdomain = ts["service_name"]
         return True
 
-    def update_triadset_status(self, ts: dict, replicas: int) -> None:
+    def update_triadset_status(self, ts: dict, replicas: int) -> bool:
         with self._lock:
             for item in self.triadsets:
                 if item["name"] == ts["name"] and item["ns"] == ts["ns"]:
                     item["status_replicas"] = replicas
+                    return True
+            return False

@@ -188,7 +188,8 @@ class ClusterBackend(ABC):
         subdomain patched in (TriadController.py:101-120)."""
 
     @abstractmethod
-    def update_triadset_status(self, ts: dict, replicas: int) -> None:
+    def update_triadset_status(self, ts: dict, replicas: int) -> bool:
         """Write status.replicas — backs the CRD's scale subresource
         (deploy/triadset-crd.yaml; the reference declares the subresource,
-        triad-crd.1.16.yaml:57-62, but never updates it)."""
+        triad-crd.1.16.yaml:57-62, but never updates it). Returns success
+        so callers only cache acknowledged writes."""

@@ -380,7 +380,7 @@ class KubeClusterBackend(ClusterBackend):
             self.logger.error(f"TriadSet pod create failed for {name}: {exc}")
             return False
 
-    def update_triadset_status(self, ts: dict, replicas: int) -> None:
+    def update_triadset_status(self, ts: dict, replicas: int) -> bool:
         """status.replicas for the scale subresource."""
         try:
             self.crd.patch_namespaced_custom_object_status(
@@ -388,5 +388,7 @@ class KubeClusterBackend(ClusterBackend):
                 self._CRD_PLURAL, ts["name"],
                 {"status": {"replicas": replicas}},
             )
+            return True
         except self._client.exceptions.ApiException as exc:
             self.logger.error(f"TriadSet status update failed: {exc}")
+            return False

@@ -122,7 +122,14 @@ class Controller(threading.Thread):
     def reconcile_triadsets(self) -> None:
         """Create any missing '{service}-{ordinal}' pods
         (reference: TriadController.py:87-120)."""
-        for ts in self.backend.list_triadsets():
+        triadsets = self.backend.list_triadsets()
+        live_keys = {(ts["ns"], ts["name"]) for ts in triadsets}
+        # prune deleted TriadSets: a recreated same-name CR must get a
+        # fresh status patch, and the cache must not grow unboundedly
+        for key in list(self._last_status):
+            if key not in live_keys:
+                del self._last_status[key]
+        for ts in triadsets:
             existing = set(self.backend.list_pods_of_triadset(ts))
             created = 0
             for ordinal in range(int(ts.get("replicas", 0))):
@@ -137,8 +144,10 @@ class Controller(threading.Thread):
             observed = len(existing) + created
             key = (ts["ns"], ts["name"])
             if self._last_status.get(key) != observed:
-                self.backend.update_triadset_status(ts, observed)
-                self._last_status[key] = observed
+                # cache only acknowledged writes so a transient API failure
+                # retries next pass
+                if self.backend.update_triadset_status(ts, observed):
+                    self._last_status[key] = observed
 
     # ------------------------------------------------------------------
 
