@@ -31,6 +31,7 @@ class ChaosStats:
     maint_flips: int = 0
     bind_failures: int = 0
     restarts: int = 0
+    group_moves: int = 0
     violations: List[str] = field(default_factory=list)
 
 
@@ -68,9 +69,21 @@ class ChaosSim:
             gpus_per_group=self.rng.choice([0, 1]),
             cpu_workers=self.rng.choice([1, 2]),
             hugepages_gb=self.rng.choice([2, 4]),
+            map_type=self.rng.choice(["NUMA", "NUMA", "PCI"]),
         )
-        self.backend.create_pod(f"chaos-{self._pod_seq}", cfg_text=cfg)
+        groups = self.rng.choice([None, None, "default", "edge"])
+        self.backend.create_pod(
+            f"chaos-{self._pod_seq}", cfg_text=cfg, groups=groups
+        )
         self.stats.created += 1
+
+    def _act_group_move(self) -> None:
+        from nhd_tpu.scheduler.controller import NHD_GROUP_LABEL
+
+        name = self.rng.choice(list(self.backend.nodes))
+        value = self.rng.choice(["default", "edge", "default.edge", None])
+        self.backend.update_node_labels(name, {NHD_GROUP_LABEL: value})
+        self.stats.group_moves += 1
 
     def _act_delete(self) -> None:
         bound = [p for p in self.backend.pods.values() if p.node]
@@ -113,8 +126,9 @@ class ChaosSim:
         self.stats.steps += 1
         action = self.rng.choices(
             [self._act_create, self._act_delete, self._act_cordon,
-             self._act_maintenance, self._act_bind_failure, self._act_restart],
-            weights=[40, 15, 10, 10, 10, 5],
+             self._act_maintenance, self._act_bind_failure, self._act_restart,
+             self._act_group_move],
+            weights=[40, 15, 10, 10, 10, 5, 8],
         )[0]
         action()
         # let the control plane catch up
