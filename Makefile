@@ -12,6 +12,10 @@ native:
 test:
 	python -m pytest tests/ -x -q
 
+# full release gate: suite + benchmark smoke on the CPU backend
+check: test
+	NHD_BENCH_PLATFORM=cpu python bench.py
+
 # Regenerate protobuf message bindings. Service stubs are hand-written in
 # nhd_tpu/rpc/server.py (no grpc_python_plugin needed).
 proto:
