@@ -92,3 +92,24 @@ def test_detailed_node_stats(stack):
     empty = client.get_detailed_node_stats("node1")
     assert empty.status == pb.NHD_STATUS_OK
     assert len(empty.podinfo) == 0
+
+
+def test_scheduler_unresponsive_returns_err(monkeypatch):
+    """A dead scheduler thread yields NHD_STATUS_ERR, not a hang
+    (reference behavior: 5s reply timeout, NHDRpcServer.py:58)."""
+    import nhd_tpu.rpc as rpc_pkg
+
+    monkeypatch.setattr(rpc_pkg, "RPC_TIMEOUT_SEC", 0.2)
+    # a handler pointed at a queue nobody drains
+    dead = StatsRpcServer(queue.Queue(), port=0)
+    dead.start()
+    try:
+        c = NHDControlClient(f"localhost:{dead.bound_port}")
+        grpc.channel_ready_future(c.channel).result(timeout=5)
+        reply = c.get_basic_node_stats()
+        assert reply.status == pb.NHD_STATUS_ERR
+        reply2 = c.get_scheduler_stats()
+        assert reply2.status == pb.NHD_STATUS_ERR
+        c.close()
+    finally:
+        dead.stop()

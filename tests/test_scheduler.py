@@ -385,3 +385,16 @@ def test_triadset_status_updated():
     ctrl = Controller(backend, sched.nqueue)
     ctrl.run_once(now=10.0)   # creates pods AND reports them immediately
     assert backend.triadsets[0]["status_replicas"] == 2
+
+
+def test_run_once_serves_rpc_queue():
+    """The main loop's RPC branch answers queued stats requests
+    (reference: NHDScheduler.py:477-479)."""
+    backend = make_backend()
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+    reply: queue.Queue = queue.Queue()
+    sched.rpcq.put((RpcMsgType.SCHEDULER_INFO, reply))
+    sched.run_once()
+    assert reply.get_nowait() == 0
