@@ -23,7 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nhd_tpu.solver.combos import get_tables
-from nhd_tpu.solver.kernel import SolveOut, _pad_pow2, _solve
+from nhd_tpu.solver.kernel import SolveOut, _pad_pow2, _solve, pad_nodes
 
 
 def make_mesh(devices=None, axis: str = "nodes") -> Mesh:
@@ -67,9 +67,7 @@ def solve_bucket_sharded(cluster, pods, mesh: Optional[Mesh] = None) -> SolveOut
 
     # pad N to a multiple of the mesh size (and a power-of-two bucket so
     # re-solves reuse the jit cache); padded rows are inactive
-    Np = max(_pad_pow2(N), n_dev)
-    if Np % n_dev:
-        Np += n_dev - (Np % n_dev)
+    Np = pad_nodes(N, n_dev)
     Tp = _pad_pow2(T)
 
     def pad(a, size):

@@ -136,6 +136,7 @@ class BatchScheduler:
         use_fast: bool = True,
         register_pods: bool = True,
         device_state: str = "auto",
+        mesh: object = "auto",
     ):
         self.logger = get_logger(__name__)
         self.respect_busy = respect_busy
@@ -151,6 +152,37 @@ class BatchScheduler:
                 f"device_state must be True, False or 'auto', got {device_state!r}"
             )
         self.device_state = device_state
+        # mesh: "auto" → shard the solve over every visible device whenever
+        # more than one exists (the production multi-chip path, SURVEY §7
+        # step 6); None → force single-device; or pass an explicit 1-D
+        # jax.sharding.Mesh over a "nodes" axis
+        if mesh is not None and mesh != "auto":
+            if "nodes" not in getattr(mesh, "axis_names", ()):
+                raise ValueError(
+                    "mesh must be 'auto', None, or a jax.sharding.Mesh "
+                    f"with a 'nodes' axis, got {mesh!r}"
+                )
+            if device_state is False:
+                raise ValueError(
+                    "device_state=False conflicts with an explicit mesh: "
+                    "sharded arrays must be device-resident"
+                )
+        self.mesh = mesh
+
+    def _resolve_mesh(self):
+        if self.device_state is False:
+            return None  # host-only path: mesh would be dead weight
+        if self.mesh != "auto":
+            return self.mesh
+        import jax
+
+        from nhd_tpu.parallel.sharding import make_mesh
+
+        try:
+            devices = jax.devices()
+        except Exception:
+            return None
+        return make_mesh(devices) if len(devices) > 1 else None
 
     def _capacity_estimate(self, cluster, pods, out) -> np.ndarray:
         """Optimistic copies-per-node estimate cap[T, N] for one round.
@@ -291,12 +323,18 @@ class BatchScheduler:
             else None
         )
         # keep node arrays resident on device across rounds; per-round
-        # uploads shrink to the claimed rows (solver/device_state.py)
+        # uploads shrink to the claimed rows (solver/device_state.py).
+        # A multi-device mesh implies resident state: sharded arrays must
+        # live on their devices for the SPMD solve.
+        mesh = self._resolve_mesh()
         use_dev = (
             self.device_state is True
-            or (self.device_state == "auto" and _accelerator_backend())
+            or (
+                self.device_state == "auto"
+                and (_accelerator_backend() or mesh is not None)
+            )
         )
-        dev = DeviceClusterState(cluster) if use_dev else None
+        dev = DeviceClusterState(cluster, mesh) if use_dev else None
         records: Dict[int, AssignRecord] = {}
         busy_nodes: set = set()
         all_buckets = None

@@ -8,6 +8,13 @@ applies each round's claims as small donated scatters: upload is O(claimed
 rows), download is the compact per-(type, node) decision tensors
 (SURVEY §7 hard part 5: host↔device state coherence without re-upload).
 
+With a multi-device ``Mesh`` the resident arrays shard along the node axis
+(``NamedSharding(mesh, P("nodes"))``) and the solve runs SPMD via the
+pjit-compiled sharded solver (parallel/sharding.py) — this is the
+production multi-chip path (SURVEY §2 parallelism bullet 1): each device
+owns a node shard, per-round row scatters update only the owning shard,
+and the [T, N] decision tensors gather back over ICI.
+
 Scatter index vectors are padded to power-of-two lengths (repeating the
 last index — idempotent for row `set`) so round-to-round claim counts reuse
 the jit cache.
@@ -15,7 +22,7 @@ the jit cache.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +34,7 @@ from nhd_tpu.solver.kernel import (
     USE_PALLAS,
     _pad_pow2,
     get_solver,
+    pad_nodes,
 )
 
 # node arrays that claims mutate; the rest are uploaded once and never touched
@@ -63,18 +71,52 @@ def _scatter_all(arrays, idx, rows):
     }
 
 
-class DeviceClusterState:
-    """Padded node arrays living on device for the duration of a batch."""
+from functools import lru_cache
 
-    def __init__(self, cluster: ClusterArrays):
+
+@lru_cache(maxsize=None)
+def _get_sharded_scatter(sharding):
+    """Row scatter that pins its outputs to the node sharding — global row
+    indices, each shard applies the rows it owns."""
+
+    @partial(
+        jax.jit,
+        donate_argnums=(0,),
+        out_shardings={name: sharding for name in _MUTABLE},
+    )
+    def scatter(arrays, idx, rows):
+        return {name: arrays[name].at[idx].set(rows[name]) for name in arrays}
+
+    return scatter
+
+
+class DeviceClusterState:
+    """Padded node arrays living on device for the duration of a batch.
+
+    ``mesh``: a 1-D ``jax.sharding.Mesh`` over a ``nodes`` axis. When given
+    (and it has >1 device), the resident arrays are laid out node-sharded
+    across the mesh and ``solve`` runs the SPMD sharded solver; without it,
+    everything lives on the default single device.
+    """
+
+    def __init__(self, cluster: ClusterArrays, mesh: Optional["jax.sharding.Mesh"] = None):
         self.cluster = cluster
         self.N = cluster.n_nodes
-        self.Np = _pad_pow2(self.N, floor=128 if USE_PALLAS else 8)
+        self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
+        n_dev = self.mesh.devices.size if self.mesh else 1
+        self.Np = pad_nodes(self.N, n_dev, floor=128 if USE_PALLAS else 8)
+        self._node_sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._node_sharding = NamedSharding(self.mesh, P("nodes"))
         self._dev: Dict[str, jax.Array] = {}
         for name in _ARG_ORDER:
-            self._dev[name] = jnp.asarray(
-                _pad_rows(getattr(cluster, name), self.Np)
-            )
+            padded = _pad_rows(getattr(cluster, name), self.Np)
+            if self._node_sharding is not None:
+                self._dev[name] = jax.device_put(padded, self._node_sharding)
+            else:
+                self._dev[name] = jnp.asarray(padded)
 
     def update_rows(self, indices: Iterable[int]) -> None:
         """Re-ship the claimed nodes' rows (host ClusterArrays → device)."""
@@ -86,7 +128,12 @@ class DeviceClusterState:
         idx[: len(idx_list)] = idx_list
         mutable = {name: self._dev[name] for name in _MUTABLE}
         rows = {name: getattr(self.cluster, name)[idx] for name in _MUTABLE}
-        updated = _scatter_all(mutable, jnp.asarray(idx), rows)
+        scatter = (
+            _get_sharded_scatter(self._node_sharding)
+            if self._node_sharding is not None
+            else _scatter_all
+        )
+        updated = scatter(mutable, jnp.asarray(idx), rows)
         self._dev.update(updated)
 
     def solve(self, pods) -> SolveOut:
@@ -97,7 +144,14 @@ class DeviceClusterState:
         def pad_t(a):
             return _pad_rows(a, Tp)
 
-        solver = get_solver(pods.G, self.cluster.U, self.cluster.K)
+        if self.mesh is not None:
+            from nhd_tpu.parallel.sharding import get_sharded_solver
+
+            solver = get_sharded_solver(
+                pods.G, self.cluster.U, self.cluster.K, self.mesh
+            )
+        else:
+            solver = get_solver(pods.G, self.cluster.U, self.cluster.K)
         out = solver(
             *[self._dev[name] for name in _ARG_ORDER],
             pad_t(pods.cpu_dem_smt), pad_t(pods.cpu_dem_raw),

@@ -219,6 +219,16 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return p
 
 
+def pad_nodes(n: int, n_dev: int = 1, floor: int = 8) -> int:
+    """Padded node-axis length: a power-of-two bucket (jit-cache reuse)
+    that is also a multiple of the mesh size (even shards). The single
+    place this rule lives — device_state and parallel/sharding share it."""
+    p = _pad_pow2(max(n, 1), floor=max(floor, n_dev))
+    if p % n_dev:
+        p += n_dev - (p % n_dev)
+    return p
+
+
 def solve_bucket(cluster, pods, *, device=None) -> SolveOut:
     """Run the bucket solve for (ClusterArrays, PodTypeArrays) → SolveOut.
 

@@ -229,25 +229,28 @@ def test_unrepresentable_request_fails_cleanly():
 
 
 def test_device_state_path_equivalent():
-    """Forced device-resident state must match the default path exactly
-    (on CPU it is pure overhead, but the code path must stay correct —
-    'auto' disables it under the CPU backend, so CI would otherwise never
-    execute it)."""
+    """The four device-state/mesh corners must agree exactly: host arrays
+    (device_state off, mesh off), forced single-device resident arrays, and
+    the sharded mesh path (the 8-device suite default)."""
     import pytest
 
     reqs = [simple_request(gpus=i % 2) for i in range(40)]
     outs = {}
-    for ds in ("auto", True):
+    for label, kw in (
+        ("host", dict(device_state=False, mesh=None)),
+        ("resident", dict(device_state=True, mesh=None)),
+        ("mesh", dict(device_state="auto", mesh="auto")),
+    ):
         nodes = make_cluster(4)
         results, stats = BatchScheduler(
-            respect_busy=False, device_state=ds
+            respect_busy=False, **kw
         ).schedule(nodes, items(reqs), now=0.0)
-        outs[str(ds)] = (
+        outs[label] = (
             [r.node for r in results],
             [r.mapping for r in results],
             stats.scheduled,
         )
-    assert outs["auto"] == outs["True"]
+    assert outs["host"] == outs["resident"] == outs["mesh"]
 
     with pytest.raises(ValueError):
         BatchScheduler(device_state="true")

@@ -44,3 +44,45 @@ def test_sharded_solve_with_node_count_not_divisible():
         plain = solve_bucket(cluster, pods)
         sharded = solve_bucket_sharded(cluster, pods)
         np.testing.assert_array_equal(np.asarray(plain.cand), sharded.cand)
+
+
+def _cluster_free_state(nodes):
+    return sorted(
+        (
+            name,
+            tuple(n.free_cpu_cores_per_numa()),
+            n.free_gpu_count(),
+            n.mem.free_hugepages_gb,
+            tuple(nic.free_bw() for nic in n.nics),
+        )
+        for name, n in nodes.items()
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_batch_scheduler_mesh_equals_single_device(seed):
+    """The PRODUCTION path: BatchScheduler over the 8-device mesh must place
+    a mixed contended batch (multi-bucket, NUMA+PCI, GPU and CPU-only pods)
+    identically to the forced single-device path — same nodes, same
+    mappings, same end cluster state."""
+    import copy
+
+    from nhd_tpu.solver.batch import BatchItem, BatchScheduler
+
+    rng = random.Random(400 + seed)
+    base_nodes = random_cluster(rng, 11)
+    reqs = [random_request(rng) for _ in range(24)]
+    items = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
+
+    outs = {}
+    for label, mesh in (("mesh", make_mesh()), ("single", None)):
+        nodes = copy.deepcopy(base_nodes)
+        sched = BatchScheduler(respect_busy=False, mesh=mesh)
+        results, stats = sched.schedule(nodes, items, now=1010.0)
+        outs[label] = (
+            [r.node for r in results],
+            [r.mapping for r in results],
+            stats.scheduled,
+            _cluster_free_state(nodes),
+        )
+    assert outs["mesh"] == outs["single"]
