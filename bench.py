@@ -2,7 +2,11 @@
 """Benchmark: batched TPU scheduling vs the serial per-pod matcher walk.
 
 Headline config is BASELINE.json config 4: 10k pending pods × 1k nodes with
-mixed node groups, scheduled as gang batches. The baseline is this repo's
+mixed node groups, scheduled as gang batches — on a capacity-matched
+cluster that absorbs every pod (cfg4), with the NIC-saturated variant
+(cfg3) reported alongside as the contention benchmark. The 100k × 10k
+federation config (BASELINE config 5) runs by default through the
+streaming solver (solver/streaming.py). The baseline is this repo's
 serial oracle (a faithful reimplementation of the reference matcher loop,
 solver/oracle.py) timed on a sample of the same workload and extrapolated —
 the reference itself publishes no numbers (BASELINE.md).
@@ -13,7 +17,7 @@ Everything else (per-config detail, platform notes) goes to stderr.
 
 Environment knobs:
     NHD_BENCH_PLATFORM=cpu    skip the TPU probe, run on CPU
-    NHD_BENCH_STRETCH=1       also run the 100k × 10k federation config
+    NHD_BENCH_SKIP_FED=1      skip the 100k × 10k federation config
 
 Busy back-off (one GPU pod per node per 30 s, reference Matcher.py:103-111)
 is disabled on BOTH sides: it is an operational rate limit, not solver
@@ -108,13 +112,36 @@ def run_serial_baseline(nodes, reqs, sample: int):
     return (time.perf_counter() - t0) / max(sample, 1)
 
 
-def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40):
+def run_stream(nodes, reqs, *, tile_nodes=2048, chunk_pods=20000):
+    """Schedule through the streaming solver (cfg5 federation path).
+
+    No warmup pass: the wall includes any compile not served by the
+    persistent cache — the honest cold-ish number for the stretch config
+    (steady-state compile behavior is covered by cfg1-4's warmed runs).
+    """
+    from nhd_tpu.solver import BatchItem, StreamingScheduler
+
+    sched = StreamingScheduler(
+        tile_nodes=tile_nodes, chunk_pods=chunk_pods,
+        respect_busy=False, register_pods=False,
+    )
+    items = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
+    t0 = time.perf_counter()
+    results, stats = sched.schedule(nodes, items, now=0.0)
+    wall = time.perf_counter() - t0
+    placed = sum(1 for r in results if r.node)
+    return wall, placed, stats, results
+
+
+def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40,
+                 cluster_fn=None, runner=run_batch):
     from nhd_tpu.sim.workloads import bench_cluster, workload_mix
 
+    cluster_fn = cluster_fn or bench_cluster
     reqs = workload_mix(n_pods, groups)
-    wall, placed, stats, results = run_batch(bench_cluster(n_nodes, groups), reqs)
+    wall, placed, stats, results = runner(cluster_fn(n_nodes, groups), reqs)
 
-    per_pod = run_serial_baseline(bench_cluster(n_nodes, groups), reqs,
+    per_pod = run_serial_baseline(cluster_fn(n_nodes, groups), reqs,
                                   baseline_sample)
     baseline_wall = per_pod * n_pods
     speedup = baseline_wall / wall if wall > 0 else 0.0
@@ -188,20 +215,36 @@ def main() -> None:
 
     bench_bind_latency()
 
+    from nhd_tpu.sim.workloads import cap_cluster
+
     bench_config("cfg1:100x32", 100, 32, ["default"], baseline_sample=30)
     bench_config("cfg2:1kx256", 1000, 256, ["default"], baseline_sample=30)
 
+    # cfg3: NIC-saturated contention shape (places ~4k of 10k — the cluster
+    # runs out of unshared NICs; throughput under heavy infeasibility)
+    bench_config(
+        "cfg3:10kx1k-sat", 10_000, 1_000, ["default", "edge", "batch"],
+        baseline_sample=40,
+    )
+
+    # cfg4 (headline): capacity-matched — every pod places
     from nhd_tpu.utils.tracing import profiler_trace
 
     with profiler_trace(os.environ.get("NHD_BENCH_PROFILE")):
         result = bench_config(
-            "cfg3:10kx1k", 10_000, 1_000, ["default", "edge", "batch"],
-            baseline_sample=40,
+            "cfg4:10kx1k-cap", 10_000, 1_000, ["default", "edge", "batch"],
+            baseline_sample=40, cluster_fn=cap_cluster,
         )
-    if os.environ.get("NHD_BENCH_STRETCH"):
+    if result["placed"] < 10_000:
+        _log(f"bench: WARNING cfg4 placed {result['placed']}/10000 "
+             "on the capacity-matched cluster")
+
+    # cfg5: federation stretch through the streaming solver (default-on)
+    if not os.environ.get("NHD_BENCH_SKIP_FED"):
         bench_config(
-            "cfg4:100kx10k", 100_000, 10_000,
+            "cfg5:100kx10k-stream", 100_000, 10_000,
             ["default", "edge", "batch", "fed1", "fed2"], baseline_sample=10,
+            cluster_fn=cap_cluster, runner=run_stream,
         )
 
     print(json.dumps({

@@ -93,4 +93,6 @@ def solve_bucket_sharded(cluster, pods, mesh: Optional[Mesh] = None) -> SolveOut
 
     solver = get_sharded_solver(pods.G, cluster.U, cluster.K, mesh)
     out = solver(*node_args, *pod_args)
-    return SolveOut(*(np.asarray(x)[:T, :N] for x in out))
+    # np.array (copy): a zero-copy view would dangle once the jax arrays
+    # are dropped at return (see solver/batch.py bucket_out note)
+    return SolveOut(*(np.array(x[:T, :N]) for x in out))

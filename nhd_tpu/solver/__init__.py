@@ -16,6 +16,8 @@ __all__ = [
     "JaxMatcher",
     "MatchResult",
     "OracleMatcher",
+    "ScheduleContext",
+    "StreamingScheduler",
     "find_node",
 ]
 
@@ -25,6 +27,8 @@ _LAZY = {
     "BatchScheduler": "nhd_tpu.solver.batch",
     "BatchStats": "nhd_tpu.solver.batch",
     "JaxMatcher": "nhd_tpu.solver.jax_matcher",
+    "ScheduleContext": "nhd_tpu.solver.batch",
+    "StreamingScheduler": "nhd_tpu.solver.streaming",
 }
 
 

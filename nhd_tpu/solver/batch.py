@@ -74,6 +74,8 @@ class BatchAssignment:
     mapping: Optional[Dict[str, tuple]] = None
     nic_list: Optional[list] = None      # (nic_index, speed, dir) consumed
     round_no: int = -1
+    failed: bool = False                 # terminal assignment failure (vs
+    #                                      merely no candidate node)
 
 
 from collections import namedtuple
@@ -81,6 +83,26 @@ from collections import namedtuple
 SolveHost = namedtuple(
     "SolveHost", "cand pref best_c best_m best_a n_combos n_picks"
 )
+
+
+@dataclass
+class ScheduleContext:
+    """Persistent per-cluster solve state reusable across schedule() calls.
+
+    Built once via BatchScheduler.make_context and passed to schedule():
+    the cluster encode, the FastCluster allocation arrays and the
+    device-resident (possibly mesh-sharded) arrays all survive between
+    calls, so streaming pod chunks through the same node tile
+    (solver/streaming.py) pays O(claimed rows), not O(tile), per chunk.
+    The HostNode mirror stays in sync (FastCluster.sync_to_nodes is
+    incremental over touched nodes).
+    """
+
+    nodes: Dict[str, "HostNode"]
+    cluster: "ClusterArrays"
+    fast: Optional["FastCluster"]
+    dev: Optional["DeviceClusterState"]
+    now: float
 
 
 def _accelerator_backend() -> bool:
@@ -258,6 +280,38 @@ class BatchScheduler:
             results[i] = BatchAssignment(item.key, m.node, m.mapping, nic_list)
             stats.scheduled += 1
 
+    def make_context(
+        self, nodes: Dict[str, HostNode], *, now: Optional[float] = None
+    ) -> ScheduleContext:
+        """Encode *nodes* once into a reusable ScheduleContext.
+
+        Pass the result to repeated schedule() calls over the same node set
+        (the streaming tile pattern): the encode, FastCluster arrays, and
+        device-resident state all persist, and each call pays only for the
+        rows its claims touch. Busy stamps are resolved against *now* once,
+        at context creation.
+        """
+        if now is None:
+            now = time.monotonic()
+        cluster = encode_cluster(nodes, now=now)
+        if not self.respect_busy:
+            cluster.busy[:] = False
+        fast = (
+            FastCluster(nodes, cluster.U, cluster.K, arrays=cluster)
+            if self.use_fast
+            else None
+        )
+        mesh = self._resolve_mesh()
+        use_dev = (
+            self.device_state is True
+            or (
+                self.device_state == "auto"
+                and (_accelerator_backend() or mesh is not None)
+            )
+        )
+        dev = DeviceClusterState(cluster, mesh) if use_dev else None
+        return ScheduleContext(nodes, cluster, fast, dev, now)
+
     def schedule(
         self,
         nodes: Dict[str, HostNode],
@@ -265,12 +319,18 @@ class BatchScheduler:
         *,
         now: Optional[float] = None,
         apply: bool = True,
+        context: Optional[ScheduleContext] = None,
     ) -> Tuple[List[BatchAssignment], BatchStats]:
         """Place every item it can; mutates ``nodes`` when ``apply``.
 
         Items without a topology get a synthetic one (sim.requests), so
         physical assignment always runs — claims must hit the host mirror
         for subsequent rounds to see them.
+
+        With ``context`` (from make_context over the same ``nodes``), the
+        per-call encode and array construction are skipped; combo-oversized
+        pods are rejected there (the caller pre-routes them — see
+        solver/streaming.py).
         """
         from nhd_tpu.sim.requests import request_to_topology
 
@@ -283,11 +343,18 @@ class BatchScheduler:
             if it.request.map_mode in (MapMode.NUMA, MapMode.PCI)
         ]
         if now is None:
-            now = time.monotonic()
+            now = context.now if context is not None else time.monotonic()
 
+        if context is not None and context.nodes is not nodes:
+            raise ValueError(
+                "context was built for a different nodes dict"
+            )
         node_list = list(nodes.values())
-        cluster = encode_cluster(nodes, now=now)
-        if not self.respect_busy:
+        cluster = (
+            context.cluster if context is not None
+            else encode_cluster(nodes, now=now)
+        )
+        if context is None and not self.respect_busy:
             cluster.busy[:] = False
 
         # combo lattices too large for dense enumeration take the serial
@@ -299,6 +366,13 @@ class BatchScheduler:
                 items[i].request.n_groups, cluster.U, cluster.K
             )
         ]
+        if oversized and context is not None:
+            # serial claims would mutate the HostNode mirror behind the
+            # context's packed arrays
+            raise ValueError(
+                "combo-oversized pods cannot be scheduled through a "
+                "persistent context; route them to the serial path first"
+            )
         if oversized:
             # NOTE: the pre-pass gives oversized pods their claims before any
             # greedy round, so in a capacity-contended mixed batch they win
@@ -317,24 +391,28 @@ class BatchScheduler:
                 if not self.respect_busy:
                     cluster.busy[:] = False
 
-        fast = (
-            FastCluster(nodes, cluster.U, cluster.K, arrays=cluster)
-            if (self.use_fast and apply)
-            else None
-        )
-        # keep node arrays resident on device across rounds; per-round
-        # uploads shrink to the claimed rows (solver/device_state.py).
-        # A multi-device mesh implies resident state: sharded arrays must
-        # live on their devices for the SPMD solve.
-        mesh = self._resolve_mesh()
-        use_dev = (
-            self.device_state is True
-            or (
-                self.device_state == "auto"
-                and (_accelerator_backend() or mesh is not None)
+        if context is not None:
+            fast = context.fast if apply else None
+            dev = context.dev
+        else:
+            fast = (
+                FastCluster(nodes, cluster.U, cluster.K, arrays=cluster)
+                if (self.use_fast and apply)
+                else None
             )
-        )
-        dev = DeviceClusterState(cluster, mesh) if use_dev else None
+            # keep node arrays resident on device across rounds; per-round
+            # uploads shrink to the claimed rows (solver/device_state.py).
+            # A multi-device mesh implies resident state: sharded arrays must
+            # live on their devices for the SPMD solve.
+            mesh = self._resolve_mesh()
+            use_dev = (
+                self.device_state is True
+                or (
+                    self.device_state == "auto"
+                    and (_accelerator_backend() or mesh is not None)
+                )
+            )
+            dev = DeviceClusterState(cluster, mesh) if use_dev else None
         records: Dict[int, AssignRecord] = {}
         busy_nodes: set = set()
         all_buckets = None
@@ -373,8 +451,12 @@ class BatchScheduler:
                 )
                 out = dev.solve(pods) if dev else solve_bucket(cluster, pods)
                 # pull results to host once — element reads off jax arrays
-                # cost ~0.2 ms each and the winner loop does three per pod
-                bucket_out[G] = (pods, SolveHost(*map(np.asarray, out)))
+                # cost ~0.2 ms each and the winner loop does three per pod.
+                # np.array (copy), NOT np.asarray: the zero-copy view aliases
+                # the jax buffer, which is dropped right here — reads through
+                # a dangling view are undefined (bit us as phantom -2
+                # assignment failures in the streaming path)
+                bucket_out[G] = (pods, SolveHost(*(np.array(x) for x in out)))
             stats.solve_seconds += time.perf_counter() - t0
 
             t0 = time.perf_counter()
@@ -488,7 +570,7 @@ class BatchScheduler:
                                 f"assignment failed for {item.key} on "
                                 f"{cluster.names[n]}: stage {int(status[w])}"
                             )
-                            results[pod_i] = BatchAssignment(item.key, None)
+                            results[pod_i] = BatchAssignment(item.key, None, failed=True)
                             newly_scheduled.append(pod_i)
                             stats.failed += 1
                             continue
@@ -560,7 +642,7 @@ class BatchScheduler:
                         self.logger.error(
                             f"assignment failed for {item.key} on {node.name}: {exc}"
                         )
-                        results[pod_i] = BatchAssignment(item.key, None)
+                        results[pod_i] = BatchAssignment(item.key, None, failed=True)
                         newly_scheduled.append(pod_i)
                         stats.failed += 1
                         continue
@@ -589,7 +671,7 @@ class BatchScheduler:
                     self.logger.error(
                         f"cannot materialize topology for {item.key}: {exc}"
                     )
-                    results[pod_i] = BatchAssignment(item.key, None)
+                    results[pod_i] = BatchAssignment(item.key, None, failed=True)
                     newly_scheduled.append(pod_i)
                     stats.failed += 1
                     continue
@@ -604,7 +686,7 @@ class BatchScheduler:
                     self.logger.error(
                         f"assignment failed for {item.key} on {node.name}: {exc}"
                     )
-                    results[pod_i] = BatchAssignment(item.key, None)
+                    results[pod_i] = BatchAssignment(item.key, None, failed=True)
                     newly_scheduled.append(pod_i)  # drop from pending
                     stats.failed += 1
                     continue

@@ -74,17 +74,12 @@ class ClusterArrays:
         return len(self.names)
 
 
-def encode_cluster(
-    nodes: Dict[str, HostNode],
-    *,
-    now: Optional[float] = None,
-    interner: Optional[GroupInterner] = None,
-) -> ClusterArrays:
-    """Project HostNodes into dense arrays (one row per node, name order =
-    dict insertion order = the reference's node iteration order)."""
-    names = list(nodes.keys())
-    nl = [nodes[n] for n in names]
-    N = len(nl)
+def cluster_dims(nodes) -> Tuple[int, int, int]:
+    """(U, K, S) padding dims for a node collection: max NUMA nodes, max
+    NICs per NUMA, max PCIe switches per node. The single source of the
+    rule — streaming's oversized routing (solver/streaming.py) must judge
+    tractability with exactly the dims the tile encodes will use."""
+    nl = list(nodes.values()) if isinstance(nodes, dict) else list(nodes)
     U = max((n.numa_nodes for n in nl), default=1) or 1
     K = 1
     S = 1
@@ -96,6 +91,21 @@ def encode_cluster(
         K = max(K, max(per_numa, default=0))
         switches = {g.pciesw for g in node.gpus} | {n.pciesw for n in node.nics}
         S = max(S, len(switches))
+    return U, K, S
+
+
+def encode_cluster(
+    nodes: Dict[str, HostNode],
+    *,
+    now: Optional[float] = None,
+    interner: Optional[GroupInterner] = None,
+) -> ClusterArrays:
+    """Project HostNodes into dense arrays (one row per node, name order =
+    dict insertion order = the reference's node iteration order)."""
+    names = list(nodes.keys())
+    nl = [nodes[n] for n in names]
+    N = len(nl)
+    U, K, S = cluster_dims(nl)
 
     interner = interner or GroupInterner()
     arr = ClusterArrays(

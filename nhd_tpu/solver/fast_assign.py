@@ -174,11 +174,18 @@ class FastCluster:
 
     def _bucket_arrays(self, pods) -> tuple:
         """[T, G] raw demand arrays for a bucket (cached across rounds —
-        dataclasses.replace shares the underlying requests list)."""
+        dataclasses.replace shares the underlying requests list).
+
+        The cache entry PINS the keyed requests list: an id() key is only
+        unique while the object lives, and CPython reuses ids aggressively
+        — without the pin, a later bucket's fresh list could collide with
+        a dead one's id and be served the WRONG demand arrays (this
+        happened in practice under the streaming chunk pattern: phantom
+        -1/-2 assignment failures and silent accounting drift)."""
         key = id(pods.requests)
         got = self._bucket_cache.get(key)
         if got is not None:
-            return got
+            return got[1]
         T, G = len(pods.requests), pods.G
         t_proc = np.zeros((T, G), np.int32)
         t_proc_smt = np.zeros((T, G), np.int32)
@@ -200,7 +207,13 @@ class FastCluster:
         gmx = max(int(t_gpus.sum(1).max(initial=0)), 1)
         got = (t_proc, t_proc_smt, t_help, t_help_smt, t_gpus,
                t_misc, t_misc_smt, maxc, gmx)
-        self._bucket_cache[key] = got
+        # bound the cache: a persistent-context FastCluster sees a fresh
+        # requests list per schedule() call; without eviction the pins
+        # accumulate forever. Recompute cost is trivial, so a coarse
+        # clear-on-overflow keeps within-call reuse and bounds memory.
+        if len(self._bucket_cache) >= 64:
+            self._bucket_cache.clear()
+        self._bucket_cache[key] = (pods.requests, got)
         return got
 
     def assign_round(self, pods, w_node, w_type, w_c, w_m, *,

@@ -115,11 +115,13 @@ class JaxMatcher:
 
         for G, pods in buckets.items():
             out = solve_bucket(cluster, pods)
-            cand = np.asarray(out.cand)
-            pref = np.asarray(out.pref)
-            best_c = np.asarray(out.best_c)
-            best_m = np.asarray(out.best_m)
-            best_a = np.asarray(out.best_a)
+            # np.array (copy): zero-copy views must not outlive the jax
+            # arrays they alias (see solver/batch.py bucket_out note)
+            cand = np.array(out.cand)
+            pref = np.array(out.pref)
+            best_c = np.array(out.best_c)
+            best_m = np.array(out.best_m)
+            best_a = np.array(out.best_a)
 
             N = cand.shape[1]
             # lexicographic (pref desc, node index asc) via one argmax

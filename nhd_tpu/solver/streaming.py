@@ -1,0 +1,189 @@
+"""Streaming solver for federation-scale problems (BASELINE config 5).
+
+The 100k-pod × 10k-node federation config must not materialize one giant
+solve: this module tiles the *node axis* into fixed-size tiles (each a
+region/cluster of the federation) and streams *pod chunks* through them —
+the scheduler-domain analog of blockwise/ring long-axis techniques
+(SURVEY §5.7: "block the node axis across devices, stream pod batches
+through").
+
+Memory is bounded by (tile_nodes × encode width) + (chunk_pods ×
+bookkeeping): each tile owns a persistent ScheduleContext (packed arrays +
+FastCluster + device-resident, mesh-sharded state), so a chunk visiting a
+tile pays only for the rows it claims, never a re-encode. Within one
+device, tiles stream sequentially; on a multi-device mesh each tile's
+solve is itself sharded over the mesh (solver/batch.py auto-mesh), so the
+two axes compose: tiles over time, nodes-within-tile over devices.
+
+Placement semantics: pods visit tiles in name order and fill earlier
+tiles first — the same first-fit shape the reference's sequential walk
+produces over one big node list (Matcher.py:393-421 picks the first
+candidate), realized tile-by-tile. Every claim is re-verified against
+live state exactly as in BatchScheduler; serializability per node is
+unchanged. One documented deviation: the gpuless-node selection
+preference (Matcher.py:404-416) applies *within* a tile, not globally —
+a CPU-only pod takes a feasible GPU node in an early tile rather than a
+gpuless node in a later one. That is the federation-locality trade-off
+(earlier tiles = nearer regions); on homogeneous clusters placement is
+identical to the untiled scheduler (tests/test_streaming.py). Combo-
+oversized pods (bucket_tractable=False) take the serial oracle pre-pass
+against the full cluster, mirroring BatchScheduler's documented
+oversized-first exception.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from nhd_tpu.core.node import HostNode
+from nhd_tpu.core.topology import MapMode
+from nhd_tpu.solver.batch import (
+    BatchAssignment,
+    BatchItem,
+    BatchScheduler,
+    BatchStats,
+    ScheduleContext,
+)
+from nhd_tpu.solver.encode import cluster_dims
+from nhd_tpu.solver.kernel import bucket_tractable
+from nhd_tpu.utils import get_logger
+
+
+class StreamingScheduler:
+    """Tile the node axis, stream pod chunks through the tiles.
+
+    ``tile_nodes`` bounds the per-solve node count (encode + solve memory);
+    ``chunk_pods`` bounds the per-call pod bookkeeping. Remaining keyword
+    arguments configure the underlying BatchScheduler (respect_busy,
+    use_fast, mesh, ...).
+    """
+
+    def __init__(
+        self,
+        *,
+        tile_nodes: int = 2048,
+        chunk_pods: int = 16384,
+        **batch_kwargs,
+    ):
+        if tile_nodes < 1 or chunk_pods < 1:
+            raise ValueError("tile_nodes and chunk_pods must be >= 1")
+        self.logger = get_logger(__name__)
+        self.tile_nodes = tile_nodes
+        self.chunk_pods = chunk_pods
+        self.batch = BatchScheduler(**batch_kwargs)
+
+    def schedule(
+        self,
+        nodes: Dict[str, HostNode],
+        items: Sequence[BatchItem],
+        *,
+        now: Optional[float] = None,
+    ) -> Tuple[List[BatchAssignment], BatchStats]:
+        """Place every item it can; mutates ``nodes``. Same contract as
+        BatchScheduler.schedule (apply semantics only)."""
+        if now is None:
+            now = time.monotonic()
+        t_stream = time.perf_counter()
+
+        stats = BatchStats()
+        results: List[BatchAssignment] = [
+            BatchAssignment(it.key, None) for it in items
+        ]
+        schedulable = [
+            i for i, it in enumerate(items)
+            if it.request.map_mode in (MapMode.NUMA, MapMode.PCI)
+        ]
+
+        # node tiles in name-insertion order (the reference's iteration
+        # order): tile boundaries never split the first-fit preference,
+        # because earlier tiles are exhausted before later ones are offered
+        names = list(nodes.keys())
+        tiles: List[Dict[str, HostNode]] = [
+            {n: nodes[n] for n in names[i : i + self.tile_nodes]}
+            for i in range(0, len(names), self.tile_nodes)
+        ]
+
+        # oversized pre-pass against the FULL cluster (tiles would hide
+        # feasible nodes from the serial oracle) — BatchScheduler's
+        # oversized-first exception, applied before any tile context exists
+        # so serial claims are visible in every tile's encode below.
+        # Tractability is judged at the worst-case (globally maximal) U/K —
+        # the same rule every tile's encode uses (encode.cluster_dims), so
+        # nothing deemed tractable here can be oversized inside a tile.
+        U, K, _ = cluster_dims(nodes)
+        oversized = [
+            i for i in schedulable
+            if not bucket_tractable(items[i].request.n_groups, U, K)
+        ]
+        if oversized:
+            self.batch._schedule_serial(
+                nodes, items, oversized, results, stats, now, True
+            )
+            ov = set(oversized)
+            schedulable = [i for i in schedulable if i not in ov]
+            stats.round_end_seconds.append(time.perf_counter() - t_stream)
+            for i in oversized:
+                if results[i].node is not None:
+                    results[i].round_no = len(stats.round_end_seconds) - 1
+
+        contexts: List[Optional[ScheduleContext]] = [None] * len(tiles)
+
+        for lo in range(0, len(schedulable), self.chunk_pods):
+            chunk = schedulable[lo : lo + self.chunk_pods]
+            pending = list(chunk)
+            for ti, tile in enumerate(tiles):
+                if not pending:
+                    break
+                if contexts[ti] is None:
+                    contexts[ti] = self.batch.make_context(tile, now=now)
+                sub_items = [items[i] for i in pending]
+                t_sub = time.perf_counter()
+                sub_results, sub_stats = self.batch.schedule(
+                    tile, sub_items, now=now, context=contexts[ti]
+                )
+                # merge: remap round numbers into the streaming timeline
+                offset = len(stats.round_end_seconds)
+                shift = t_sub - t_stream
+                stats.round_end_seconds.extend(
+                    t + shift for t in sub_stats.round_end_seconds
+                )
+                stats.rounds += sub_stats.rounds
+                stats.solve_seconds += sub_stats.solve_seconds
+                stats.select_seconds += sub_stats.select_seconds
+                stats.assign_seconds += sub_stats.assign_seconds
+                stats.scheduled += sub_stats.scheduled
+                # NOT sub_stats.failed: a pod failing its first-on-node
+                # claim in one tile is re-offered to later tiles, so
+                # per-tile failure counts would double-book; terminal
+                # failures are recounted from result flags at the end
+
+                still_pending: List[int] = []
+                for pod_i, r in zip(pending, sub_results):
+                    if r.node is None:
+                        # carry the latest tile's verdict (failed flag) so
+                        # the final stats can distinguish assignment
+                        # failure from plain unschedulability
+                        results[pod_i] = r
+                        still_pending.append(pod_i)
+                        continue
+                    if r.round_no >= 0:
+                        r = BatchAssignment(
+                            r.key, r.node, r.mapping, r.nic_list,
+                            r.round_no + offset,
+                        )
+                    results[pod_i] = r
+                pending = still_pending
+            if pending:
+                self.logger.info(
+                    f"streaming: {len(pending)} pods of chunk "
+                    f"{lo // self.chunk_pods} unschedulable after "
+                    f"{len(tiles)} tiles"
+                )
+        # stats.failed so far counts only the serial pre-pass (never
+        # retried); add pods whose final tile verdict was a hard failure
+        stats.failed += sum(
+            1 for i in schedulable
+            if results[i].node is None and results[i].failed
+        )
+        return results, stats
