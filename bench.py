@@ -157,6 +157,36 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40,
     return {"wall": wall, "placed": placed, "speedup": speedup}
 
 
+def bench_cold_start() -> None:
+    """First pod create→bind after a scheduler (re)start, in THIS fresh
+    process: includes config parse, solver trace and compile (or
+    persistent-cache load — exactly what a crash-only restart pays).
+    Must run before any other bench warms the jit caches."""
+    import queue as queue_mod
+
+    from nhd_tpu.k8s.fake import FakeClusterBackend
+    from nhd_tpu.scheduler.core import Scheduler
+    from nhd_tpu.scheduler.events import WatchQueue
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+
+    backend = FakeClusterBackend()
+    for i in range(8):
+        spec = SynthNodeSpec(name=f"cold-node{i}")
+        backend.add_node(spec.name, make_node_labels(spec),
+                         hugepages_gb=spec.hugepages_gb)
+    sched = Scheduler(backend, WatchQueue(), queue_mod.Queue(),
+                      respect_busy=False)
+    sched.build_initial_node_list()
+    backend.create_pod("cold-0", cfg_text=make_triad_config(gpus_per_group=1))
+    t0 = time.perf_counter()
+    sched.attempt_scheduling_batch([("cold-0", "default", "uid-cold")])
+    dt = time.perf_counter() - t0
+    bound = backend.pods[("default", "cold-0")].node
+    _log(f"bench[cold-start]: first create→bind after restart = "
+         f"{dt * 1e3:.0f}ms (bound to {bound}; includes first-solve "
+         f"trace + compile/cache-load)")
+
+
 def bench_bind_latency(n_pods: int = 200) -> None:
     """Event-driven single-pod path latency (p50/p99): pod create → bound,
     through the full scheduler on the fake backend — config parse, batched
@@ -213,6 +243,7 @@ def main() -> None:
     _log(f"bench platform: {jax.devices()[0].platform} "
          f"({len(jax.devices())} device(s))")
 
+    bench_cold_start()
     bench_bind_latency()
 
     from nhd_tpu.sim.workloads import cap_cluster

@@ -12,15 +12,19 @@ The reference's analog is its API-server-centric distribution (SURVEY
 
 Usage on each host of a multi-host deployment:
 
-    from nhd_tpu.parallel import multihost, make_mesh
+    from nhd_tpu.parallel import multihost
     multihost.initialize(coordinator="host0:9999", num_processes=4,
                          process_id=RANK)
-    mesh = make_mesh()          # global mesh over every host's devices
-    # BatchScheduler/solve_bucket_sharded proceed unchanged: pjit handles
-    # cross-host collectives; each host feeds its local node shard.
+    mine = multihost.local_nodes(all_nodes)   # this host's region
+    StreamingScheduler(...).schedule(mine, items)
+    # tiles stream within the host; each tile's solve shards over the
+    # host's LOCAL devices (BatchScheduler auto-mesh uses
+    # jax.local_devices() — per-host solves are independent programs).
 
-Cannot be exercised on this single-host dev image; the virtual 8-device
-CPU mesh (tests/conftest.py) covers the sharded code path itself.
+Cannot be exercised end-to-end on this single-host dev image; the virtual
+8-device CPU mesh (tests/conftest.py) covers the sharded code path and
+tests/test_multihost.py covers the shard partitioning with a mocked
+process topology.
 """
 
 from __future__ import annotations
@@ -70,3 +74,17 @@ def local_node_slice(n_nodes: int) -> slice:
     per = -(-n_nodes // jax.process_count())  # ceil division
     start = per * jax.process_index()
     return slice(start, min(start + per, n_nodes))
+
+
+def local_nodes(nodes: dict) -> dict:
+    """This process's node shard of a federation cluster — the multi-host
+    streaming pattern: each host runs a StreamingScheduler over its own
+    region (`StreamingScheduler.schedule(local_nodes(all), ...)`), so
+    tiles stream within a host while the per-tile solve shards over that
+    host's devices. Names are SORTED before slicing: each host builds its
+    dict from its own API listing whose order is not guaranteed, and the
+    partition must be identical on every host (exact cover, no node owned
+    twice)."""
+    names = sorted(nodes.keys())
+    s = local_node_slice(len(names))
+    return {n: nodes[n] for n in names[s]}

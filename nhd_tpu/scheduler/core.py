@@ -359,8 +359,12 @@ class Scheduler(threading.Thread):
 
     def check_pending_pods(self) -> None:
         """Full-cluster scan: batch-schedule Pending pods, release Failed
-        ones (reference: NHDScheduler.py:425-441)."""
+        ones (reference: NHDScheduler.py:425-441), and reconcile the host
+        mirror against the live pod list."""
         podlist = self.backend.service_pods(self.sched_name)
+        self.reconcile_deleted_pods(
+            {(ns, pod): uid for (ns, pod, uid) in podlist}
+        )
         to_schedule: List[Tuple[str, str, str]] = []
         for (ns, pod, uid), (phase, node) in podlist.items():
             key = (ns, pod)
@@ -380,6 +384,49 @@ class Scheduler(threading.Thread):
                 }
         if to_schedule:
             self.attempt_scheduling_batch(to_schedule)
+
+    def reconcile_deleted_pods(self, live: Dict[Tuple[str, str], str]) -> None:
+        """Release claims for pod incarnations the cluster no longer has.
+
+        The delete-safety net: the reference pins deletions with a
+        finalizer so the solved config stays readable at release time
+        (TriadController.py:19-23); this rebuild instead keeps the solved
+        topology in the host mirror (node.pod_info), so a delete whose
+        watch event was missed (controller down, queue loss) is caught by
+        this periodic mirror-vs-live diff and released from the stored
+        topology directly — no finalizer, no full-cluster rescan.
+
+        ``live`` maps (ns, pod) → uid from the same service_pods snapshot
+        the caller is about to schedule from, so anything in the mirror
+        but not in ``live`` was bound before the snapshot and is truly
+        gone (single-writer loop: no claim can interleave). The uid also
+        catches delete+recreate under the same name (TriadSet ordinals):
+        a live pod whose uid differs from the claimed incarnation's means
+        the claimed one is dead — release it so the new Pending pod can
+        schedule this very scan instead of stalling behind a stale
+        SCHEDULED record (the event path's uid check, mirrored here).
+        """
+        for node in self.nodes.values():
+            for pod, ns in list(node.pod_info):
+                key = (ns, pod)
+                live_uid = live.get(key)
+                if live_uid is not None:
+                    st = self.pod_state.get(key)
+                    claimed_uid = st.get("uid") if st else None
+                    if claimed_uid in (None, "0") or claimed_uid == live_uid:
+                        continue  # same incarnation (or unknown): keep
+                    why = (f"replaced (uid {claimed_uid} -> {live_uid}) "
+                           "without a delete event")
+                else:
+                    why = "vanished without a delete event"
+                self.logger.warning(
+                    f"{ns}.{pod} {why}; releasing its claims on "
+                    f"{node.name} from the mirror"
+                )
+                top = node.pod_info[(pod, ns)]
+                node.release_from_topology(top)
+                node.remove_scheduled_pod(pod, ns)
+                self.pod_state.pop(key, None)
 
     # ------------------------------------------------------------------
     # stats (consumed by the RPC plane)

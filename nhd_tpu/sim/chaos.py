@@ -32,6 +32,7 @@ class ChaosStats:
     bind_failures: int = 0
     restarts: int = 0
     group_moves: int = 0
+    silent_deletes: int = 0
     violations: List[str] = field(default_factory=list)
 
 
@@ -92,6 +93,19 @@ class ChaosSim:
             self.backend.delete_pod(victim.name, victim.namespace)
             self.stats.deleted += 1
 
+    def _act_silent_delete(self) -> None:
+        """Controller-down deletion: the pod vanishes with NO watch event;
+        only the periodic mirror-vs-live diff
+        (Scheduler.reconcile_deleted_pods) can release its claims."""
+        bound = [p for p in self.backend.pods.values() if p.node]
+        if bound:
+            victim = self.rng.choice(bound)
+            self.backend.delete_pod(
+                victim.name, victim.namespace, emit_watch=False
+            )
+            self.stats.deleted += 1
+            self.stats.silent_deletes += 1
+
     def _act_cordon(self) -> None:
         name = self.rng.choice(list(self.backend.nodes))
         self.backend.cordon_node(name, self.rng.random() < 0.5)
@@ -127,8 +141,8 @@ class ChaosSim:
         action = self.rng.choices(
             [self._act_create, self._act_delete, self._act_cordon,
              self._act_maintenance, self._act_bind_failure, self._act_restart,
-             self._act_group_move],
-            weights=[40, 15, 10, 10, 10, 5, 8],
+             self._act_group_move, self._act_silent_delete],
+            weights=[40, 15, 10, 10, 10, 5, 8, 8],
         )[0]
         action()
         # let the control plane catch up

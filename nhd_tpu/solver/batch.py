@@ -201,7 +201,14 @@ class BatchScheduler:
         from nhd_tpu.parallel.sharding import make_mesh
 
         try:
-            devices = jax.devices()
+            # local_devices, NOT devices: each scheduler process runs an
+            # independent computation over its own node shard (multihost
+            # pattern). A mesh over jax.devices() after
+            # jax.distributed.initialize would span every host and demand
+            # lockstep cross-host collectives that don't exist here. A
+            # global SPMD solve is still available by passing an explicit
+            # mesh.
+            devices = jax.local_devices()
         except Exception:
             return None
         return make_mesh(devices) if len(devices) > 1 else None

@@ -3,6 +3,8 @@
 import copy
 import random
 
+import pytest
+
 from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
 from nhd_tpu.core.topology import MapMode, SmtMode
 from nhd_tpu.sim import SynthNodeSpec, make_cluster
@@ -123,6 +125,58 @@ def test_round_path_equals_per_pod_path():
     assert [r.node for r in r1] == [r.node for r in r2]
     assert [r.mapping for r in r1] == [r.mapping for r in r2]
     assert s1.scheduled == s2.scheduled
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_pci_single_pod_batch_superset_of_oracle(seed):
+    """PCI-mode batch parity (docs/PARITY.md 'Batch-mode extensions'):
+    for single-pod batches the batch must place everything the oracle
+    places (same node), must never invent feasibility the oracle lacks,
+    and may additionally place pods the oracle match-then-fails on (the
+    PCI quirk) — the documented strict improvement."""
+    import dataclasses
+
+    from nhd_tpu.core.node import AssignmentError
+    from nhd_tpu.core.topology import MapMode
+    from nhd_tpu.sim.requests import request_to_topology
+    from tests.test_jax_matcher import random_cluster, random_request
+
+    rng = random.Random(7000 + seed)
+    base = random_cluster(rng, 5)
+    for _ in range(6):
+        req = dataclasses.replace(random_request(rng), map_mode=MapMode.PCI)
+
+        nodes_o = copy.deepcopy(base)
+        m = find_node(nodes_o, req, now=1010.0, respect_busy=False)
+        oracle_outcome = None
+        if m is not None:
+            try:
+                top = request_to_topology(req)
+                nodes_o[m.node].assign_physical_ids(m.mapping, top)
+                oracle_outcome = m.node
+            except (AssignmentError, ValueError):
+                oracle_outcome = "QUIRK_FAIL"
+
+        nodes_b = copy.deepcopy(base)
+        results, _ = BatchScheduler(respect_busy=False).schedule(
+            nodes_b, items([req]), now=1010.0
+        )
+        got = results[0].node
+
+        if m is None:
+            assert got is None, (
+                f"batch invented feasibility the oracle lacks: {req}"
+            )
+        elif oracle_outcome == "QUIRK_FAIL":
+            # improvement allowed, not required; placements must be sound
+            if got is not None:
+                n = nodes_b[got]
+                assert n.free_gpu_count() >= 0
+                assert all(c >= 0 for c in n.free_cpu_cores_per_numa())
+        else:
+            assert got == oracle_outcome, (
+                f"oracle placed on {oracle_outcome}, batch on {got}"
+            )
 
 
 def test_busy_backoff_limits_gpu_pods_per_node():

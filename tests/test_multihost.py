@@ -1,0 +1,47 @@
+"""Multi-host helpers: the per-process node shard must exactly partition
+the cluster (single-host dev image: process topology is mocked)."""
+
+from unittest import mock
+
+import pytest
+
+from nhd_tpu.parallel import multihost
+from nhd_tpu.sim import make_cluster
+
+
+@pytest.mark.parametrize("n_proc,n_nodes", [(1, 5), (2, 10), (3, 10), (4, 3)])
+def test_local_node_slices_partition(n_proc, n_nodes):
+    nodes = make_cluster(n_nodes)
+    shards = []
+    for rank in range(n_proc):
+        with mock.patch("jax.process_count", return_value=n_proc), \
+             mock.patch("jax.process_index", return_value=rank):
+            shards.append(multihost.local_nodes(nodes))
+    seen = [name for s in shards for name in s]
+    assert seen == list(nodes.keys())          # exact cover, stable order
+    assert len(seen) == len(set(seen))         # no node owned twice
+    # block layout: every shard is contiguous in name order
+    names = list(nodes.keys())
+    at = 0
+    for s in shards:
+        assert list(s.keys()) == names[at:at + len(s)]
+        at += len(s)
+
+
+def test_local_nodes_feed_streaming():
+    """The documented multi-host pattern composes: a rank's shard goes
+    straight into StreamingScheduler."""
+    from nhd_tpu.solver import BatchItem, StreamingScheduler
+    from tests.test_batch import simple_request
+
+    nodes = make_cluster(6)
+    with mock.patch("jax.process_count", return_value=2), \
+         mock.patch("jax.process_index", return_value=1):
+        mine = multihost.local_nodes(nodes)
+    assert len(mine) == 3
+    items = [BatchItem(("ns", f"p{i}"), simple_request()) for i in range(4)]
+    results, stats = StreamingScheduler(
+        tile_nodes=2, respect_busy=False
+    ).schedule(mine, items, now=0.0)
+    assert stats.scheduled == 4
+    assert all(r.node in mine for r in results)
