@@ -31,7 +31,7 @@ import numpy as np
 from nhd_tpu.solver.encode import ClusterArrays
 from nhd_tpu.solver.kernel import (
     SolveOut,
-    USE_PALLAS,
+    pallas_enabled,
     _pad_pow2,
     get_solver,
     pad_nodes,
@@ -104,7 +104,7 @@ class DeviceClusterState:
         self.N = cluster.n_nodes
         self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
         n_dev = self.mesh.devices.size if self.mesh else 1
-        self.Np = pad_nodes(self.N, n_dev, floor=128 if USE_PALLAS else 8)
+        self.Np = pad_nodes(self.N, n_dev, floor=128 if pallas_enabled() else 8)
         self._node_sharding = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P

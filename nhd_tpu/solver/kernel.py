@@ -131,7 +131,7 @@ def _solve(
         from nhd_tpu.ops.nic_pallas import nic_any_first
 
         T, N = rx.shape[0], nic_free.shape[0]
-        nic_any, first_a = nic_any_first(
+        nic_any, first_a, nic_pick_count = nic_any_first(
             nic_free[..., 0].reshape(N, U * K),
             nic_free[..., 1].reshape(N, U * K),
             dem_rx.reshape(T, C * A, U * K),
@@ -143,9 +143,6 @@ def _solve(
             U=U, K=K, C=C, A=A,
             interpret=jax.default_backend() != "tpu",
         )
-        # the pallas kernel reduces picks away; a capacity hint of 1 keeps
-        # multi-claim correct (just more rounds) on this path
-        nic_pick_count = nic_any.astype(jnp.int32)
     else:
         nic_ok = (
             fit
@@ -187,7 +184,11 @@ def _solve(
     return SolveOut(cand, pref, best_c, best_m, best_a, n_combos, n_picks)
 
 
-USE_PALLAS = os.environ.get("NHD_TPU_PALLAS") == "1"
+def pallas_enabled() -> bool:
+    """Whether the Pallas NIC path is on (NHD_TPU_PALLAS=1), read
+    dynamically so a benchmark can A/B it in one process. Must not change
+    mid-batch: the padding floor and the solver cache key both consult it."""
+    return os.environ.get("NHD_TPU_PALLAS") == "1"
 
 # combo-lattice ceiling: (U^G) * (K^G) above this routes the bucket to the
 # serial oracle instead of enumerating a huge static axis (a 6-group pod on
@@ -200,14 +201,19 @@ def bucket_tractable(n_groups: int, n_numa: int, max_nic: int) -> bool:
     return (n_numa ** n_groups) * (max(max_nic, 1) ** n_groups) <= MAX_LATTICE
 
 
-@lru_cache(maxsize=None)
 def get_solver(n_groups: int, n_numa: int, max_nic: int):
     """A jitted solver specialized to one bucket shape; tables are closure
-    constants so XLA folds them."""
+    constants so XLA folds them. The Pallas toggle is part of the cache
+    key so an in-process A/B (bench.py on TPU) gets distinct programs."""
+    return _get_solver(n_groups, n_numa, max_nic, pallas_enabled())
+
+
+@lru_cache(maxsize=None)
+def _get_solver(n_groups: int, n_numa: int, max_nic: int, use_pallas: bool):
     tables = get_tables(n_groups, n_numa, max_nic)
 
     def fn(*args):
-        return _solve(tables, *args, use_pallas=USE_PALLAS)
+        return _solve(tables, *args, use_pallas=use_pallas)
 
     return jax.jit(fn)
 
@@ -240,7 +246,7 @@ def solve_bucket(cluster, pods, *, device=None) -> SolveOut:
     """
     T, N = pods.n_types, cluster.n_nodes
     # the pallas NIC path streams node blocks of 128 (ops/nic_pallas.py)
-    Tp, Np = _pad_pow2(T), _pad_pow2(N, floor=128 if USE_PALLAS else 8)
+    Tp, Np = _pad_pow2(T), _pad_pow2(N, floor=128 if pallas_enabled() else 8)
 
     def pad_n(a):  # pad axis 0 to Np
         if a.shape[0] == Np:

@@ -27,11 +27,14 @@ def test_pallas_matches_reference(shape):
     rng = np.random.default_rng(7)
     args = make_case(rng, T, N, U, K, C, A)
     dims = dict(U=U, K=K, C=C, A=A)
-    any_p, first_p = nic_any_first(*args, **dims, interpret=True)
-    any_r, first_r = nic_any_first_reference(*args, **dims)
+    any_p, first_p, count_p = nic_any_first(*args, **dims, interpret=True)
+    any_r, first_r, count_r = nic_any_first_reference(*args, **dims)
     np.testing.assert_array_equal(np.asarray(any_p), np.asarray(any_r))
     # first_a only meaningful where any is True
     mask = np.asarray(any_r)
     np.testing.assert_array_equal(
         np.asarray(first_p)[mask], np.asarray(first_r)[mask]
     )
+    # real pick counts (the multi-claim capacity hint) must match too
+    np.testing.assert_array_equal(np.asarray(count_p), np.asarray(count_r))
+    assert (np.asarray(count_p) > 0).sum() == mask.sum()
