@@ -128,6 +128,12 @@ class StreamingScheduler:
                     results[i].round_no = len(stats.round_end_seconds) - 1
 
         contexts: List[Optional[ScheduleContext]] = [None] * len(tiles)
+        # per-tile saturation certificates: a request type that came back
+        # unschedulable from a tile stays unschedulable there for the rest
+        # of this call (resources only shrink within one schedule()), so
+        # later chunks skip the futile solve. Terminal assignment failures
+        # (r.failed) are NOT certified — they had a candidate.
+        exhausted: List[set] = [set() for _ in tiles]
 
         for lo in range(0, len(schedulable), self.chunk_pods):
             chunk = schedulable[lo : lo + self.chunk_pods]
@@ -135,9 +141,21 @@ class StreamingScheduler:
             for ti, tile in enumerate(tiles):
                 if not pending:
                     break
+                offer = []
+                for i in pending:
+                    if items[i].request in exhausted[ti]:
+                        # the certificate stands in for the tile's verdict
+                        # ("no candidate", not a hard failure) so a stale
+                        # failed=True from an earlier tile can't leak into
+                        # the final stats
+                        results[i] = BatchAssignment(items[i].key, None)
+                    else:
+                        offer.append(i)
+                if not offer:
+                    continue
                 if contexts[ti] is None:
                     contexts[ti] = self.batch.make_context(tile, now=now)
-                sub_items = [items[i] for i in pending]
+                sub_items = [items[i] for i in offer]
                 t_sub = time.perf_counter()
                 sub_results, sub_stats = self.batch.schedule(
                     tile, sub_items, now=now, context=contexts[ti]
@@ -158,14 +176,20 @@ class StreamingScheduler:
                 # per-tile failure counts would double-book; terminal
                 # failures are recounted from result flags at the end
 
-                still_pending: List[int] = []
-                for pod_i, r in zip(pending, sub_results):
+                # a no-candidate verdict is only a saturation certificate
+                # when the batch loop ended by exhausting candidates, not
+                # by hitting the round cap (a capped run can leave feasible
+                # pods unplaced mid-retry)
+                certify = sub_stats.rounds < self.batch.max_rounds
+                placed_here: set = set()
+                for pod_i, r in zip(offer, sub_results):
                     if r.node is None:
                         # carry the latest tile's verdict (failed flag) so
                         # the final stats can distinguish assignment
                         # failure from plain unschedulability
                         results[pod_i] = r
-                        still_pending.append(pod_i)
+                        if certify and not r.failed:
+                            exhausted[ti].add(items[pod_i].request)
                         continue
                     if r.round_no >= 0:
                         r = BatchAssignment(
@@ -173,7 +197,8 @@ class StreamingScheduler:
                             r.round_no + offset,
                         )
                     results[pod_i] = r
-                pending = still_pending
+                    placed_here.add(pod_i)
+                pending = [i for i in pending if i not in placed_here]
             if pending:
                 self.logger.info(
                     f"streaming: {len(pending)} pods of chunk "

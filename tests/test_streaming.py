@@ -149,6 +149,38 @@ def test_oversized_pods_take_serial_prepass():
     assert stats.scheduled == 3
 
 
+def test_round_cap_does_not_certify_exhaustion(monkeypatch):
+    """A max_rounds-capped sub-call can leave feasible pods unplaced
+    mid-retry (with tile capacity remaining); that must NOT poison the
+    tile's saturation certificate — a later chunk's pods still place.
+
+    Forced deterministically: a 4x-overestimated capacity estimate aims a
+    whole chunk at the tile's first node; the overflow claims are stale
+    retries that the round cap cuts off while the second node is still
+    completely free."""
+    import numpy as np
+
+    from nhd_tpu.solver.batch import BatchScheduler
+
+    orig = BatchScheduler._capacity_estimate
+    monkeypatch.setattr(
+        BatchScheduler, "_capacity_estimate",
+        lambda self, cluster, pods, out: orig(self, cluster, pods, out) * 4,
+    )
+    nodes = make_cluster(2)   # one tile of two nodes
+    reqs = [simple_request(gpus=1) for _ in range(16)]
+    results, stats = StreamingScheduler(
+        tile_nodes=2, chunk_pods=8, respect_busy=False, max_rounds=1
+    ).schedule(nodes, items(reqs), now=0.0)
+    placed = [r.node for r in results if r.node]
+    # one capped round places 2 pods (2 NIC picks per combo); chunk 1's
+    # overflow returns unplaced/failed=False with capacity remaining. A
+    # false certificate would skip the tile for chunk 2 entirely (total
+    # 2); with the guard, chunk 2 is offered and places 2 more
+    assert len(placed) == 4
+    assert all(n == sorted(nodes)[0] for n in placed)
+
+
 def test_bucket_cache_pins_requests_list():
     """Regression: FastCluster's demand-array cache is keyed by
     id(requests-list); each entry must PIN that list (strong ref) so a
