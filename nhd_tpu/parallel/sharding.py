@@ -92,6 +92,37 @@ def solve_bucket_sharded(cluster, pods, mesh: Optional[Mesh] = None) -> SolveOut
     ]
 
     solver = get_sharded_solver(pods.G, cluster.U, cluster.K, mesh)
+
+    multiproc = any(
+        d.process_index != jax.process_index() for d in mesh.devices.flat
+    )
+    if multiproc:
+        # multi-controller SPMD: every process holds the SAME global numpy
+        # state (the scheduler's host mirror is replicated by contract) and
+        # jit cannot shard raw numpy across processes — build global Arrays
+        # explicitly, then gather the compact decision tensors back to
+        # every host
+        from jax.experimental import multihost_utils
+
+        node_spec = NamedSharding(mesh, P("nodes"))
+        repl_spec = NamedSharding(mesh, P())
+
+        def globalize(a, spec):
+            return jax.make_array_from_callback(
+                a.shape, spec, lambda idx: a[idx]
+            )
+
+        out = solver(
+            *[globalize(a, node_spec) for a in node_args],
+            *[globalize(a, repl_spec) for a in pod_args],
+        )
+        # one pytree allgather (a single cross-host collective round), and
+        # np.array copies per this function's no-dangling-views rule
+        gathered = multihost_utils.process_allgather(
+            tuple(x[:T, :N] for x in out), tiled=True
+        )
+        return SolveOut(*(np.array(x) for x in gathered))
+
     out = solver(*node_args, *pod_args)
     # np.array (copy): a zero-copy view would dangle once the jax arrays
     # are dropped at return (see solver/batch.py bucket_out note)

@@ -1,0 +1,127 @@
+"""True multi-process distributed tests (simulated multi-host).
+
+Two separate Python processes jax.distributed.initialize against a local
+coordinator and exercise both documented federation patterns
+(nhd_tpu/parallel/multihost.py):
+
+1. region-independent: each process schedules its own node shard
+   (multihost.local_nodes) with its local devices — no cross-process
+   collectives;
+2. global SPMD: both processes participate in ONE sharded solve over a
+   global mesh (one device per process), with cross-process collectives
+   (Gloo on the CPU backend), and the result must equal the local
+   single-device solve bit-for-bit.
+
+This is the closest a single host gets to the reference's multi-node
+story (SURVEY §5.8) without a cluster.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # the virtual 8-device mesh of the parent suite must not leak in:
+    # each process contributes exactly one device to the global mesh
+    os.environ["XLA_FLAGS"] = ""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+
+    rank = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+    scenario = sys.argv[4]
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=rank)
+    assert jax.process_count() == nproc
+
+    import numpy as np
+    from nhd_tpu.sim import make_cluster
+    from tests.test_batch import simple_request
+
+    if scenario == "regions":
+        from nhd_tpu.parallel import multihost
+        from nhd_tpu.solver import BatchItem, StreamingScheduler
+
+        all_nodes = make_cluster(6)
+        mine = multihost.local_nodes(all_nodes)
+        items = [BatchItem(("ns", f"r{rank}-p{i}"), simple_request())
+                 for i in range(4)]
+        res, st = StreamingScheduler(
+            tile_nodes=2, respect_busy=False
+        ).schedule(mine, items, now=0.0)
+        assert st.scheduled == 4, st
+        assert all(r.node in mine for r in res)
+    elif scenario == "spmd":
+        from nhd_tpu.parallel.sharding import make_mesh, solve_bucket_sharded
+        from nhd_tpu.solver.encode import encode_cluster, encode_pods
+        from nhd_tpu.solver.kernel import solve_bucket
+
+        nodes = make_cluster(8)
+        cluster = encode_cluster(nodes, now=0.0)
+        pods = encode_pods([simple_request(gpus=1)], cluster.interner)[1]
+        mesh = make_mesh(jax.devices())   # global: one device per process
+        assert mesh.devices.size == nproc
+        out = solve_bucket_sharded(cluster, pods, mesh)
+        ref = solve_bucket(cluster, pods)
+        np.testing.assert_array_equal(out.cand, np.asarray(ref.cand))
+        np.testing.assert_array_equal(out.pref, np.asarray(ref.pref))
+        np.testing.assert_array_equal(out.best_c, np.asarray(ref.best_c))
+        np.testing.assert_array_equal(out.best_a, np.asarray(ref.best_a))
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+    print(f"OK rank {rank} {scenario}")
+""")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_pair(scenario: str) -> None:
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(rank), "2", str(port),
+             scenario],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"{scenario}: worker timed out")
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"{scenario} rank {rank} failed:\n{out[-2000:]}"
+        )
+        assert f"OK rank {rank} {scenario}" in out
+
+
+def test_two_process_region_scheduling():
+    _run_pair("regions")
+
+
+def test_two_process_global_spmd_solve():
+    _run_pair("spmd")
