@@ -55,6 +55,65 @@ def build_threads(
     return threads, rpc_q
 
 
+def make_fake_backend():
+    """The canonical 4-node demo cluster — shared by `--fake` scheduling
+    and `--fake --explain` so both see the same cluster."""
+    from nhd_tpu.k8s.fake import FakeClusterBackend
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels
+
+    backend = FakeClusterBackend()
+    for i in range(4):
+        spec = SynthNodeSpec(name=f"sim-node{i}")
+        backend.add_node(spec.name, make_node_labels(spec),
+                         hugepages_gb=spec.hugepages_gb)
+    return backend
+
+
+def explain_main(args) -> int:
+    """`nhd-tpu --explain cfg.txt`: why does/doesn't this config schedule?
+
+    Builds the node mirror exactly like the scheduler would (labels +
+    hugepages from the backend) and prints each node's first failing
+    predicate — the structured version of the reference's grep-the-logs
+    debugging workflow (reference README.md:161-171).
+    """
+    from nhd_tpu.config.parser import get_cfg_parser
+    from nhd_tpu.core.request import PodRequest
+    from nhd_tpu.scheduler.core import Scheduler
+    from nhd_tpu.solver.explain import explain
+
+    if args.fake:
+        backend = make_fake_backend()
+    else:
+        from nhd_tpu.k8s.kube import KubeClusterBackend
+
+        backend = KubeClusterBackend(start_watches=False)
+
+    sched = Scheduler(backend)
+    sched.build_initial_node_list()
+    sched.load_deployed_configs()   # mirror reflects current claims
+
+    with open(args.explain) as fh:
+        cfg_text = fh.read()
+    try:
+        parser = get_cfg_parser("triad", cfg_text)
+        top = parser.to_topology(False)
+        if top is None:
+            raise ValueError("config has no parseable TopologyCfg")
+        req = PodRequest.from_topology(
+            top, node_groups=frozenset(args.groups.split(","))
+        )
+    except Exception as exc:
+        # the tool exists to diagnose broken configs — a parse failure is
+        # itself the diagnosis, not a traceback (the scheduler fails such
+        # pods the same way, scheduler/core.py::_parse_pod_config)
+        print(f"config does not parse (the scheduler would fail this "
+              f"pod with FailedCfgParse): {exc}")
+        return 1
+    print(explain(sched.nodes, req).render())
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="nhd_tpu scheduler")
     parser.add_argument("--fake", action="store_true",
@@ -62,6 +121,11 @@ def main(argv=None) -> int:
     parser.add_argument("--rpc-port", type=int, default=45655)
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="Prometheus /metrics port (0 = disabled)")
+    parser.add_argument("--explain", metavar="CFGFILE",
+                        help="diagnose why this Triad config does or "
+                             "doesn't schedule, then exit")
+    parser.add_argument("--groups", default="default",
+                        help="pod node-groups for --explain (comma-sep)")
     args = parser.parse_args(argv)
 
     logger = get_logger(__name__)
@@ -75,17 +139,15 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    if args.explain:
+        return explain_main(args)
+
     if args.fake:
-        from nhd_tpu.k8s.fake import FakeClusterBackend
-        from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+        from nhd_tpu.sim import make_triad_config
 
         # demo cluster: 4 synthetic nodes + a 6-replica TriadSet, so the
         # harness visibly discovers, reconciles, and binds
-        backend = FakeClusterBackend()
-        for i in range(4):
-            spec = SynthNodeSpec(name=f"sim-node{i}")
-            backend.add_node(spec.name, make_node_labels(spec),
-                             hugepages_gb=spec.hugepages_gb)
+        backend = make_fake_backend()
         backend.add_triadset(
             "demo", "default", replicas=6, service_name="triad",
             cfg_text=make_triad_config(gpus_per_group=1, cpu_workers=2),

@@ -240,6 +240,30 @@ class OracleMatcher:
     # stage 3: cross-resource intersection
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def prune_pci_nic_combos(
+        node: HostNode, nic_combos: List[NicCombo]
+    ) -> List[NicCombo]:
+        """PCI map mode: keep NIC combos whose PCIe switches hold at least
+        as many free GPUs as NICs chosen on them — the kept reference
+        quirk (Matcher.py:295-335; see module docstring). Shared with the
+        explainer (solver/explain.py) so both report identical verdicts
+        by construction."""
+        gpu_per_sw = node.free_gpus_per_pciesw()
+        nic_sw = node.nic_pciesw_per_numa()
+        kept: List[NicCombo] = []
+        for combo in nic_combos:
+            switch_counts: Dict[int, int] = {}
+            for numa, idx in combo:
+                sw = nic_sw[numa][idx]
+                switch_counts[sw] = switch_counts.get(sw, 0) + 1
+            if all(
+                gpu_per_sw.get(sw, 0) >= count
+                for sw, count in switch_counts.items()
+            ):
+                kept.append(combo)
+        return kept
+
     def intersect_resources(
         self, nodes: Dict[str, HostNode], filts: FeasibleSets, map_mode: MapMode
     ) -> None:
@@ -250,21 +274,9 @@ class OracleMatcher:
         """
         if map_mode == MapMode.PCI:
             for name in list(filts.candidates):
-                node = nodes[name]
-                gpu_per_sw = node.free_gpus_per_pciesw()
-                nic_sw = node.nic_pciesw_per_numa()
-                kept: List[NicCombo] = []
-                for combo in filts.nic[name]:
-                    switch_counts: Dict[int, int] = {}
-                    for numa, idx in combo:
-                        sw = nic_sw[numa][idx]
-                        switch_counts[sw] = switch_counts.get(sw, 0) + 1
-                    if all(
-                        gpu_per_sw.get(sw, 0) >= count
-                        for sw, count in switch_counts.items()
-                    ):
-                        kept.append(combo)
-                filts.nic[name] = kept
+                filts.nic[name] = self.prune_pci_nic_combos(
+                    nodes[name], filts.nic[name]
+                )
 
         for name in list(filts.candidates):
             gpu_prefixes = set(filts.gpu[name])

@@ -92,7 +92,7 @@ def _run_pair(scenario: str) -> None:
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
-        + env.get("PYTHONPATH", "").split(os.pathsep)
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
     )
     procs = [
         subprocess.Popen(

@@ -1,0 +1,128 @@
+"""Unschedulability explainer: per-node verdicts must agree with the
+matcher (a node is 'schedulable' iff the oracle can place the pod there),
+and each forced failure mode must surface its own reason."""
+
+import random
+
+import pytest
+
+from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+from nhd_tpu.core.topology import MapMode, SmtMode
+from nhd_tpu.sim import SynthNodeSpec, make_cluster, make_node
+from nhd_tpu.solver import find_node
+from nhd_tpu.solver.explain import (
+    R_BUSY,
+    R_CPU,
+    R_GPU,
+    R_GROUPS,
+    R_HUGEPAGES,
+    R_INACTIVE,
+    R_MAINTENANCE,
+    R_NIC,
+    R_OK,
+    explain,
+)
+from tests.test_batch import simple_request
+from tests.test_jax_matcher import random_cluster, random_request
+
+
+def verdict_of(report, node):
+    return next(v for v in report.verdicts if v.node == node).reason
+
+
+def test_each_failure_mode_has_its_reason():
+    nodes = make_cluster(8)
+    names = sorted(nodes)
+    nodes[names[0]].active = False
+    nodes[names[1]].maintenance = True
+    nodes[names[2]].mem.free_hugepages_gb = 0
+    nodes[names[3]].set_groups("other")
+    nodes[names[4]].set_busy(now=1000.0)
+    for gpu in nodes[names[5]].gpus:
+        gpu.used = True
+    for core in nodes[names[6]].cores:
+        core.used = True
+
+    req = simple_request(gpus=1)
+    report = explain(nodes, req, now=1010.0)
+    assert verdict_of(report, names[0]) == R_INACTIVE
+    assert verdict_of(report, names[1]) == R_MAINTENANCE
+    assert verdict_of(report, names[2]) == R_HUGEPAGES
+    assert verdict_of(report, names[3]) == R_GROUPS
+    assert verdict_of(report, names[4]) == R_BUSY
+    assert verdict_of(report, names[5]) == R_GPU
+    assert verdict_of(report, names[6]) == R_CPU
+    assert verdict_of(report, names[7]) == R_OK
+    assert report.schedulable_nodes == [names[7]]
+    assert report.summary[R_OK] == 1
+
+    text = report.render()
+    assert R_GPU in text and names[5] in text
+
+
+def test_nic_exhaustion_reason():
+    nodes = make_cluster(1)
+    node = next(iter(nodes.values()))
+    for nic in node.nics:
+        nic.pods_used = 1   # sharing disabled: zero headroom
+    report = explain(nodes, simple_request())
+    assert report.verdicts[0].reason == R_NIC
+    assert not report.schedulable_nodes
+    assert "UNSCHEDULABLE" in report.render()
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_explain_agrees_with_matcher(seed):
+    """A node reads 'schedulable' iff the oracle would place the pod on it
+    when offered that node alone."""
+    rng = random.Random(8000 + seed)
+    nodes = random_cluster(rng, 6)
+    for _ in range(3):
+        req = random_request(rng)
+        report = explain(nodes, req, now=1010.0)
+        for v in report.verdicts:
+            alone = {v.node: nodes[v.node]}
+            m = find_node(alone, req, now=1010.0)
+            assert (m is not None) == (v.reason == R_OK), (
+                f"seed {seed} node {v.node}: explain={v.reason} "
+                f"matcher={'hit' if m else 'miss'}"
+            )
+
+
+def test_invalid_map_mode_reported():
+    """The matcher refuses unknown map modes outright; explain must say
+    so, not report per-node feasibility (iff-contract with the oracle)."""
+    import dataclasses
+
+    from nhd_tpu.solver.explain import R_INVALID_MODE
+
+    nodes = make_cluster(2)
+    req = dataclasses.replace(simple_request(), map_mode=MapMode.INVALID)
+    report = explain(nodes, req)
+    assert all(v.reason == R_INVALID_MODE for v in report.verdicts)
+    assert not report.schedulable_nodes
+    assert find_node(nodes, req) is None
+
+
+def test_cli_explain(tmp_path, capsys):
+    from nhd_tpu.cli import main
+    from nhd_tpu.sim import make_triad_config
+
+    cfg = tmp_path / "pod.cfg"
+    cfg.write_text(make_triad_config(gpus_per_group=1, hugepages_gb=4))
+    rc = main(["--fake", "--explain", str(cfg)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "schedulable on 4 node(s)" in out
+
+
+def test_cli_explain_unparseable_config(tmp_path, capsys):
+    """A broken config is itself the diagnosis — no traceback."""
+    from nhd_tpu.cli import main
+
+    cfg = tmp_path / "broken.cfg"
+    cfg.write_text("this is { not libconfig")
+    rc = main(["--fake", "--explain", str(cfg)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "does not parse" in out
