@@ -93,16 +93,20 @@ def explain_main(args) -> int:
     sched.build_initial_node_list()
     sched.load_deployed_configs()   # mirror reflects current claims
 
-    with open(args.explain) as fh:
-        cfg_text = fh.read()
+    groups = frozenset(
+        g.strip() for g in args.groups.split(",") if g.strip()
+    ) or frozenset({"default"})
     try:
+        with open(args.explain) as fh:
+            cfg_text = fh.read()
         parser = get_cfg_parser("triad", cfg_text)
         top = parser.to_topology(False)
         if top is None:
             raise ValueError("config has no parseable TopologyCfg")
-        req = PodRequest.from_topology(
-            top, node_groups=frozenset(args.groups.split(","))
-        )
+        req = PodRequest.from_topology(top, node_groups=groups)
+    except OSError as exc:
+        print(f"cannot read config: {exc}")
+        return 1
     except Exception as exc:
         # the tool exists to diagnose broken configs — a parse failure is
         # itself the diagnosis, not a traceback (the scheduler fails such

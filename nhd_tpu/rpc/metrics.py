@@ -19,12 +19,38 @@ from nhd_tpu.scheduler.core import RpcMsgType
 from nhd_tpu.utils import get_logger
 
 
-def render_metrics(nodes: List[dict], failed_count: int) -> str:
+def render_metrics(
+    nodes: List[dict], failed_count: int, perf: dict | None = None
+) -> str:
     """Scheduler stats → Prometheus text exposition format."""
     lines = [
         "# HELP nhd_failed_schedule_total Pods that failed to schedule",
         "# TYPE nhd_failed_schedule_total counter",
         f"nhd_failed_schedule_total {failed_count}",
+    ]
+    for name, kind, help_text in (
+        ("batches_total", "counter", "Scheduling batches run"),
+        ("scheduled_total", "counter", "Pods scheduled"),
+        ("rounds_total", "counter", "Greedy solver rounds run"),
+        ("solve_seconds_total", "counter",
+         "Seconds in the batched feasibility solve"),
+        ("select_seconds_total", "counter",
+         "Seconds in candidate selection/packing"),
+        ("assign_seconds_total", "counter",
+         "Seconds in physical ID assignment"),
+        ("last_batch_pods", "gauge", "Pod count of the last batch"),
+        ("last_batch_seconds", "gauge", "Wall seconds of the last batch"),
+        ("last_bind_p99_seconds", "gauge",
+         "p99 bind latency within the last batch"),
+    ):
+        if perf is None or name not in perf:
+            continue
+        lines += [
+            f"# HELP nhd_{name} {help_text}",
+            f"# TYPE nhd_{name} {kind}",
+            f"nhd_{name} {perf[name]}",
+        ]
+    lines += [
         "# HELP nhd_node_free_cpus Free logical CPU cores per node",
         "# TYPE nhd_node_free_cpus gauge",
         "# HELP nhd_node_free_gpus Free GPUs per node",
@@ -94,7 +120,8 @@ class MetricsServer(threading.Thread):
     def _collect(self) -> str:
         nodes = ask_scheduler(self.mainq, RpcMsgType.NODE_INFO)
         failed = ask_scheduler(self.mainq, RpcMsgType.SCHEDULER_INFO)
-        return render_metrics(nodes, failed)
+        perf = ask_scheduler(self.mainq, RpcMsgType.PERF_INFO)
+        return render_metrics(nodes, failed, perf)
 
     def run(self) -> None:
         self._serving = True

@@ -41,11 +41,13 @@ class PodStatus(Enum):
 
 
 class RpcMsgType(Enum):
-    """Reference: NHDCommon.py:69-73."""
+    """Reference: NHDCommon.py:69-73 (PERF_INFO is a rebuild addition —
+    the solver-phase counters the reference never had)."""
 
     NODE_INFO = 0
     SCHEDULER_INFO = 1
     POD_INFO = 2
+    PERF_INFO = 3
 
 
 class Scheduler(threading.Thread):
@@ -70,6 +72,20 @@ class Scheduler(threading.Thread):
         self.pod_state: Dict[Tuple[str, str], dict] = {}
         self.failed_schedule_count = 0
         self.batch = BatchScheduler(respect_busy=respect_busy)
+        # cumulative solver-phase accounting (exported via PERF_INFO /
+        # the Prometheus plane; the north-star metric is p99 bind latency,
+        # SURVEY §5.1/§5.5)
+        self.perf: Dict[str, float] = {
+            "batches_total": 0,
+            "scheduled_total": 0,
+            "solve_seconds_total": 0.0,
+            "select_seconds_total": 0.0,
+            "assign_seconds_total": 0.0,
+            "rounds_total": 0,
+            "last_batch_pods": 0,
+            "last_batch_seconds": 0.0,
+            "last_bind_p99_seconds": 0.0,
+        }
         self._stop_event = threading.Event()
 
     # ------------------------------------------------------------------
@@ -256,8 +272,19 @@ class Scheduler(threading.Thread):
         if not prepared:
             return 0
 
-        results, _ = self.batch.schedule(
+        t_batch = time.perf_counter()
+        results, bstats = self.batch.schedule(
             self.nodes, [item for _, item in prepared]
+        )
+        self.perf["batches_total"] += 1
+        self.perf["solve_seconds_total"] += bstats.solve_seconds
+        self.perf["select_seconds_total"] += bstats.select_seconds
+        self.perf["assign_seconds_total"] += bstats.assign_seconds
+        self.perf["rounds_total"] += bstats.rounds
+        self.perf["last_batch_pods"] = len(prepared)
+        self.perf["last_batch_seconds"] = time.perf_counter() - t_batch
+        self.perf["last_bind_p99_seconds"] = bstats.bind_latency_percentile(
+            results, 99
         )
 
         scheduled = 0
@@ -284,6 +311,10 @@ class Scheduler(threading.Thread):
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
                 }
+        # commit-level count: a pod is "scheduled" only once bound (a pod
+        # the solver placed but whose commit failed counts as failed, not
+        # both — dashboards divide these)
+        self.perf["scheduled_total"] += scheduled
         return scheduled
 
     def _commit_pod(self, parser: CfgParser, item: BatchItem, result) -> bool:
@@ -490,6 +521,8 @@ class Scheduler(threading.Thread):
             reply_q.put(self.failed_schedule_count)
         elif msg_type == RpcMsgType.POD_INFO:
             reply_q.put(self.get_pod_stats())
+        elif msg_type == RpcMsgType.PERF_INFO:
+            reply_q.put(dict(self.perf))
 
     # ------------------------------------------------------------------
     # event handling

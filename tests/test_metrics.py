@@ -45,6 +45,11 @@ def test_metrics_endpoint(metrics_stack):
     assert 'nhd_node_pods{node="node0"} 1' in body
     assert 'nhd_node_active{node="node1"} 1' in body
     assert 'dir="rx"' in body
+    # solver-phase counters from the scheduled batch
+    assert "nhd_batches_total 1" in body
+    assert "nhd_scheduled_total 1" in body
+    assert "nhd_solve_seconds_total" in body
+    assert "nhd_last_bind_p99_seconds" in body
 
 
 def test_metrics_query_string_ok(metrics_stack):
