@@ -447,6 +447,10 @@ class BatchScheduler:
             # (pod index, node index, bucket G, type) chosen this round
             claims: List[Tuple[int, int, int, int]] = []
             bucket_out = {}
+            # pins the jax SolveOuts whose buffers SolveHost's zero-copy
+            # views alias, for the round's lifetime — correctness must not
+            # hinge on any particular backend's buffer-export semantics
+            keepalive: List[object] = []
             for G, full in all_buckets.items():
                 mask = is_pending[full.pod_index]
                 if not mask.any():
@@ -459,11 +463,11 @@ class BatchScheduler:
                 out = dev.solve(pods) if dev else solve_bucket(cluster, pods)
                 # pull results to host once — element reads off jax arrays
                 # cost ~0.2 ms each and the winner loop does three per pod.
-                # np.array (copy), NOT np.asarray: the zero-copy view aliases
-                # the jax buffer, which is dropped right here — reads through
-                # a dangling view are undefined (bit us as phantom -2
-                # assignment failures in the streaming path)
-                bucket_out[G] = (pods, SolveHost(*(np.array(x) for x in out)))
+                # np.asarray is zero-copy on the CPU backend (copying cost
+                # ~1s per 100k pods); `keepalive` holds the owning arrays
+                # until the round's reads are done
+                keepalive.append(out)
+                bucket_out[G] = (pods, SolveHost(*map(np.asarray, out)))
             stats.solve_seconds += time.perf_counter() - t0
 
             t0 = time.perf_counter()
