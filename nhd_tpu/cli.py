@@ -18,7 +18,7 @@ import queue
 import sys
 import time
 
-from nhd_tpu import NHD_SCHED_NAME, __version__
+from nhd_tpu import __version__
 from nhd_tpu.scheduler.controller import Controller
 from nhd_tpu.scheduler.core import Scheduler
 from nhd_tpu.scheduler.events import WatchQueue

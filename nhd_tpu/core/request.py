@@ -11,7 +11,7 @@ pod ask for" on both paths.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import FrozenSet, List, Tuple
 
 from nhd_tpu.core.topology import MapMode, PodTopology, SmtMode

@@ -10,7 +10,7 @@ mirror, oracle, JAX solver, scheduler, bench — runs hermetically.
 from __future__ import annotations
 
 import random
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from nhd_tpu.core.node import HostNode

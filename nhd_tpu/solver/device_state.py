@@ -22,7 +22,7 @@ the jit cache.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, Optional
 
 import jax
 import jax.numpy as jnp

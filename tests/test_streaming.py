@@ -149,6 +149,29 @@ def test_oversized_pods_take_serial_prepass():
     assert stats.scheduled == 3
 
 
+def test_streaming_over_mesh_equals_single_device():
+    """Streaming composes with the sharded batch path: tiles over time,
+    nodes-within-tile over the 8-device mesh — totals and end state equal
+    the forced single-device streaming run."""
+    import jax
+
+    assert len(jax.devices()) == 8
+    reqs = [simple_request(gpus=i % 2, proc=2 + 2 * (i % 3))
+            for i in range(30)]
+    outs = {}
+    for label, mesh in (("mesh", "auto"), ("single", None)):
+        nodes = make_cluster(10)
+        results, stats = StreamingScheduler(
+            tile_nodes=4, chunk_pods=9, respect_busy=False, mesh=mesh
+        ).schedule(nodes, items(reqs), now=0.0)
+        outs[label] = (
+            [r.node for r in results],
+            stats.scheduled,
+            _free_state(nodes),
+        )
+    assert outs["mesh"] == outs["single"]
+
+
 def test_round_cap_does_not_certify_exhaustion(monkeypatch):
     """A max_rounds-capped sub-call can leave feasible pods unplaced
     mid-retry (with tile capacity remaining); that must NOT poison the
