@@ -69,40 +69,70 @@ def make_fake_backend():
     return backend
 
 
-def explain_main(args) -> int:
-    """`nhd-tpu --explain cfg.txt`: why does/doesn't this config schedule?
+def explain_main(args, backend=None) -> int:
+    """`nhd-tpu --explain cfg.txt` / `--explain-pod ns/pod`: why does or
+    doesn't this workload schedule?
 
     Builds the node mirror exactly like the scheduler would (labels +
     hugepages from the backend) and prints each node's first failing
     predicate — the structured version of the reference's grep-the-logs
-    debugging workflow (reference README.md:161-171).
+    debugging workflow (reference README.md:161-171). ``backend`` is
+    injectable for tests; by default it is built from the flags.
     """
     from nhd_tpu.config.parser import get_cfg_parser
     from nhd_tpu.core.request import PodRequest
     from nhd_tpu.scheduler.core import Scheduler
     from nhd_tpu.solver.explain import explain
 
-    if args.fake:
-        backend = make_fake_backend()
-    else:
-        from nhd_tpu.k8s.kube import KubeClusterBackend
+    if backend is None:
+        if args.fake:
+            backend = make_fake_backend()
+        else:
+            from nhd_tpu.k8s.kube import KubeClusterBackend
 
-        backend = KubeClusterBackend(start_watches=False)
+            backend = KubeClusterBackend(start_watches=False)
 
     sched = Scheduler(backend)
     sched.build_initial_node_list()
     sched.load_deployed_configs()   # mirror reflects current claims
 
-    groups = frozenset(
-        g.strip() for g in args.groups.split(",") if g.strip()
-    ) or frozenset({"default"})
+    live_pod = None
+    if args.explain_pod:
+        # live-pod mode: read the stuck pod's own ConfigMap, cfg-type and
+        # groups — exactly the inputs the scheduler would use
+        # (Scheduler._prepare_item), minus its event side effects
+        ns, _, pod = args.explain_pod.rpartition("/")
+        ns = ns or "default"
+        if not backend.pod_exists(pod, ns):
+            print(f"pod {ns}/{pod} not found")
+            return 1
+        _, cfg_text = backend.get_cfg_map(pod, ns)
+        if cfg_text is None:
+            print(f"pod {ns}/{pod} has no readable ConfigMap — the "
+                  "scheduler fails this pod with FailedCfgParse")
+            return 1
+        cfg_type = backend.get_cfg_type(pod, ns)
+        groups = frozenset(backend.get_pod_node_groups(pod, ns))
+        live_pod = (pod, ns)
+    else:
+        groups = frozenset(
+            g.strip() for g in args.groups.split(",") if g.strip()
+        ) or frozenset({"default"})
+        cfg_text = None
+        cfg_type = "triad"
     try:
-        with open(args.explain) as fh:
-            cfg_text = fh.read()
-        parser = get_cfg_parser("triad", cfg_text)
+        if cfg_text is None:
+            with open(args.explain) as fh:
+                cfg_text = fh.read()
+        parser = get_cfg_parser(cfg_type, cfg_text)
         top = parser.to_topology(False)
         if top is None:
             raise ValueError("config has no parseable TopologyCfg")
+        if live_pod is not None:
+            # pod-spec hugepage requests override the config's figure,
+            # like the scheduler's reservation fold-in (core.py
+            # _prepare_item → _pod_reservations)
+            top.add_pod_reservations(sched._pod_reservations(*live_pod))
         req = PodRequest.from_topology(top, node_groups=groups)
     except OSError as exc:
         print(f"cannot read config: {exc}")
@@ -128,6 +158,9 @@ def main(argv=None) -> int:
     parser.add_argument("--explain", metavar="CFGFILE",
                         help="diagnose why this Triad config does or "
                              "doesn't schedule, then exit")
+    parser.add_argument("--explain-pod", metavar="[NS/]POD",
+                        help="diagnose a pod already in the cluster "
+                             "(reads its own ConfigMap and node-groups)")
     parser.add_argument("--groups", default="default",
                         help="pod node-groups for --explain (comma-sep)")
     args = parser.parse_args(argv)
@@ -143,7 +176,7 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    if args.explain:
+    if args.explain or args.explain_pod:
         return explain_main(args)
 
     if args.fake:

@@ -116,6 +116,49 @@ def test_cli_explain(tmp_path, capsys):
     assert "schedulable on 4 node(s)" in out
 
 
+def test_cli_explain_pod_live(capsys):
+    """--explain-pod diagnoses a pod stuck in the cluster using its own
+    ConfigMap and node-group annotation."""
+    import argparse
+
+    from nhd_tpu.cli import explain_main
+    from nhd_tpu.k8s.fake import FakeClusterBackend
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+
+    backend = FakeClusterBackend()
+    for i in range(2):
+        spec = SynthNodeSpec(name=f"n{i}")
+        backend.add_node(spec.name, make_node_labels(spec), hugepages_gb=64)
+    backend.create_pod(
+        "stuck-0", cfg_text=make_triad_config(hugepages_gb=500)
+    )
+    args = argparse.Namespace(
+        fake=True, explain=None, explain_pod="default/stuck-0",
+        groups="default",
+    )
+    rc = explain_main(args, backend=backend)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "insufficient-hugepages" in out
+    assert "UNSCHEDULABLE" in out
+
+    args.explain_pod = "default/ghost"
+    assert explain_main(args, backend=backend) == 1
+    assert "not found" in capsys.readouterr().out
+
+    # pod-spec hugepages reservation folds in like the scheduler's
+    # _prepare_item: config says 4 GiB, pod spec requests 500Gi → fail
+    backend.create_pod(
+        "res-0", cfg_text=make_triad_config(hugepages_gb=4),
+        resources={"hugepages-1Gi": "500G"},
+    )
+    args.explain_pod = "default/res-0"
+    assert explain_main(args, backend=backend) == 0
+    out = capsys.readouterr().out
+    assert "insufficient-hugepages" in out
+    assert "500" in out
+
+
 def test_cli_explain_unparseable_config(tmp_path, capsys):
     """A broken config is itself the diagnosis — no traceback."""
     from nhd_tpu.cli import main
