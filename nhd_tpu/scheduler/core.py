@@ -11,6 +11,7 @@ the same path with a batch of one, reproducing reference behavior exactly.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -28,6 +29,11 @@ from nhd_tpu.utils import get_logger
 
 IDLE_CNT_THRESH = 60        # reference: NHDScheduler.py:24
 Q_BLOCK_TIME_SEC = 0.5      # reference: NHDScheduler.py:25
+
+# above this node count the scheduler solves through the streaming tiler
+# (solver/streaming.py) instead of one whole-cluster batch — bounded
+# per-solve memory at federation scale (SURVEY §5.7)
+STREAM_NODE_THRESH = int(os.environ.get("NHD_STREAM_NODES", "4096"))
 
 
 class PodStatus(Enum):
@@ -72,6 +78,7 @@ class Scheduler(threading.Thread):
         self.pod_state: Dict[Tuple[str, str], dict] = {}
         self.failed_schedule_count = 0
         self.batch = BatchScheduler(respect_busy=respect_busy)
+        self._stream = None   # built lazily past STREAM_NODE_THRESH
         # cumulative solver-phase accounting (exported via PERF_INFO /
         # the Prometheus plane; the north-star metric is p99 bind latency,
         # SURVEY §5.1/§5.5)
@@ -273,7 +280,17 @@ class Scheduler(threading.Thread):
             return 0
 
         t_batch = time.perf_counter()
-        results, bstats = self.batch.schedule(
+        if len(self.nodes) > STREAM_NODE_THRESH:
+            from nhd_tpu.solver.streaming import StreamingScheduler
+
+            if self._stream is None:
+                self._stream = StreamingScheduler(
+                    respect_busy=self.batch.respect_busy
+                )
+            solver = self._stream
+        else:
+            solver = self.batch
+        results, bstats = solver.schedule(
             self.nodes, [item for _, item in prepared]
         )
         self.perf["batches_total"] += 1
