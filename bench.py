@@ -243,6 +243,45 @@ def bench_cold_start() -> None:
          f"trace + compile/cache-load)")
 
 
+def bench_restart_replay(n_nodes: int = 128, n_pods: int = 512) -> None:
+    """Crash-only restart cost: rebuild the node mirror and re-claim every
+    bound pod's resources from its solved-config annotation (reference:
+    NHDScheduler.py:161-172) — the scheduler's real downtime after a crash
+    or upgrade."""
+    import queue as queue_mod
+
+    from nhd_tpu.k8s.fake import FakeClusterBackend
+    from nhd_tpu.scheduler.core import Scheduler
+    from nhd_tpu.scheduler.events import WatchQueue
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+
+    backend = FakeClusterBackend()
+    for i in range(n_nodes):
+        spec = SynthNodeSpec(name=f"rs-node{i:04d}", hugepages_gb=256)
+        backend.add_node(spec.name, make_node_labels(spec), hugepages_gb=256)
+    for i in range(n_pods):
+        backend.create_pod(
+            f"rs-{i}", cfg_text=make_triad_config(gpus_per_group=i % 2,
+                                                  hugepages_gb=2),
+        )
+    sched = Scheduler(backend, WatchQueue(), queue_mod.Queue(),
+                      respect_busy=False)
+    sched.build_initial_node_list()
+    sched.check_pending_pods()
+    bound = sum(1 for p in backend.pods.values() if p.node)
+
+    sched2 = Scheduler(backend, WatchQueue(), queue_mod.Queue(),
+                       respect_busy=False)
+    t0 = time.perf_counter()
+    sched2.build_initial_node_list()
+    sched2.load_deployed_configs()
+    wall = time.perf_counter() - t0
+    claimed = sum(n.total_pods() for n in sched2.nodes.values())
+    _log(f"bench[restart-replay]: {claimed}/{bound} pods re-claimed over "
+         f"{n_nodes} nodes in {wall:.2f}s ({wall / max(claimed, 1) * 1e3:.2f} "
+         f"ms/pod; crash-only restart downtime)")
+
+
 def bench_bind_latency(n_pods: int = 200) -> None:
     """Event-driven single-pod path latency (p50/p99): pod create → bound,
     through the full scheduler on the fake backend — config parse, batched
@@ -301,6 +340,7 @@ def main() -> None:
 
     bench_cold_start()
     bench_bind_latency()
+    bench_restart_replay()
     if jax.default_backend() == "tpu":
         bench_pallas_compare()
 
