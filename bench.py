@@ -213,26 +213,37 @@ def bench_pallas_compare() -> None:
              f"(pallas {ratio:.2f}x vs xla)")
 
 
-def bench_cold_start() -> None:
-    """First pod create→bind after a scheduler (re)start, in THIS fresh
-    process: includes config parse, solver trace and compile (or
-    persistent-cache load — exactly what a crash-only restart pays).
-    Must run before any other bench warms the jit caches."""
+def make_fake_sched(n_nodes: int, prefix: str, hugepages_gb: int = None):
+    """Fake backend + initialized Scheduler — shared bench scaffolding."""
     import queue as queue_mod
 
     from nhd_tpu.k8s.fake import FakeClusterBackend
     from nhd_tpu.scheduler.core import Scheduler
     from nhd_tpu.scheduler.events import WatchQueue
-    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels
 
     backend = FakeClusterBackend()
-    for i in range(8):
-        spec = SynthNodeSpec(name=f"cold-node{i}")
+    for i in range(n_nodes):
+        kw = {"name": f"{prefix}{i:04d}"}
+        if hugepages_gb is not None:
+            kw["hugepages_gb"] = hugepages_gb
+        spec = SynthNodeSpec(**kw)
         backend.add_node(spec.name, make_node_labels(spec),
                          hugepages_gb=spec.hugepages_gb)
     sched = Scheduler(backend, WatchQueue(), queue_mod.Queue(),
                       respect_busy=False)
     sched.build_initial_node_list()
+    return backend, sched
+
+
+def bench_cold_start() -> None:
+    """First pod create→bind after a scheduler (re)start, in THIS fresh
+    process: includes config parse, solver trace and compile (or
+    persistent-cache load — exactly what a crash-only restart pays).
+    Must run before any other bench warms the jit caches."""
+    from nhd_tpu.sim import make_triad_config
+
+    backend, sched = make_fake_sched(8, "cold-node")
     backend.create_pod("cold-0", cfg_text=make_triad_config(gpus_per_group=1))
     t0 = time.perf_counter()
     sched.attempt_scheduling_batch([("cold-0", "default", "uid-cold")])
@@ -250,23 +261,16 @@ def bench_restart_replay(n_nodes: int = 128, n_pods: int = 512) -> None:
     or upgrade."""
     import queue as queue_mod
 
-    from nhd_tpu.k8s.fake import FakeClusterBackend
     from nhd_tpu.scheduler.core import Scheduler
     from nhd_tpu.scheduler.events import WatchQueue
-    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+    from nhd_tpu.sim import make_triad_config
 
-    backend = FakeClusterBackend()
-    for i in range(n_nodes):
-        spec = SynthNodeSpec(name=f"rs-node{i:04d}", hugepages_gb=256)
-        backend.add_node(spec.name, make_node_labels(spec), hugepages_gb=256)
+    backend, sched = make_fake_sched(n_nodes, "rs-node", hugepages_gb=256)
     for i in range(n_pods):
         backend.create_pod(
             f"rs-{i}", cfg_text=make_triad_config(gpus_per_group=i % 2,
                                                   hugepages_gb=2),
         )
-    sched = Scheduler(backend, WatchQueue(), queue_mod.Queue(),
-                      respect_busy=False)
-    sched.build_initial_node_list()
     sched.check_pending_pods()
     bound = sum(1 for p in backend.pods.values() if p.node)
 
@@ -287,22 +291,11 @@ def bench_bind_latency(n_pods: int = 200) -> None:
     through the full scheduler on the fake backend — config parse, batched
     solve of one, physical assignment, annotations, bind. The reference's
     north-star metric is p99 bind latency (BASELINE.md)."""
-    import queue as queue_mod
-
     import numpy as np
 
-    from nhd_tpu.k8s.fake import FakeClusterBackend
-    from nhd_tpu.scheduler.core import Scheduler
-    from nhd_tpu.scheduler.events import WatchQueue
-    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+    from nhd_tpu.sim import make_triad_config
 
-    backend = FakeClusterBackend()
-    for i in range(32):
-        spec = SynthNodeSpec(name=f"lat-node{i}", hugepages_gb=256)
-        backend.add_node(spec.name, make_node_labels(spec), hugepages_gb=256)
-    sched = Scheduler(backend, WatchQueue(), queue_mod.Queue(),
-                      respect_busy=False)
-    sched.build_initial_node_list()
+    backend, sched = make_fake_sched(32, "lat-node", hugepages_gb=256)
 
     lat = []
     failed = 0

@@ -20,3 +20,17 @@ def test_chaos_with_restarts_replays_consistently():
     stats = sim.run(steps=80)
     assert stats.violations == []
     assert stats.restarts >= 1
+
+
+def test_chaos_through_streaming_scheduler_path(monkeypatch):
+    """Same churn storm with every scheduler batch routed through the
+    streaming tiler (NHD_STREAM_NODES forced to 1) — the federation-scale
+    production path must satisfy the same conservation invariants."""
+    from nhd_tpu.scheduler import core as core_mod
+
+    monkeypatch.setattr(core_mod, "STREAM_NODE_THRESH", 1)
+    sim = ChaosSim(seed=7, n_nodes=4)
+    stats = sim.run(steps=60)
+    assert stats.violations == []
+    assert sim.sched._stream is not None, "streaming path never engaged"
+    assert stats.created > 10
