@@ -10,6 +10,7 @@ checking conservation invariants after every step.
 
 from __future__ import annotations
 
+import json
 import queue
 import random
 from dataclasses import dataclass, field
@@ -65,17 +66,36 @@ class ChaosSim:
 
     def _act_create(self) -> None:
         self._pod_seq += 1
-        cfg = make_triad_config(
-            n_groups=self.rng.choice([1, 1, 2]),
-            gpus_per_group=self.rng.choice([0, 1]),
-            cpu_workers=self.rng.choice([1, 2]),
-            hugepages_gb=self.rng.choice([2, 4]),
-            map_type=self.rng.choice(["NUMA", "NUMA", "PCI"]),
-        )
         groups = self.rng.choice([None, None, "default", "edge"])
-        self.backend.create_pod(
-            f"chaos-{self._pod_seq}", cfg_text=cfg, groups=groups
-        )
+        if self.rng.random() < 0.25:
+            # exercise the second config format through the same storm
+            cfg = json.dumps({
+                "map_mode": self.rng.choice(["NUMA", "NUMA", "PCI"]),
+                "hugepages_gb": self.rng.choice([2, 4]),
+                "misc_cores": {"count": 1, "smt": True},
+                "groups": [{
+                    "proc_cores": {"count": self.rng.choice([3, 4]),
+                                   "smt": True},
+                    "helper_cores": {"count": 1, "smt": True},
+                    "gpus": self.rng.choice([0, 1]),
+                    "nic": {"rx_gbps": 10.0, "tx_gbps": 5.0},
+                }],
+            })
+            self.backend.create_pod(
+                f"chaos-{self._pod_seq}", cfg_text=cfg, cfg_type="json",
+                groups=groups,
+            )
+        else:
+            cfg = make_triad_config(
+                n_groups=self.rng.choice([1, 1, 2]),
+                gpus_per_group=self.rng.choice([0, 1]),
+                cpu_workers=self.rng.choice([1, 2]),
+                hugepages_gb=self.rng.choice([2, 4]),
+                map_type=self.rng.choice(["NUMA", "NUMA", "PCI"]),
+            )
+            self.backend.create_pod(
+                f"chaos-{self._pod_seq}", cfg_text=cfg, groups=groups
+            )
         self.stats.created += 1
 
     def _act_group_move(self) -> None:

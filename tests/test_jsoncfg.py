@@ -49,7 +49,10 @@ def test_parse_solve_writeback_roundtrip():
 
     m = find_node(nodes, top, respect_busy=False)
     assert m is not None
-    nodes[m.node].assign_physical_ids(m.mapping, top)
+    nic_list = nodes[m.node].assign_physical_ids(m.mapping, top)
+    # the scheduler claims NIC occupancy after assignment
+    # (reference: NHDScheduler.py:304)
+    nodes[m.node].claim_nic_pods(sorted({x[0] for x in nic_list}))
     solved = parser.to_config()
 
     doc = json.loads(solved)
@@ -75,6 +78,15 @@ def test_parse_solve_writeback_roundtrip():
     assert fresh[m.node].free_gpu_count() == nodes[m.node].free_gpu_count()
     assert fresh[m.node].mem.free_hugepages_gb == \
         nodes[m.node].mem.free_hugepages_gb
+    # NIC bandwidth too: claim_from_topology restores it best-effort (a
+    # silently-lost nic_mac would leak the rx/tx claim on replay)
+    assert [
+        (nic.speed_used[0], nic.speed_used[1], nic.pods_used)
+        for nic in fresh[m.node].nics
+    ] == [
+        (nic.speed_used[0], nic.speed_used[1], nic.pods_used)
+        for nic in nodes[m.node].nics
+    ]
 
 
 def test_gpu_map_indexes_across_groups():
