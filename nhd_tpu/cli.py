@@ -79,10 +79,17 @@ def explain_main(args, backend=None) -> int:
     debugging workflow (reference README.md:161-171). ``backend`` is
     injectable for tests; by default it is built from the flags.
     """
-    from nhd_tpu.config.parser import get_cfg_parser
+    from nhd_tpu.config.parser import get_cfg_parser, registered_cfg_types
     from nhd_tpu.core.request import PodRequest
     from nhd_tpu.scheduler.core import Scheduler
     from nhd_tpu.solver.explain import explain
+
+    if args.explain and args.cfg_type not in registered_cfg_types():
+        # a diagnostics tool must not fall back to the wrong parser and
+        # then blame the user's config
+        print(f"unknown --cfg-type {args.cfg_type!r}; registered: "
+              + ", ".join(registered_cfg_types()))
+        return 1
 
     if backend is None:
         if args.fake:
@@ -119,7 +126,7 @@ def explain_main(args, backend=None) -> int:
             g.strip() for g in args.groups.split(",") if g.strip()
         ) or frozenset({"default"})
         cfg_text = None
-        cfg_type = "triad"
+        cfg_type = args.cfg_type
     try:
         if cfg_text is None:
             with open(args.explain) as fh:
@@ -127,7 +134,10 @@ def explain_main(args, backend=None) -> int:
         parser = get_cfg_parser(cfg_type, cfg_text)
         top = parser.to_topology(False)
         if top is None:
-            raise ValueError("config has no parseable TopologyCfg")
+            raise ValueError(
+                f"the {cfg_type!r} parser found no usable topology "
+                "(see the parse error above)"
+            )
         if live_pod is not None:
             # pod-spec hugepage requests override the config's figure,
             # like the scheduler's reservation fold-in (core.py
@@ -163,6 +173,9 @@ def main(argv=None) -> int:
                              "(reads its own ConfigMap and node-groups)")
     parser.add_argument("--groups", default="default",
                         help="pod node-groups for --explain (comma-sep)")
+    parser.add_argument("--cfg-type", default="triad",
+                        help="config format for --explain files "
+                             "(registered cfg_type, e.g. triad or json)")
     args = parser.parse_args(argv)
 
     logger = get_logger(__name__)

@@ -49,3 +49,8 @@ def get_cfg_parser(cfg_type: Optional[str], cfg_text: str) -> CfgParser:
     the reference factory does (NHDScheduler.py:228-233)."""
     factory = _REGISTRY.get(cfg_type or _DEFAULT_TYPE) or _REGISTRY[_DEFAULT_TYPE]
     return factory(cfg_text)
+
+
+def registered_cfg_types() -> list:
+    """The cfg_type values currently registered (CLI validation)."""
+    return sorted(_REGISTRY)

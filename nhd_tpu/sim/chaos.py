@@ -69,6 +69,7 @@ class ChaosSim:
         groups = self.rng.choice([None, None, "default", "edge"])
         if self.rng.random() < 0.25:
             # exercise the second config format through the same storm
+            cfg_type = "json"
             cfg = json.dumps({
                 "map_mode": self.rng.choice(["NUMA", "NUMA", "PCI"]),
                 "hugepages_gb": self.rng.choice([2, 4]),
@@ -81,11 +82,8 @@ class ChaosSim:
                     "nic": {"rx_gbps": 10.0, "tx_gbps": 5.0},
                 }],
             })
-            self.backend.create_pod(
-                f"chaos-{self._pod_seq}", cfg_text=cfg, cfg_type="json",
-                groups=groups,
-            )
         else:
+            cfg_type = "triad"
             cfg = make_triad_config(
                 n_groups=self.rng.choice([1, 1, 2]),
                 gpus_per_group=self.rng.choice([0, 1]),
@@ -93,9 +91,10 @@ class ChaosSim:
                 hugepages_gb=self.rng.choice([2, 4]),
                 map_type=self.rng.choice(["NUMA", "NUMA", "PCI"]),
             )
-            self.backend.create_pod(
-                f"chaos-{self._pod_seq}", cfg_text=cfg, groups=groups
-            )
+        self.backend.create_pod(
+            f"chaos-{self._pod_seq}", cfg_text=cfg, cfg_type=cfg_type,
+            groups=groups,
+        )
         self.stats.created += 1
 
     def _act_group_move(self) -> None:

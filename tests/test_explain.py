@@ -159,6 +159,18 @@ def test_cli_explain_pod_live(capsys):
     assert "500" in out
 
 
+def test_cli_explain_json_cfg_type(tmp_path, capsys):
+    from nhd_tpu.cli import main
+    from tests.test_jsoncfg import json_cfg
+
+    cfg = tmp_path / "pod.json"
+    cfg.write_text(json_cfg(hugepages_gb=999))
+    rc = main(["--fake", "--explain", str(cfg), "--cfg-type", "json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "insufficient-hugepages" in out
+
+
 def test_cli_explain_unparseable_config(tmp_path, capsys):
     """A broken config is itself the diagnosis — no traceback."""
     from nhd_tpu.cli import main
