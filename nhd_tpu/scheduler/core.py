@@ -35,6 +35,11 @@ Q_BLOCK_TIME_SEC = 0.5      # reference: NHDScheduler.py:25
 # per-solve memory at federation scale (SURVEY §5.7)
 STREAM_NODE_THRESH = int(os.environ.get("NHD_STREAM_NODES", "4096"))
 
+# commit-path concurrency: 1 (default) = the reference's strictly serial
+# annotate→bind sequence; >1 = per-pod commit sequences on a thread pool
+# (API-server round trips dominate gang bind latency on real clusters)
+COMMIT_WORKERS = int(os.environ.get("NHD_COMMIT_WORKERS", "1"))
+
 
 class PodStatus(Enum):
     """Reference: NHDScheduler.py:29-34."""
@@ -304,7 +309,7 @@ class Scheduler(threading.Thread):
             results, 99
         )
 
-        scheduled = 0
+        winners: List[Tuple[CfgParser, BatchItem, object]] = []
         for (parser, item), result in zip(prepared, results):
             ns, pod = item.key
             if result.node is None:
@@ -316,14 +321,37 @@ class Scheduler(threading.Thread):
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
                 }
-                continue
-            if self._commit_pod(parser, item, result):
+            else:
+                winners.append((parser, item, result))
+
+        # the commit path is >= 5 serial API round trips per pod — at gang
+        # scale the API server, not the solver, bounds bind latency. With
+        # NHD_COMMIT_WORKERS > 1 the per-pod backend call sequences run on
+        # a thread pool (each pod's own events stay ordered); every
+        # scheduler-state mutation (pod_state, failure unwind) happens
+        # here, on the single-writer thread, after the pool joins.
+        # Default 1 = the reference's strictly serial behavior.
+        if COMMIT_WORKERS > 1 and len(winners) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=COMMIT_WORKERS) as pool:
+                outcomes = list(pool.map(
+                    lambda w: self._commit_pod_calls(*w), winners
+                ))
+        else:
+            outcomes = [self._commit_pod_calls(*w) for w in winners]
+
+        scheduled = 0
+        for (parser, item, result), ok in zip(winners, outcomes):
+            ns, pod = item.key
+            if ok:
                 scheduled += 1
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.SCHEDULED, "time": time.time(),
                     "uid": uids.get((ns, pod), "0"),
                 }
             else:
+                self._unwind(pod, ns, self.nodes[result.node], item)
                 self.failed_schedule_count += 1
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
@@ -334,9 +362,12 @@ class Scheduler(threading.Thread):
         self.perf["scheduled_total"] += scheduled
         return scheduled
 
-    def _commit_pod(self, parser: CfgParser, item: BatchItem, result) -> bool:
-        """NAD → solved config → GPU map → bind, releasing on any failure
-        (reference: NHDScheduler.py:286-353)."""
+    def _commit_pod_calls(self, parser: CfgParser, item: BatchItem, result) -> bool:
+        """The backend-only commit sequence: NAD → GPU map → solved config
+        → bind (reference: NHDScheduler.py:286-353). Touches no scheduler
+        state (node reads only), so commits for different pods may run on
+        worker threads; the failure unwind stays on the scheduler thread
+        (attempt_scheduling_batch's outcome loop)."""
         ns, pod = item.key
         node = self.nodes[result.node]
         self.backend.generate_pod_event(
@@ -348,7 +379,6 @@ class Scheduler(threading.Thread):
         nad = ",".join(f"{x}@{x}" for x in node.nad_names_from_indices(nic_indices))
         if nad and not self.backend.add_nad_to_pod(pod, ns, nad):
             self.logger.error(f"NAD annotation failed for {ns}/{pod}")
-            self._unwind(pod, ns, node, item)
             return False
 
         solved = parser.to_config()
@@ -359,7 +389,6 @@ class Scheduler(threading.Thread):
                 pod, ns, "PodCfgFailed", EventType.WARNING,
                 "Failed to annotate pod's GPU configuration",
             )
-            self._unwind(pod, ns, node, item)
             return False
 
         if not self.backend.annotate_pod_config(ns, pod, solved):
@@ -367,7 +396,6 @@ class Scheduler(threading.Thread):
                 pod, ns, "PodCfgFailed", EventType.WARNING,
                 "Failed to annotate pod's configuration",
             )
-            self._unwind(pod, ns, node, item)
             return False
         self.backend.generate_pod_event(
             pod, ns, "PodCfgSuccess", EventType.NORMAL,
@@ -379,7 +407,6 @@ class Scheduler(threading.Thread):
                 pod, ns, "FailedScheduling", EventType.WARNING,
                 f"Failed to schedule {ns}/{pod} to {result.node}",
             )
-            self._unwind(pod, ns, node, item)
             return False
 
         self.backend.generate_pod_event(
@@ -387,6 +414,7 @@ class Scheduler(threading.Thread):
             f"Successfully assigned {ns}/{pod} to {result.node}",
         )
         return True
+
 
     def _unwind(self, pod: str, ns: str, node: HostNode, item: BatchItem) -> None:
         """Roll back an applied batch claim when the K8s commit path fails.

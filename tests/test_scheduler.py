@@ -125,6 +125,35 @@ def test_restart_replay():
     assert sched2.nodes["node0"].total_pods() == 1
 
 
+def test_concurrent_commits_match_serial(monkeypatch):
+    """NHD_COMMIT_WORKERS > 1 runs per-pod commit sequences on a pool:
+    same binds, each pod's own event order preserved, and a bind failure
+    still unwinds on the scheduler thread."""
+    from nhd_tpu.scheduler import core as core_mod
+
+    monkeypatch.setattr(core_mod, "COMMIT_WORKERS", 4)
+    backend = make_backend(n_nodes=3)
+    for i in range(6):
+        backend.create_pod(f"gang-{i}", cfg_text=pod_cfg())
+    backend.fail_bind_for.add(("default", "gang-3"))
+    sched = make_scheduler(backend)
+    sched.check_pending_pods()
+
+    bound = {name: backend.pods[("default", name)].node
+             for name in (f"gang-{i}" for i in range(6))}
+    assert bound["gang-3"] is None          # failed bind
+    assert sum(1 for n in bound.values() if n) == 5
+    assert sched.perf["scheduled_total"] == 5
+    assert sched.failed_schedule_count == 1
+    # unwound: cluster books balance (5 pods' worth of claims only)
+    assert sum(n.total_pods() for n in sched.nodes.values()) == 5
+    # per-pod event sequence is still the reference order
+    for i in (0, 1, 2, 4, 5):
+        seq = [e.reason for e in backend.events if e.pod == f"gang-{i}"]
+        assert seq == ["StartedScheduling", "Scheduling", "PodCfgSuccess",
+                       "Scheduled"]
+
+
 def test_scheduler_streams_past_node_threshold(monkeypatch):
     """Past NHD_STREAM_NODES the scheduler solves through the streaming
     tiler — same end result, bounded per-solve memory."""
