@@ -88,6 +88,7 @@ def _init_jax(platform: str):
 
 def run_batch(nodes, reqs, *, warm: bool = True):
     import copy
+    import gc
 
     from nhd_tpu.solver import BatchItem, BatchScheduler
 
@@ -103,8 +104,6 @@ def run_batch(nodes, reqs, *, warm: bool = True):
         sched.schedule(warm_nodes, items, now=0.0)
         # the copied object graph (~10^5 objects) would otherwise trigger
         # gc cycles inside the measured region (~2.5x on the assign phase)
-        import gc
-
         del warm_nodes
         gc.collect()
         gc.freeze()
@@ -114,8 +113,6 @@ def run_batch(nodes, reqs, *, warm: bool = True):
     if warm:
         # un-pin the heap: a permanent freeze would accumulate every
         # config's dead-but-cyclic objects across the bench sweep
-        import gc
-
         gc.unfreeze()
         gc.collect()
     placed = sum(1 for r in results if r.node)

@@ -176,6 +176,9 @@ def main(argv=None) -> int:
     parser.add_argument("--cfg-type", default="triad",
                         help="config format for --explain files "
                              "(registered cfg_type, e.g. triad or json)")
+    parser.add_argument("--run-seconds", type=float, default=0,
+                        help="exit cleanly after N seconds with a summary "
+                             "(demo/smoke runs; 0 = run forever)")
     args = parser.parse_args(argv)
 
     logger = get_logger(__name__)
@@ -215,12 +218,23 @@ def main(argv=None) -> int:
 
     # liveness watchdog (reference: bin/nhd:43-56): crash-only — if any
     # thread dies the whole process exits and the Deployment restarts it
+    deadline = time.monotonic() + args.run_seconds if args.run_seconds else None
     while True:
         time.sleep(1)
         for t in threads:
             if not t.is_alive():
                 logger.error(f"thread {t.name} died; exiting")
                 os._exit(-1)
+        if deadline is not None and time.monotonic() >= deadline:
+            if args.fake:
+                # controller/scheduler threads are still mutating the
+                # backend; snapshot under its lock
+                with backend._lock:
+                    bound = sum(1 for p in backend.pods.values() if p.node)
+                    total, n_nodes = len(backend.pods), len(backend.nodes)
+                print(f"demo summary: {bound}/{total} pods "
+                      f"bound across {n_nodes} nodes")
+            return 0
 
 
 if __name__ == "__main__":

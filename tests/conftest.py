@@ -34,6 +34,21 @@ try:  # pragma: no cover - environment-specific
     _xb._backend_factories.pop("axon", None)
 except Exception:
     pass
+
+
+def subprocess_env(**extra):
+    """Environment for subprocess tests: repo root PREPENDED to
+    PYTHONPATH, never overwriting it (the sitecustomize plugin lives
+    there — see the TPU environment notes). Shared by every test that
+    spawns a python child."""
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root]
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    )
+    env.update(extra)
+    return env
 # ...and the registration also overrides the jax_platforms *config*, which
 # beats the env var — force it back so the suite really runs on CPU.
 jax.config.update("jax_platforms", "cpu")

@@ -88,12 +88,10 @@ def _free_port() -> int:
 
 
 def _run_pair(scenario: str) -> None:
+    from tests.conftest import subprocess_env
+
     port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
-        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
-    )
+    env = subprocess_env()
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, str(rank), "2", str(port),
