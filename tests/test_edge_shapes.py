@@ -113,3 +113,92 @@ def test_many_group_pod_single_numa_no_overflow():
     used = sum(1 for c in node.cores
                if c.used and c.core not in node.reserved_cores)
     assert used > 33  # all groups' cores actually claimed
+
+
+def test_single_socket_nodes_schedule_and_match_oracle():
+    """U=1 clusters (single-socket nodes) never occur in the randomized
+    generators (always sockets=2); pin the degenerate combo lattice."""
+    import copy
+
+    from nhd_tpu.solver import find_node
+    from tests.test_batch import items, simple_request
+
+    nodes = make_cluster(
+        3, SynthNodeSpec(sockets=1, phys_cores=16, gpus_per_numa=2,
+                         nics_per_numa=3),
+    )
+    ref = copy.deepcopy(nodes)
+    reqs = [simple_request(gpus=i % 2) for i in range(8)]
+    results, stats = BatchScheduler(respect_busy=False).schedule(
+        nodes, items(reqs), now=0.0
+    )
+    assert stats.scheduled == 8 and stats.failed == 0
+    want = find_node(ref, reqs[0], now=0.0, respect_busy=False)
+    assert results[0].node == want.node
+
+
+def test_mixed_socket_counts_pad_cleanly():
+    """A heterogeneous cluster mixing U=1 and U=2 nodes: single-socket
+    rows are padded to the cluster-wide U and must never be selected for
+    a NUMA index they don't have."""
+    from tests.test_batch import items, simple_request
+
+    nodes = {}
+    nodes.update(make_cluster(
+        2, SynthNodeSpec(sockets=1, phys_cores=8, gpus_per_numa=1,
+                         nics_per_numa=2)))
+    two = make_cluster(
+        2, SynthNodeSpec(sockets=2, phys_cores=24, gpus_per_numa=2,
+                         nics_per_numa=2))
+    for name, node in two.items():
+        nodes[f"big-{name}"] = node
+    reqs = [simple_request(gpus=1) for _ in range(10)]
+    results, stats = BatchScheduler(respect_busy=False).schedule(
+        nodes, items(reqs), now=0.0
+    )
+    assert stats.failed == 0
+    assert stats.scheduled >= 6
+    single_socket = {n for n in nodes if not n.startswith("big-")}
+    placed_on_small = 0
+    for r in results:
+        if r.node in single_socket and r.mapping is not None:
+            placed_on_small += 1
+            # the padded NUMA index 1 must never be chosen on a U=1 node
+            assert all(u == 0 for u in r.mapping["gpu"])
+            assert all(u == 0 for u in r.mapping["cpu"])
+            assert all(u == 0 for u, _ in r.mapping["nic"])
+    assert placed_on_small > 0, "no pod exercised the padded U=1 rows"
+
+
+def test_device_state_update_rows_matches_reupload():
+    """Targeted: after claims, the resident arrays patched by the donated
+    row scatters must equal a fresh full upload — on one device and on
+    the 8-device mesh."""
+    import numpy as np
+
+    from nhd_tpu.parallel.sharding import make_mesh
+    from nhd_tpu.solver.device_state import _ARG_ORDER, DeviceClusterState
+    from nhd_tpu.solver.encode import encode_cluster, refresh_node_row
+    from tests.test_batch import items, simple_request
+
+    for mesh in (None, make_mesh()):
+        nodes = make_cluster(6)
+        cluster = encode_cluster(nodes, now=0.0)
+        dev = DeviceClusterState(cluster, mesh)
+
+        # mutate some rows on the host mirror, refresh, scatter
+        touched = [0, 2, 5]
+        for i, name in enumerate(nodes):
+            if i in touched:
+                for gpu in nodes[name].gpus[:2]:
+                    gpu.used = True
+                nodes[name].mem.free_hugepages_gb -= 8
+                refresh_node_row(cluster, i, nodes[name], now=0.0)
+        dev.update_rows(touched)
+
+        fresh = DeviceClusterState(cluster, mesh)
+        for name in _ARG_ORDER:
+            np.testing.assert_array_equal(
+                np.asarray(dev._dev[name]), np.asarray(fresh._dev[name]),
+                err_msg=f"{name} diverged (mesh={mesh is not None})",
+            )
