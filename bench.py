@@ -39,7 +39,7 @@ def _log(msg: str) -> None:
 
 def _pick_platform() -> str:
     """Probe TPU availability in a subprocess (a wedged tunnel must not hang
-    the bench); retry once with a longer deadline, then fall back to CPU.
+    the bench), falling back to CPU on timeout.
 
     Runs FIRST in main() — before any jax work in this process — so the
     probe can't be poisoned by an earlier backend init, and a healthy
@@ -71,17 +71,9 @@ def _init_jax(platform: str):
     import jax
 
     if platform == "cpu":
-        try:
-            from jax._src import xla_bridge as _xb
+        from nhd_tpu.utils import force_cpu_backend
 
-            # pop ONLY the tunnel-backed plugin that can hang backend init —
-            # removing every non-cpu factory breaks Pallas, whose import
-            # registers TPU lowering rules and requires the 'tpu' platform
-            # to at least be *known*
-            _xb._backend_factories.pop("axon", None)
-        except Exception:
-            pass
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend(jax)
     jax.config.update("jax_compilation_cache_dir", "/tmp/nhd_tpu_jax_cache")
     return jax
 

@@ -227,13 +227,10 @@ def main(argv=None) -> int:
                 os._exit(-1)
         if deadline is not None and time.monotonic() >= deadline:
             if args.fake:
-                # controller/scheduler threads are still mutating the
-                # backend; snapshot under its lock
-                with backend._lock:
-                    bound = sum(1 for p in backend.pods.values() if p.node)
-                    total, n_nodes = len(backend.pods), len(backend.nodes)
-                print(f"demo summary: {bound}/{total} pods "
-                      f"bound across {n_nodes} nodes")
+                snap = backend.snapshot_stats()
+                print(f"demo summary: {snap['bound_pods']}/"
+                      f"{snap['total_pods']} pods "
+                      f"bound across {snap['nodes']} nodes")
             return 0
 
 

@@ -76,6 +76,16 @@ class FakeClusterBackend(ClusterBackend):
     # simulation controls (test-facing, not part of ClusterBackend)
     # ------------------------------------------------------------------
 
+    def snapshot_stats(self) -> Dict[str, int]:
+        """Consistent point-in-time counts while scheduler/controller
+        threads are still mutating the backend (CLI demo summary)."""
+        with self._lock:
+            return {
+                "bound_pods": sum(1 for p in self.pods.values() if p.node),
+                "total_pods": len(self.pods),
+                "nodes": len(self.nodes),
+            }
+
     def add_node(self, name: str, labels: Dict[str, str], *,
                  hugepages_gb: int = 64, addr: str = "") -> FakeNode:
         with self._lock:

@@ -35,6 +35,20 @@ Q_BLOCK_TIME_SEC = 0.5      # reference: NHDScheduler.py:25
 # per-solve memory at federation scale (SURVEY §5.7)
 STREAM_NODE_THRESH = int(os.environ.get("NHD_STREAM_NODES", "4096"))
 
+# streaming tiler shape knobs (latency/memory trade-off, OPERATIONS.md):
+# smaller tiles bound per-solve memory and shorten each tile's turn;
+# larger chunks amortize encode cost across more pods per offer.
+# Validated here so a misconfigured value fails at startup, not when the
+# node count first crosses STREAM_NODE_THRESH mid-run on the scheduler
+# thread (StreamingScheduler's own constructor check would fire there)
+STREAM_TILE_NODES = int(os.environ.get("NHD_STREAM_TILE_NODES", "2048"))
+STREAM_CHUNK_PODS = int(os.environ.get("NHD_STREAM_CHUNK_PODS", "16384"))
+if STREAM_TILE_NODES < 1 or STREAM_CHUNK_PODS < 1:
+    raise ValueError(
+        "NHD_STREAM_TILE_NODES and NHD_STREAM_CHUNK_PODS must be >= 1, got "
+        f"{STREAM_TILE_NODES} / {STREAM_CHUNK_PODS}"
+    )
+
 # commit-path concurrency: 1 (default) = the reference's strictly serial
 # annotate→bind sequence; >1 = per-pod commit sequences on a thread pool
 # (API-server round trips dominate gang bind latency on real clusters)
@@ -84,6 +98,9 @@ class Scheduler(threading.Thread):
         self.failed_schedule_count = 0
         self.batch = BatchScheduler(respect_busy=respect_busy)
         self._stream = None   # built lazily past STREAM_NODE_THRESH
+        # vanished-pod suspects from the previous reconcile scan
+        # (reconcile_deleted_pods two-scan release rule)
+        self._missing_once: set = set()
         # cumulative solver-phase accounting (exported via PERF_INFO /
         # the Prometheus plane; the north-star metric is p99 bind latency,
         # SURVEY §5.1/§5.5)
@@ -290,7 +307,9 @@ class Scheduler(threading.Thread):
 
             if self._stream is None:
                 self._stream = StreamingScheduler(
-                    respect_busy=self.batch.respect_busy
+                    tile_nodes=STREAM_TILE_NODES,
+                    chunk_pods=STREAM_CHUNK_PODS,
+                    respect_busy=self.batch.respect_busy,
                 )
             solver = self._stream
         else:
@@ -367,7 +386,24 @@ class Scheduler(threading.Thread):
         → bind (reference: NHDScheduler.py:286-353). Touches no scheduler
         state (node reads only), so commits for different pods may run on
         worker threads; the failure unwind stays on the scheduler thread
-        (attempt_scheduling_batch's outcome loop)."""
+        (attempt_scheduling_batch's outcome loop).
+
+        Never raises: backend methods return bools by contract, but an
+        exception escaping one commit (an unwrapped client error) must
+        not skip the outcome loop — on the serial path it would kill the
+        scheduler thread with the mirror mutated and no unwind recorded;
+        on the pool path it would abort ``pool.map`` before any other
+        winner's outcome ran. Either way: log, treat as a failed commit.
+        """
+        try:
+            return self._commit_pod_calls_inner(parser, item, result)
+        except Exception:
+            self.logger.exception(
+                f"commit raised for {item.key}; treating as failed"
+            )
+            return False
+
+    def _commit_pod_calls_inner(self, parser: CfgParser, item: BatchItem, result) -> bool:
         ns, pod = item.key
         node = self.nodes[result.node]
         self.backend.generate_pod_event(
@@ -481,7 +517,17 @@ class Scheduler(threading.Thread):
         the claimed one is dead — release it so the new Pending pod can
         schedule this very scan instead of stalling behind a stale
         SCHEDULED record (the event path's uid check, mirrored here).
+
+        A single listing can be transiently inconsistent on a real API
+        server, so a *vanished* pod (absent from ``live``, vs the
+        uid-mismatch case where a live pod positively proves replacement)
+        is only released once it has been missing on two consecutive
+        scans. Costs no extra API calls (a point-GET confirm would stall
+        the single-writer loop for the exact mass-delete scenario this
+        net exists for) and delays a missed-delete release by one scan —
+        the watch path handles ordinary deletes immediately.
         """
+        suspects: set = set()
         for node in self.nodes.values():
             for pod, ns in list(node.pod_info):
                 key = (ns, pod)
@@ -494,7 +540,10 @@ class Scheduler(threading.Thread):
                     why = (f"replaced (uid {claimed_uid} -> {live_uid}) "
                            "without a delete event")
                 else:
-                    why = "vanished without a delete event"
+                    if key not in self._missing_once:
+                        suspects.add(key)  # first miss: wait one scan
+                        continue
+                    why = "vanished without a delete event (2 scans)"
                 self.logger.warning(
                     f"{ns}.{pod} {why}; releasing its claims on "
                     f"{node.name} from the mirror"
@@ -503,6 +552,9 @@ class Scheduler(threading.Thread):
                 node.release_from_topology(top)
                 node.remove_scheduled_pod(pod, ns)
                 self.pod_state.pop(key, None)
+        # rebuilt every scan: a pod that reappears in a later listing
+        # drops back out of the suspect set
+        self._missing_once = suspects
 
     # ------------------------------------------------------------------
     # stats (consumed by the RPC plane)

@@ -211,6 +211,11 @@ class ChaosSim:
         }
         for key, node_name in mirrored.items():
             if key not in bound:
+                # a vanished pod is released only after missing on two
+                # consecutive scans (reconcile_deleted_pods); a claim in
+                # the suspect set is awaiting its confirmation, not leaked
+                if key in self.sched._missing_once:
+                    continue
                 v.append(f"step {self.stats.steps}: mirror has unbound {key}")
             elif bound[key] != node_name:
                 v.append(f"step {self.stats.steps}: {key} mirror/backend differ")

@@ -37,6 +37,21 @@ from nhd_tpu.solver.kernel import (
     pad_nodes,
 )
 
+_pallas_mesh_warned = False
+
+
+def _warn_pallas_mesh_once() -> None:
+    global _pallas_mesh_warned
+    if not _pallas_mesh_warned:
+        _pallas_mesh_warned = True
+        from nhd_tpu.utils import get_logger
+
+        get_logger(__name__).warning(
+            "NHD_TPU_PALLAS=1 is ignored on the sharded (mesh) solve path;"
+            " solving via the pjit SPMD solver without the Pallas kernel"
+        )
+
+
 # node arrays that claims mutate; the rest are uploaded once and never touched
 _MUTABLE = ("busy", "hp_free", "cpu_free", "gpu_free", "nic_free", "gpu_free_sw")
 _STATIC = (
@@ -104,7 +119,13 @@ class DeviceClusterState:
         self.N = cluster.n_nodes
         self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
         n_dev = self.mesh.devices.size if self.mesh else 1
-        self.Np = pad_nodes(self.N, n_dev, floor=128 if pallas_enabled() else 8)
+        # the sharded solver never lowers through Pallas (per-shard node
+        # extents fall below the kernel's lane tile), so on the mesh path
+        # NHD_TPU_PALLAS must not inflate padding it can't benefit from
+        use_pallas = pallas_enabled() and self.mesh is None
+        if pallas_enabled() and self.mesh is not None:
+            _warn_pallas_mesh_once()
+        self.Np = pad_nodes(self.N, n_dev, floor=128 if use_pallas else 8)
         self._node_sharding = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P

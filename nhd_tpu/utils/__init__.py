@@ -1,3 +1,4 @@
 from nhd_tpu.utils.logging import get_logger
+from nhd_tpu.utils.platform import force_cpu_backend
 
-__all__ = ["get_logger"]
+__all__ = ["get_logger", "force_cpu_backend"]

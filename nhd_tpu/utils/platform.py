@@ -1,0 +1,32 @@
+"""Keeping a process off a wedged tunnel-backed TPU plugin.
+
+This dev image's sitecustomize registers a remote-TPU PJRT plugin
+("axon") in every interpreter. Two traps, shared by every entry point
+that must run on CPU (tests/conftest.py, bench.py, tools/export_tpu.py):
+
+- the plugin initializes even under ``JAX_PLATFORMS=cpu`` (the
+  registration overrides the *config*, which beats the env var), and a
+  wedged tunnel then blocks forever inside ``make_c_api_client``;
+- popping every non-cpu backend factory breaks Pallas, whose import
+  registers TPU lowering rules and needs the "tpu" platform to at least
+  be *known* — only the tunnel-backed plugin may be dropped.
+
+This is the single copy of that dance. Call before any jax backend
+initialization (importing jax is fine; creating arrays is not).
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_backend(jax=None):
+    """Pin this process to the CPU backend, immune to a wedged tunnel."""
+    if jax is None:
+        import jax
+    try:  # pragma: no cover - environment-specific
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    return jax

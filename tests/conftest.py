@@ -24,16 +24,12 @@ import jax  # noqa: E402  (env vars above must be set first)
 jax.config.update("jax_compilation_cache_dir", "/tmp/nhd_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
-# This box's sitecustomize registers a remote-TPU PJRT plugin ('axon') in
-# every interpreter; jax initializes it even under JAX_PLATFORMS=cpu, and a
-# wedged tunnel then blocks the whole suite inside make_c_api_client.
-# Unit tests must never depend on TPU tunnel health — drop the factory.
-try:  # pragma: no cover - environment-specific
-    from jax._src import xla_bridge as _xb
+# Unit tests must never depend on TPU tunnel health — the shared helper
+# drops the tunnel-backed plugin factory and pins jax_platforms=cpu
+# (see nhd_tpu/utils/platform.py for why both legs are needed)
+from nhd_tpu.utils import force_cpu_backend  # noqa: E402
 
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
+force_cpu_backend(jax)
 
 
 def subprocess_env(**extra):
@@ -49,6 +45,3 @@ def subprocess_env(**extra):
     )
     env.update(extra)
     return env
-# ...and the registration also overrides the jax_platforms *config*, which
-# beats the env var — force it back so the suite really runs on CPU.
-jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,72 @@
+"""AOT export round-trip: the TPU program artifact (tools/export_tpu.py)
+deserializes, carries both platforms, and — because the artifact includes
+a CPU lowering alongside the TPU one — executes on CPU bit-identically
+to the live jitted solver. Pins the artifact contract for the day the
+wedged tunnel (docs/TPU_STATUS.md) comes back."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tools.export_tpu import (
+    build_headline_buckets,
+    export_solver,
+    register_solveout_serialization,
+)
+
+
+@pytest.fixture(scope="module")
+def exported_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("artifacts")
+    metas = export_solver(str(out))
+    return out, metas
+
+
+def test_export_metadata(exported_dir):
+    out, metas = exported_dir
+    assert metas, "no buckets exported"
+    for meta in metas:
+        assert meta["platforms"] == ["cpu", "tpu"]
+        assert meta["bytes"] > 0
+        path = out / meta["artifact"]
+        assert path.exists() and path.stat().st_size == meta["bytes"]
+        side = json.loads((out / meta["artifact"].replace(
+            ".stablehlo.bin", ".json")).read_text())
+        assert side["bucket"] == meta["bucket"]
+
+
+def test_roundtrip_executes_and_matches_live_solver(exported_dir):
+    from jax import export as jexport
+
+    from nhd_tpu.solver.kernel import get_solver
+
+    out, metas = exported_dir
+    register_solveout_serialization()
+    buckets = {tuple(m["bucket"].values()): m for m in metas}
+    for args, meta in build_headline_buckets():
+        b = meta["bucket"]
+        blob = (out / buckets[(b["G"], b["U"], b["K"])]["artifact"]).read_bytes()
+        exported = jexport.deserialize(bytearray(blob))
+        got = exported.call(*args)
+        want = get_solver(b["G"], b["U"], b["K"])(*args)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.array(g), np.array(w))
+
+
+def test_repo_artifacts_committed():
+    """The checked-in artifacts/ copies deserialize and match the current
+    solver's bucket inventory (regenerate via tools/export_tpu.py)."""
+    art = os.path.join(os.path.dirname(os.path.dirname(__file__)), "artifacts")
+    metas = [f for f in os.listdir(art) if f.endswith(".json")]
+    bins = [f for f in os.listdir(art) if f.endswith(".stablehlo.bin")]
+    assert metas and len(metas) == len(bins)
+    register_solveout_serialization()
+    from jax import export as jexport
+
+    for m in metas:
+        meta = json.load(open(os.path.join(art, m)))
+        blob = open(os.path.join(art, meta["artifact"]), "rb").read()
+        exported = jexport.deserialize(bytearray(blob))
+        assert list(exported.platforms) == ["cpu", "tpu"]

@@ -192,6 +192,12 @@ def test_missed_delete_reconciled_without_rescan():
     calls = []
     orig = sched.reset_resources
     sched.reset_resources = lambda: calls.append(1) or orig()
+    # two-scan rule: the first scan only marks the vanished pod as a
+    # suspect (a single listing may be transiently inconsistent on a
+    # real API server); the second consecutive miss releases it
+    sched.check_pending_pods()
+    assert sched.nodes[victim_node].pod_present("triad-0", "default")
+    assert ("default", "triad-0") in sched._missing_once
     sched.check_pending_pods()
     assert not calls, "reconcile fell back to a full rescan"
 

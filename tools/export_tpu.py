@@ -1,0 +1,142 @@
+"""AOT-export the batched solver for TPU via ``jax.export``.
+
+The axon tunnel has been wedged for three rounds (docs/TPU_STATUS.md), so
+no TPU has ever executed the solver. Cross-platform lowering needs no
+device: this exports the jitted bucket solve at the headline cfg4 shape
+(10k pods x 1k nodes) as serialized StableHLO with
+``platforms=["cpu", "tpu"]`` — the TPU program artifact is pinned and
+versioned in ``artifacts/`` for the day hardware returns, and the same
+artifact stays executable on CPU so tests can round-trip it
+(tests/test_export.py).
+
+Run: ``python tools/export_tpu.py [outdir]`` (defaults to ./artifacts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def build_headline_buckets():
+    """The exact padded argument arrays solve_bucket would pass for the
+    cfg4 headline shape (solver/kernel.py:239-280), one entry per
+    (G, U, K) bucket the workload produces."""
+    import numpy as np
+
+    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+    from nhd_tpu.solver.encode import encode_cluster, encode_pods
+    from nhd_tpu.solver.kernel import _pad_pow2
+
+    groups = ["default", "edge", "batch"]
+    nodes = cap_cluster(1000, groups)
+    reqs = workload_mix(256, groups)
+    cluster = encode_cluster(nodes, now=0.0)
+    buckets = encode_pods(reqs, cluster.interner)
+
+    def pad0(a, size):
+        if a.shape[0] == size:
+            return a
+        return np.concatenate(
+            [a, np.zeros((size - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
+        )
+
+    out = []
+    for G, pods in sorted(buckets.items()):
+        T, N = pods.n_types, cluster.n_nodes
+        Tp, Np = _pad_pow2(T), _pad_pow2(N)
+        args = (
+            pad0(cluster.numa_nodes, Np), pad0(cluster.smt, Np),
+            pad0(cluster.active, Np), pad0(cluster.maintenance, Np),
+            pad0(cluster.busy, Np), pad0(cluster.gpuless, Np),
+            pad0(cluster.group_mask, Np), pad0(cluster.hp_free, Np),
+            pad0(cluster.cpu_free, Np), pad0(cluster.gpu_free, Np),
+            pad0(cluster.nic_count, Np), pad0(cluster.nic_free, Np),
+            pad0(cluster.nic_sw, Np), pad0(cluster.gpu_free_sw, Np),
+            pad0(pods.cpu_dem_smt, Tp), pad0(pods.cpu_dem_raw, Tp),
+            pad0(pods.gpu_dem, Tp), pad0(pods.rx, Tp), pad0(pods.tx, Tp),
+            pad0(pods.hp, Tp), pad0(pods.needs_gpu, Tp), pad0(pods.map_pci, Tp),
+            pad0(pods.group_mask, Tp),
+        )
+        meta = {
+            "bucket": {"G": G, "U": int(cluster.U), "K": int(cluster.K)},
+            "shape": {"T": T, "Tp": Tp, "N": N, "Np": Np},
+        }
+        out.append((args, meta))
+    return out
+
+
+_registered = False
+
+
+def register_solveout_serialization() -> None:
+    global _registered
+    if _registered:
+        return
+    from jax import export as jexport
+
+    from nhd_tpu.solver.kernel import SolveOut
+
+    jexport.register_namedtuple_serialization(
+        SolveOut, serialized_name="nhd_tpu.solver.kernel.SolveOut"
+    )
+    _registered = True
+
+
+def export_solver(outdir: str) -> list:
+    import jax
+    from jax import export as jexport
+
+    from nhd_tpu.solver.kernel import get_solver
+
+    register_solveout_serialization()
+    os.makedirs(outdir, exist_ok=True)
+    metas = []
+    for args, meta in build_headline_buckets():
+        b = meta["bucket"]
+        solver = get_solver(b["G"], b["U"], b["K"])
+
+        specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
+        exported = jexport.export(solver, platforms=("cpu", "tpu"))(*specs)
+        blob = exported.serialize()
+
+        name = (
+            f"solver_g{b['G']}_u{b['U']}_k{b['K']}"
+            f"_t{meta['shape']['Tp']}_n{meta['shape']['Np']}"
+        )
+        bin_path = os.path.join(outdir, f"{name}.stablehlo.bin")
+        with open(bin_path, "wb") as f:
+            f.write(blob)
+
+        meta.update({
+            "artifact": os.path.basename(bin_path),
+            "platforms": list(exported.platforms),
+            "calling_convention_version": exported.calling_convention_version,
+            "jax_version": jax.__version__,
+            "bytes": len(blob),
+            "in_avals": [f"{s.dtype}{list(s.shape)}" for s in specs],
+            "out_avals": [str(a) for a in exported.out_avals],
+        })
+        meta_path = os.path.join(outdir, f"{name}.json")
+        with open(meta_path, "w") as f:
+            json.dump(meta, f, indent=1, sort_keys=True)
+        metas.append(meta)
+    return metas
+
+
+def main() -> int:
+    from nhd_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+    outdir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts",
+    )
+    metas = export_solver(outdir)
+    print(json.dumps(metas, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
