@@ -79,7 +79,6 @@ def _init_jax(platform: str):
 
 
 def run_batch(nodes, reqs, *, warm: bool = True):
-    import copy
     import gc
 
     from nhd_tpu.solver import BatchItem, BatchScheduler
@@ -87,16 +86,18 @@ def run_batch(nodes, reqs, *, warm: bool = True):
     sched = BatchScheduler(respect_busy=False, register_pods=False)
     items = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
     if warm:
-        # compile warmup by running the REAL schedule on a throwaway copy
-        # of the cluster: a dry run (apply=False) would warm the solves but
-        # never the donated row scatters of the device-resident path, whose
-        # first-use compiles would otherwise land inside the measured
-        # region on a cold-cache TPU
-        warm_nodes = copy.deepcopy(nodes)
-        sched.schedule(warm_nodes, items, now=0.0)
-        # the copied object graph (~10^5 objects) would otherwise trigger
-        # gc cycles inside the measured region (~2.5x on the assign phase)
-        del warm_nodes
+        # compile warmup by running the REAL schedule on the REAL cluster,
+        # then resetting allocation state in place (the scheduler's own
+        # drift-repair op, HostNode.reset_resources): a dry run
+        # (apply=False) would warm the solves but never the donated row
+        # scatters of the device-resident path, and a deepcopied warm
+        # cluster would invalidate the id-keyed static caches
+        # (EncodeStatic, FastCluster._build_static) that the production
+        # scheduler — which holds one node set for its lifetime — always
+        # hits. The measured batch is cold allocation state, warm process.
+        sched.schedule(nodes, items, now=0.0)
+        for n in nodes.values():
+            n.reset_resources()
         gc.collect()
         gc.freeze()
     t0 = time.perf_counter()

@@ -60,37 +60,123 @@ def parse_range_list(text: str) -> List[int]:
     return sorted(set(chain.from_iterable(one(p) for p in text.split(","))))
 
 
+def pack_generation_key(node_objs, *extra) -> tuple:
+    """Cache key identifying a node list's packed-topology generation.
+
+    _pack_state rebuilds a node's packed arrays on every label reparse,
+    so the arrays' id()s are the generation tokens. Single definition —
+    every id-keyed static cache over a node set (EncodeStatic,
+    FastCluster._build_static) must use this, so a future _pack_state
+    change invalidates them all in lockstep. Callers must PIN node_objs
+    in the cache entry (CPython reuses id()s of dead objects)."""
+    return (
+        *extra,
+        tuple(id(n) for n in node_objs),
+        tuple(id(n._core_socket) for n in node_objs),
+        tuple(id(n._gpu_sw) for n in node_objs),
+        tuple(id(n._nic_u) for n in node_objs),
+    )
+
+
 def format_mac(raw: str) -> str:
     """NFD flattens MACs to bare hex; restore colon form, uppercased
     (reference: NodeNic.FormatMac, Node.py:58-59)."""
     return ":".join(a + b for a, b in zip(raw[::2], raw[1::2])).upper()
 
 
-@dataclass
 class NodeCpuCore:
-    """One logical CPU (reference: Node.py:23-34)."""
+    """One logical CPU (reference: Node.py:23-34).
 
-    core: int
-    socket: int
-    sibling: int  # logical id of the SMT sibling, -1 when SMT is off
-    used: bool = False
+    ``used`` lives in the owning node's packed array once the node is
+    finalized (HostNode._pack_state) so batch encode and write-back are
+    single vector ops over the whole node instead of ~100k Python
+    attribute accesses per 1000-node batch; a core not yet attached to a
+    packed node keeps a local flag."""
+
+    __slots__ = ("core", "socket", "sibling", "_used", "_arr")
+
+    def __init__(self, core: int, socket: int, sibling: int, used: bool = False):
+        self.core = core
+        self.socket = socket
+        self.sibling = sibling  # logical id of the SMT sibling, -1 when SMT off
+        self._used = used
+        self._arr = None  # owning node's packed used[] (indexed by .core)
+
+    @property
+    def used(self) -> bool:
+        a = self._arr
+        return self._used if a is None else bool(a[self.core])
+
+    @used.setter
+    def used(self, v: bool) -> None:
+        a = self._arr
+        if a is None:
+            self._used = bool(v)
+        else:
+            a[self.core] = v
+
+    def __repr__(self) -> str:
+        return (f"NodeCpuCore(core={self.core}, socket={self.socket}, "
+                f"sibling={self.sibling}, used={self.used})")
 
 
-@dataclass
 class NodeNic:
-    """One schedulable NIC port (reference: Node.py:37-59)."""
+    """One schedulable NIC port (reference: Node.py:37-59).
 
-    ifname: str
-    mac: str
-    vendor: str
-    speed_gbps: float
-    numa_node: int
-    pciesw: int
-    card: int
-    port: int
-    idx: int = -1  # per-NUMA-node ordinal, set after all NICs are read
-    speed_used: List[float] = field(default_factory=lambda: [0.0, 0.0])  # rx, tx
-    pods_used: int = 0
+    ``speed_used``/``pods_used`` live in the owning node's packed arrays
+    after HostNode._pack_state (same rationale as NodeCpuCore.used);
+    ``speed_used`` is then a live [rx, tx] view supporting item reads,
+    writes and ``+=``."""
+
+    __slots__ = (
+        "ifname", "mac", "vendor", "speed_gbps", "numa_node", "pciesw",
+        "card", "port", "idx", "slot", "_speed_used", "_pods_used",
+        "_bw", "_pods",
+    )
+
+    def __init__(self, ifname: str, mac: str, vendor: str, speed_gbps: float,
+                 numa_node: int, pciesw: int, card: int, port: int):
+        self.ifname = ifname
+        self.mac = mac
+        self.vendor = vendor
+        self.speed_gbps = speed_gbps
+        self.numa_node = numa_node
+        self.pciesw = pciesw
+        self.card = card
+        self.port = port
+        self.idx = -1   # per-NUMA-node ordinal, set after all NICs are read
+        self.slot = -1  # position in HostNode.nics, set by _pack_state
+        self._speed_used = [0.0, 0.0]  # rx, tx (pre-pack fallback)
+        self._pods_used = 0
+        self._bw = None    # owning node's packed [n_nics, 2] bandwidth
+        self._pods = None  # owning node's packed [n_nics] pods_used
+
+    @property
+    def speed_used(self):
+        b = self._bw
+        return self._speed_used if b is None else b[self.slot]
+
+    @speed_used.setter
+    def speed_used(self, v) -> None:
+        b = self._bw
+        if b is None:
+            self._speed_used = list(v)
+        else:
+            b[self.slot, 0] = v[0]
+            b[self.slot, 1] = v[1]
+
+    @property
+    def pods_used(self) -> int:
+        p = self._pods
+        return self._pods_used if p is None else int(p[self.slot])
+
+    @pods_used.setter
+    def pods_used(self, v: int) -> None:
+        p = self._pods
+        if p is None:
+            self._pods_used = int(v)
+        else:
+            p[self.slot] = v
 
     def free_bw(self) -> Tuple[float, float]:
         """Schedulable headroom per direction. With sharing disabled a NIC
@@ -99,6 +185,10 @@ class NodeNic:
         if ENABLE_NIC_SHARING:
             return (cap - self.speed_used[0], cap - self.speed_used[1])
         return (0.0, 0.0) if self.pods_used > 0 else (cap, cap)
+
+    def __repr__(self) -> str:
+        return (f"NodeNic({self.ifname!r}, mac={self.mac!r}, "
+                f"numa={self.numa_node}, idx={self.idx})")
 
 
 @dataclass
@@ -115,15 +205,39 @@ class NodeMemory:
     res_hugepages_gb: int = 0
 
 
-@dataclass
 class NodeGpu:
-    """One GPU device (reference: Node.py:74-97)."""
+    """One GPU device (reference: Node.py:74-97). ``used`` is packed on
+    the owning node after _pack_state (see NodeCpuCore)."""
 
-    kind: GpuKind
-    device_id: int
-    numa_node: int
-    pciesw: int
-    used: bool = False
+    __slots__ = ("kind", "device_id", "numa_node", "pciesw", "slot",
+                 "_used", "_arr")
+
+    def __init__(self, kind: GpuKind, device_id: int, numa_node: int,
+                 pciesw: int, used: bool = False):
+        self.kind = kind
+        self.device_id = device_id
+        self.numa_node = numa_node
+        self.pciesw = pciesw
+        self.slot = -1  # position in HostNode.gpus, set by _pack_state
+        self._used = used
+        self._arr = None
+
+    @property
+    def used(self) -> bool:
+        a = self._arr
+        return self._used if a is None else bool(a[self.slot])
+
+    @used.setter
+    def used(self, v: bool) -> None:
+        a = self._arr
+        if a is None:
+            self._used = bool(v)
+        else:
+            a[self.slot] = v
+
+    def __repr__(self) -> str:
+        return (f"NodeGpu({self.kind}, device_id={self.device_id}, "
+                f"numa={self.numa_node}, used={self.used})")
 
 
 class HostNode:
@@ -152,6 +266,108 @@ class HostNode:
         # clock epoch the caller uses (the reference's 0.0 init relies on
         # time.monotonic() being large, Node.py:115)
         self._busy_time = float("-inf")
+        # packed dynamic state (built by _pack_state after label parse):
+        # the authoritative store of used/bandwidth flags, exposed through
+        # the NodeCpuCore/NodeGpu/NodeNic properties, so batch projection
+        # (solver/encode.py) and write-back (FastCluster.sync_to_nodes)
+        # are vector ops
+        self._core_used = None   # [L] bool
+        self._core_socket = None  # [L] int8
+        self._gpu_used = None    # [n_gpus] bool
+        self._gpu_numa = None    # [n_gpus] int32
+        self._gpu_sw = None      # [n_gpus] int64 (raw pciesw)
+        self._gpu_devid = None   # [n_gpus] int32
+        self._nic_bw = None      # [n_nics, 2] float64 (rx, tx used)
+        self._nic_pods = None    # [n_nics] int32
+        self._nic_u = None       # [n_nics] int32 (numa_node)
+        self._nic_k = None       # [n_nics] int32 (per-NUMA ordinal)
+        self._nic_cap = None     # [n_nics] float64 (schedulable Gbps)
+        self._nic_sw = None      # [n_nics] int64 (raw pciesw)
+        self._n_switches = 0     # distinct PCIe switches on this node
+        self._gpu_sw_dense = None  # [n_gpus] int64 dense switch ids
+        self._nic_sw_dense = None  # [n_nics] int64 dense switch ids
+        self._nic_cnt = None     # [max_numa+1] int32 NICs per NUMA
+
+    def _pack_state(self) -> None:
+        """Move the dynamic allocation flags into packed per-node arrays
+        (the component objects become views; see NodeCpuCore). Re-run on
+        every label reparse — component lists are rebuilt there.
+
+        Core packing requires the identity layout _init_cores builds
+        (cores[i].core == i; SMT sibling of physical core c is c + phys) —
+        the vectorized free queries index by position. A hand-assembled
+        node with a different layout keeps per-object flags and the loop
+        fallbacks."""
+        import numpy as np
+
+        phys = self.cores_per_proc * self.sockets
+        identity = all(c.core == i for i, c in enumerate(self.cores)) and (
+            not self.smt_enabled
+            or (
+                len(self.cores) >= 2 * phys
+                and all(
+                    self.cores[c].sibling == c + phys for c in range(phys)
+                )
+            )
+        )
+        if identity:
+            self._core_used = np.array([c.used for c in self.cores], bool)
+            self._core_socket = np.array(
+                [c.socket for c in self.cores], np.int8
+            )
+            for c in self.cores:
+                c._arr = self._core_used
+        else:
+            self._core_used = None
+            self._core_socket = None
+            for c in self.cores:
+                if c._arr is not None:
+                    c._used = bool(c._arr[c.core])
+                    c._arr = None
+
+        self._gpu_used = np.array([g.used for g in self.gpus], bool)
+        self._gpu_numa = np.array([g.numa_node for g in self.gpus], np.int32)
+        self._gpu_sw = np.array([g.pciesw for g in self.gpus], np.int64)
+        self._gpu_devid = np.array([g.device_id for g in self.gpus], np.int32)
+        for j, g in enumerate(self.gpus):
+            g.slot = j
+            g._arr = self._gpu_used
+
+        nb = len(self.nics)
+        self._nic_bw = np.zeros((nb, 2), np.float64)
+        self._nic_pods = np.zeros(nb, np.int32)
+        self._nic_u = np.array([n.numa_node for n in self.nics], np.int32)
+        self._nic_k = np.array([n.idx for n in self.nics], np.int32)
+        self._nic_cap = np.array(
+            [n.speed_gbps * NIC_BW_AVAIL_PERCENT for n in self.nics],
+            np.float64,
+        )
+        self._nic_sw = np.array([n.pciesw for n in self.nics], np.int64)
+        for s, n in enumerate(self.nics):
+            self._nic_bw[s, 0] = n.speed_used[0]
+            self._nic_bw[s, 1] = n.speed_used[1]
+            self._nic_pods[s] = n.pods_used
+            n.slot = s
+            n._bw = self._nic_bw
+            n._pods = self._nic_pods
+
+        # dense per-node PCIe switch ids (sorted order for determinism) —
+        # static, precomputed so encode_cluster's per-batch re-projection
+        # (solver/encode.py refresh_node_row) is pure vector ops
+        switches = sorted(set(self._gpu_sw.tolist()) | set(self._nic_sw.tolist()))
+        sw_id = {sw: j for j, sw in enumerate(switches)}
+        self._n_switches = len(switches)
+        self._gpu_sw_dense = np.array(
+            [sw_id[s] for s in self._gpu_sw.tolist()], np.int64
+        )
+        self._nic_sw_dense = np.array(
+            [sw_id[s] for s in self._nic_sw.tolist()], np.int64
+        )
+        # NICs per NUMA node (max ordinal + 1), indexed by numa id
+        u_max = int(self._nic_u.max(initial=-1)) + 1
+        self._nic_cnt = np.zeros(u_max, np.int32)
+        if nb:
+            np.maximum.at(self._nic_cnt, self._nic_u, self._nic_k + 1)
 
     # ------------------------------------------------------------------
     # label parsing
@@ -160,7 +376,7 @@ class HostNode:
     def parse_labels(self, labels: Dict[str, str]) -> bool:
         """Initialize all hardware state from node labels
         (reference: Node.py:468-487, same stage order)."""
-        return (
+        ok = (
             self._init_groups(labels)
             and self._init_maintenance(labels)
             and self._init_cores(labels)
@@ -168,6 +384,9 @@ class HostNode:
             and self._init_gpus(labels)
             and self._init_misc(labels)
         )
+        if ok:
+            self._pack_state()
+        return ok
 
     def _init_groups(self, labels: Dict[str, str]) -> bool:
         """NHD_GROUP label: dot-separated group list (reference: Node.py:312-321)."""
@@ -299,38 +518,94 @@ class HostNode:
     # free-resource queries (consumed by the matcher)
     # ------------------------------------------------------------------
 
+    def _ensure_packed(self) -> None:
+        """Lazily pack nodes built outside parse_labels (hand-assembled in
+        tests/sims); re-packs when a component *list* was swapped out
+        (detected by length or by the first element not being wired to
+        this node's arrays). Replacing individual elements of a packed
+        list is NOT detected — mutate the element's fields (e.g.
+        ``used``) instead, or call _pack_state() after surgery."""
+        if (
+            self._gpu_used is None
+            or len(self._gpu_used) != len(self.gpus)
+            or (self.gpus and self.gpus[0]._arr is not self._gpu_used)
+            or len(self._nic_pods) != len(self.nics)
+            or (self.nics and self.nics[0]._pods is not self._nic_pods)
+            or (
+                self._core_used is not None
+                and (
+                    len(self._core_used) != len(self.cores)
+                    or (
+                        self.cores
+                        and self.cores[0]._arr is not self._core_used
+                    )
+                )
+            )
+        ):
+            self._pack_state()
+
     def free_cpu_cores_per_numa(self) -> List[int]:
         """Fully-free *physical* cores per NUMA node. On SMT nodes a physical
         core counts only when both logical siblings are unused — no partial
-        multi-tenancy (reference: Node.py:250-264)."""
-        free = [0] * self.numa_nodes
-        for c in range(self.cores_per_proc * self.sockets):
-            core = self.cores[c]
-            if core.used:
-                continue
-            if self.smt_enabled and self.cores[core.sibling].used:
-                continue
-            free[core.socket] += 1
-        return free
+        multi-tenancy (reference: Node.py:250-264). Vectorized over the
+        packed used[] (the sibling of physical core c is c + phys, the
+        layout _init_cores builds); loop fallback for non-identity nodes."""
+        import numpy as np
+
+        self._ensure_packed()
+        phys = self.cores_per_proc * self.sockets
+        used = self._core_used
+        if used is None:
+            free = [0] * self.numa_nodes
+            for c in range(phys):
+                core = self.cores[c]
+                if core.used:
+                    continue
+                if self.smt_enabled and self.cores[core.sibling].used:
+                    continue
+                free[core.socket] += 1
+            return free
+        if self.smt_enabled:
+            free_phys = ~used[:phys] & ~used[phys:2 * phys]
+        else:
+            free_phys = ~used[:phys]
+        counts = np.bincount(
+            self._core_socket[:phys][free_phys].astype(np.int64),
+            minlength=self.numa_nodes,
+        )
+        return counts[: self.numa_nodes].tolist()
 
     def free_cpu_core_count(self) -> int:
         """Reference: Node.py:229-236 (logical count with both-siblings-free rule)."""
+        self._ensure_packed()
+        used = self._core_used
+        if used is None:
+            if self.smt_enabled:
+                return sum(
+                    1 for c in self.cores
+                    if not c.used and not self.cores[c.sibling].used
+                )
+            return sum(1 for c in self.cores if not c.used)
         if self.smt_enabled:
-            return sum(
-                1 for c in self.cores if not c.used and not self.cores[c.sibling].used
-            )
-        return sum(1 for c in self.cores if not c.used)
+            phys = self.cores_per_proc * self.sockets
+            pair_free = ~used[:phys] & ~used[phys:2 * phys]
+            return int(pair_free.sum()) * 2
+        return int((~used).sum())
 
     def free_gpus_per_numa(self) -> List[int]:
         """Reference: Node.py:456-462."""
-        free = [0] * self.numa_nodes
-        for g in self.gpus:
-            if not g.used:
-                free[g.numa_node] += 1
-        return free
+        import numpy as np
+
+        self._ensure_packed()
+        counts = np.bincount(
+            self._gpu_numa[~self._gpu_used].astype(np.int64),
+            minlength=self.numa_nodes,
+        )
+        return counts[: self.numa_nodes].tolist()
 
     def free_gpu_count(self) -> int:
-        return sum(1 for g in self.gpus if not g.used)
+        self._ensure_packed()
+        return int((~self._gpu_used).sum())
 
     def total_gpus(self) -> int:
         return len(self.gpus)

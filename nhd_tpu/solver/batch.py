@@ -41,7 +41,7 @@ import numpy as np
 
 from nhd_tpu.core.node import AssignmentError, HostNode
 from nhd_tpu.core.request import PodRequest
-from nhd_tpu.core.topology import MapMode, PodTopology
+from nhd_tpu.core.topology import MapMode, NicDir, PodTopology
 from nhd_tpu.solver.device_state import DeviceClusterState
 from nhd_tpu.solver.encode import encode_cluster, encode_pods, refresh_node_row
 from nhd_tpu.solver.kernel import bucket_tractable
@@ -67,7 +67,7 @@ class BatchItem:
     topology: Optional[PodTopology] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class BatchAssignment:
     key: Tuple[str, str]
     node: Optional[str]                  # None → unschedulable
@@ -103,6 +103,23 @@ class ScheduleContext:
     fast: Optional["FastCluster"]
     dev: Optional["DeviceClusterState"]
     now: float
+
+
+_FC_EXECUTOR = None
+
+
+def _fc_executor():
+    """Single shared worker for off-thread FastCluster builds (the build
+    overlaps round 1's solve; one worker is enough — schedule() joins the
+    future before any assignment)."""
+    global _FC_EXECUTOR
+    if _FC_EXECUTOR is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _FC_EXECUTOR = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="nhd-fastcluster"
+        )
+    return _FC_EXECUTOR
 
 
 def _accelerator_backend() -> bool:
@@ -165,6 +182,9 @@ class BatchScheduler:
         self.max_rounds = max_rounds
         self.use_fast = use_fast
         self.register_pods = register_pods
+        # FastCluster static-topology cache, shared across schedule() calls
+        # over the same unchanged node set (fast_assign.py _build_static)
+        self._fc_static: dict = {}
         # "auto": resident device arrays + per-round row scatters pay off on
         # real accelerators (especially across a tunnel/PCIe) but are pure
         # overhead on the CPU backend, where solve inputs are already host
@@ -304,7 +324,8 @@ class BatchScheduler:
         if not self.respect_busy:
             cluster.busy[:] = False
         fast = (
-            FastCluster(nodes, cluster.U, cluster.K, arrays=cluster)
+            FastCluster(nodes, cluster.U, cluster.K, arrays=cluster,
+                        static_cache=self._fc_static)
             if self.use_fast
             else None
         )
@@ -398,15 +419,21 @@ class BatchScheduler:
                 if not self.respect_busy:
                     cluster.busy[:] = False
 
+        fast_future = None
         if context is not None:
             fast = context.fast if apply else None
             dev = context.dev
         else:
-            fast = (
-                FastCluster(nodes, cluster.U, cluster.K, arrays=cluster)
-                if (self.use_fast and apply)
-                else None
-            )
+            fast = None
+            if self.use_fast and apply:
+                # build the packed assignment state on a worker thread —
+                # it only reads the (quiescent until assign) node mirror,
+                # and the main thread is about to block in round 1's solve
+                # pull, so the build hides under the XLA wait
+                fast_future = _fc_executor().submit(
+                    FastCluster, nodes, cluster.U, cluster.K,
+                    arrays=cluster, static_cache=self._fc_static,
+                )
             # keep node arrays resident on device across rounds; per-round
             # uploads shrink to the claimed rows (solver/device_state.py).
             # A multi-device mesh implies resident state: sharded arrays must
@@ -424,6 +451,9 @@ class BatchScheduler:
         busy_nodes: set = set()
         all_buckets = None
         is_pending = None
+        # solves for round r+1, dispatched by round r's native-assign path
+        # before it materializes results (round pipelining)
+        prelaunched = None
 
         t_batch = time.perf_counter()
         for round_no in range(self.max_rounds):
@@ -432,17 +462,29 @@ class BatchScheduler:
             stats.rounds = round_no + 1
 
             t0 = time.perf_counter()
-            if all_buckets is None:
-                # type-level tensors never change across rounds — encode the
-                # whole pending set once and only filter membership below
-                all_buckets = encode_pods(
-                    [items[i].request for i in pending],
-                    cluster.interner,
-                    indices=pending,
-                )
-                is_pending = np.zeros(len(items), bool)
-            is_pending[:] = False
-            is_pending[pending] = True
+            try:
+                if all_buckets is None:
+                    # type-level tensors never change across rounds —
+                    # encode the whole pending set once and only filter
+                    # membership below
+                    all_buckets = encode_pods(
+                        [items[i].request for i in pending],
+                        cluster.interner,
+                        indices=pending,
+                    )
+                    is_pending = np.zeros(len(items), bool)
+                is_pending[:] = False
+                is_pending[pending] = True
+            except BaseException:
+                # the off-thread FastCluster build must not outlive
+                # schedule() — it reads the caller's mutable nodes
+                if fast_future is not None:
+                    try:
+                        fast_future.result()
+                    except Exception:
+                        pass
+                    fast_future = None
+                raise
 
             # (pod index, node index, bucket G, type) chosen this round
             claims: List[Tuple[int, int, int, int]] = []
@@ -451,16 +493,50 @@ class BatchScheduler:
             # views alias, for the round's lifetime — correctness must not
             # hinge on any particular backend's buffer-export semantics
             keepalive: List[object] = []
-            for G, full in all_buckets.items():
-                mask = is_pending[full.pod_index]
-                if not mask.any():
-                    continue
-                pods = replace(
-                    full,
-                    pod_type=full.pod_type[mask],
-                    pod_index=full.pod_index[mask],
-                )
-                out = dev.solve(pods) if dev else solve_bucket(cluster, pods)
+
+            # dispatch every bucket's solve before pulling any result:
+            # jax dispatch is async, so the buckets' XLA programs overlap
+            # instead of serializing on the first np.asarray block
+            def _dispatch_solves():
+                launched = []
+                for G, full in all_buckets.items():
+                    mask = is_pending[full.pod_index]
+                    if not mask.any():
+                        continue
+                    pods = replace(
+                        full,
+                        pod_type=full.pod_type[mask],
+                        pod_index=full.pod_index[mask],
+                    )
+                    out = dev.solve(pods) if dev else solve_bucket(cluster, pods)
+                    launched.append((G, pods, out))
+                return launched
+
+            if prelaunched is not None:
+                # round r-1 dispatched this round's solves right after its
+                # native assign; its result materialization ran under the
+                # XLA compute (the round-pipelining that keeps host work
+                # off the critical path)
+                launched = prelaunched
+                prelaunched = None
+            else:
+                try:
+                    launched = _dispatch_solves()
+                except BaseException:
+                    if fast_future is not None:
+                        try:
+                            fast_future.result()
+                        except Exception:
+                            pass
+                        fast_future = None
+                    raise
+            if fast_future is not None:
+                # join here, while the just-dispatched solves compute in
+                # the XLA pool: the build still hides under the solve
+                # wait, and the worker never outlives schedule()
+                fast = fast_future.result()
+                fast_future = None
+            for G, pods, out in launched:
                 # pull results to host once — element reads off jax arrays
                 # cost ~0.2 ms each and the winner loop does three per pod.
                 # np.asarray is zero-copy on the CPU backend (copying cost
@@ -553,11 +629,13 @@ class BatchScheduler:
                 )
             )
             if round_ok:
-                # one native call places every winner of the round
-                # (native/nhd_assign.cc::nhd_assign_round)
+                # one native call per bucket places every winner of the
+                # round (native/nhd_assign.cc::nhd_assign_round) and
+                # mutates the packed state + solver arrays
                 by_bucket: Dict[int, List[Tuple[int, int, int]]] = {}
                 for pod_i, n, G, t in claims:
                     by_bucket.setdefault(G, []).append((pod_i, n, t))
+                native_out = []
                 for G, winners in by_bucket.items():
                     pods, out = bucket_out[G]
                     w_node = np.asarray([w[1] for w in winners], np.int32)
@@ -568,50 +646,142 @@ class BatchScheduler:
                         pods, w_node, w_type, w_c, w_m,
                         set_busy=self.respect_busy,
                     )
+                    native_out.append(
+                        (G, pods, winners, buffers, w_node, w_c, w_m)
+                    )
+                if dev is not None:
+                    dev.update_rows(node_claimed)
+
+                # pending update, vectorized: a winner leaves pending when
+                # its assignment succeeded (status >= 0) OR it was the
+                # first claim its node processed and failed (final — it
+                # ran against fresh feasibility); later same-node failures
+                # are stale contention and retry next round. claims.sort()
+                # put winners in pod-index order, and the one-bucket-per-
+                # node rule makes first-occurrence-within-bucket exactly
+                # "first on node this round".
+                removed: List[np.ndarray] = []
+                for G, pods, winners, buffers, w_node, w_c, w_m in native_out:
+                    ok = buffers[0] >= 0
+                    first = np.zeros(len(winners), bool)
+                    first[np.unique(w_node, return_index=True)[1]] = True
+                    pod_arr = np.fromiter(
+                        (w[0] for w in winners), np.int64, len(winners)
+                    )
+                    removed.append(pod_arr[ok | first])
+                done = (
+                    set(np.concatenate(removed).tolist()) if removed else set()
+                )
+                pending = [i for i in pending if i not in done]
+
+                # dispatch round r+1's solves NOW — the arrays already
+                # carry this round's claims, so the Python result
+                # materialization below overlaps the next XLA compute
+                if pending and round_no + 1 < self.max_rounds:
+                    is_pending[:] = False
+                    is_pending[pending] = True
+                    prelaunched = _dispatch_solves()
+
+                for G, pods, winners, buffers, w_node, w_c, w_m in native_out:
+                    # winner loop runs ~10k times a round at gang scale:
+                    # one .tolist() per buffer up front (C speed) so the
+                    # loop touches only Python ints, per-type NIC
+                    # templates so nic lists need no object-graph walks,
+                    # and a local (c, m, pick) memo in front of the
+                    # decode_mapping lru (dict.get beats the lru wrapper)
                     status = buffers[0]
-                    picks = buffers[5]
+                    status_l = status.tolist()
+                    picks_l = buffers[5].tolist()
+                    w_c_l = w_c.tolist()
+                    w_m_l = w_m.tolist()
+                    out_nic_l = buffers[3].tolist()
+                    nic_tmpl: Dict[int, list] = {
+                        t: [
+                            (g, bw, d)
+                            for g, grp in enumerate(pods.requests[t].groups)
+                            for bw, d in (
+                                (grp.nic_rx_gbps, NicDir.RX),
+                                (grp.nic_tx_gbps, NicDir.TX),
+                            )
+                            if bw > 0
+                        ]
+                        for t in {w[2] for w in winners}
+                    }
+                    U_, K_ = cluster.U, cluster.K
+                    names = cluster.names
+                    want_record = self.register_pods
+                    all_ok = bool((status >= 0).all())
+                    memo: Dict[tuple, object] = {}
+                    if all_ok and not want_record:
+                        # fast path: no failures → no first-on-node
+                        # bookkeeping; bulk set/list updates
+                        busy_nodes.update(n for _, n, _ in winners)
+                        applied_on_node.update(n for _, n, _ in winners)
+                        stats.scheduled += len(winners)
+                        for w, (pod_i, n, t) in enumerate(winners):
+                            item = items[pod_i]
+                            mk = (w_c_l[w], w_m_l[w], picks_l[w])
+                            mapping = memo.get(mk)
+                            if mapping is None:
+                                mapping = memo[mk] = decode_mapping(
+                                    G, U_, K_, mk[0], mk[1], mk[2],
+                                )
+                            if item.topology is not None:
+                                rec = fast.record_from_round(
+                                    pods, w, n, t, buffers
+                                )
+                                records[pod_i] = rec
+                                nic_list = rec.nic_list
+                            else:
+                                row = out_nic_l[w]
+                                nic_list = [
+                                    (row[g], bw, d)
+                                    for g, bw, d in nic_tmpl[t]
+                                ]
+                            results[pod_i] = BatchAssignment(
+                                item.key, names[n], mapping, nic_list,
+                                round_no,
+                            )
+                        continue
                     for w, (pod_i, n, t) in enumerate(winners):
                         item = items[pod_i]
                         is_first = n not in applied_on_node
                         applied_on_node.add(n)
-                        if status[w] < 0:
+                        if status_l[w] < 0:
                             if not is_first:
                                 continue  # stale same-node claim: retry
                             self.logger.error(
                                 f"assignment failed for {item.key} on "
-                                f"{cluster.names[n]}: stage {int(status[w])}"
+                                f"{names[n]}: stage {status_l[w]}"
                             )
                             results[pod_i] = BatchAssignment(item.key, None, failed=True)
-                            newly_scheduled.append(pod_i)
                             stats.failed += 1
                             continue
-                        newly_scheduled.append(pod_i)
                         # the NIC pick is re-selected against live state in
                         # the native call — decode the actual choice
-                        mapping = decode_mapping(
-                            G, cluster.U, cluster.K,
-                            int(w_c[w]), int(w_m[w]), int(picks[w]),
-                        )
-                        if item.topology is not None or self.register_pods:
+                        mk = (w_c_l[w], w_m_l[w], picks_l[w])
+                        mapping = memo.get(mk)
+                        if mapping is None:
+                            mapping = memo[mk] = decode_mapping(
+                                G, U_, K_, mk[0], mk[1], mk[2],
+                            )
+                        if want_record or item.topology is not None:
                             rec = fast.record_from_round(pods, w, n, t, buffers)
                             records[pod_i] = rec
                             nic_list = rec.nic_list
                         else:
-                            nic_list = fast.nic_list_from_round(
-                                pods, w, t, buffers
-                            )
+                            row = out_nic_l[w]
+                            nic_list = [
+                                (row[g], bw, d) for g, bw, d in nic_tmpl[t]
+                            ]
                         busy_nodes.add(n)
                         results[pod_i] = BatchAssignment(
-                            item.key, cluster.names[n], mapping, nic_list,
+                            item.key, names[n], mapping, nic_list,
                             round_no,
                         )
                         stats.scheduled += 1
-                if dev is not None:
-                    dev.update_rows(node_claimed)
                 stats.assign_seconds += time.perf_counter() - t0
                 stats.round_end_seconds.append(time.perf_counter() - t_batch)
-                done = set(newly_scheduled)
-                pending = [i for i in pending if i not in done]
                 continue
 
             for pod_i, n, G, t in claims:
@@ -730,6 +900,12 @@ class BatchScheduler:
             pending = [i for i in pending if i not in done]
             if not apply:
                 break  # without claims, later rounds would repeat choices
+
+        if fast_future is not None:
+            # loop never ran (nothing pending): still reap the worker —
+            # it must not outlive schedule() reading the caller's nodes
+            fast = fast_future.result()
+            fast_future = None
 
         # fast path: one final sync of the HostNode mirror + topology fills
         if fast is not None:

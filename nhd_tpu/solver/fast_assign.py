@@ -65,68 +65,75 @@ class AssignRecord:
 class FastCluster:
     """Packed allocation state for a set of HostNodes."""
 
-    def __init__(self, nodes: Dict[str, HostNode], U: int, K: int, arrays=None):
+    def __init__(self, nodes: Dict[str, HostNode], U: int, K: int, arrays=None,
+                 static_cache: Optional[dict] = None):
         self.arrays = arrays  # optional ClusterArrays kept in sync on assign
         self.names = list(nodes.keys())
         self.node_objs = [nodes[n] for n in self.names]
         N = len(self.node_objs)
         self.U, self.K = U, K
-        self.P = max((n.cores_per_proc * n.sockets for n in self.node_objs), default=1)
-        self.L = max((len(n.cores) for n in self.node_objs), default=1)
-        GM = max((len(n.gpus) for n in self.node_objs), default=1) or 1
+        for node in self.node_objs:
+            node._ensure_packed()
 
-        P, L = self.P, self.L
-        self.smt = np.zeros(N, bool)
-        self.phys = np.zeros(N, np.int32)
+        # --- static topology matrices (never mutated by assignment) ---
+        # Shared across FastCluster builds over the same unchanged node set
+        # via ``static_cache`` (one dict per BatchScheduler): a label
+        # reparse rebuilds a node's packed arrays, so array identity is the
+        # generation token; the cache entry pins node_objs, keeping the
+        # id()s valid (see _bucket_arrays for why pinning matters).
+        from nhd_tpu.core.node import pack_generation_key
+
+        key = pack_generation_key(self.node_objs, U, K)
+        st = None
+        if static_cache is not None:
+            ent = static_cache.get("entry")
+            if ent is not None and ent["key"] == key:
+                st = ent
+        if st is None:
+            st = self._build_static(key)
+            if static_cache is not None:
+                static_cache["entry"] = st
+        self.P = st["P"]
+        self.L = st["L"]
+        self.smt = st["smt"]
+        self.phys = st["phys"]
+        self.core_socket = st["core_socket"]
+        self.gpu_numa = st["gpu_numa"]
+        self.gpu_sw = st["gpu_sw"]
+        self.gpu_devid = st["gpu_devid"]
+        self.n_gpus = st["n_gpus"]
+        self.nic_flat = st["nic_flat"]
+        self.nic_cap = st["nic_cap"]
+        self.nic_sw = st["nic_sw"]
+        self.gpu_sw_dense = st["gpu_sw_dense"]
+        self._nic_idx = st["nic_idx"]
+        GM = self.gpu_numa.shape[1]
+        L = self.L
+
+        # --- dynamic allocation state (fresh per build) ---
         self.core_used = np.ones((N, L), bool)       # pad: used
-        self.core_socket = np.full((N, L), -1, np.int8)
         self.gpu_used = np.ones((N, GM), bool)
-        self.gpu_numa = np.full((N, GM), -1, np.int8)
-        self.gpu_sw = np.full((N, GM), -1, np.int64)
-        self.gpu_devid = np.full((N, GM), -1, np.int32)
-        self.n_gpus = np.zeros(N, np.int32)
-        self.nic_flat = np.full((N, U, K), -1, np.int32)
-        self.nic_cap = np.zeros((N, U, K), np.float64)   # schedulable Gbps
         self.nic_rx_used = np.zeros((N, U, K), np.float64)
         self.nic_tx_used = np.zeros((N, U, K), np.float64)
         self.nic_pods = np.zeros((N, U, K), np.int32)
-        self.nic_sw = np.full((N, U, K), -1, np.int64)
-        self.gpu_sw_dense = np.full((N, GM), -1, np.int32)  # encode_cluster ids
         self.hp_free = np.zeros(N, np.int64)
-
-        from nhd_tpu.core.node import NIC_BW_AVAIL_PERCENT
-
         for i, node in enumerate(self.node_objs):
-            self.smt[i] = node.smt_enabled
-            self.phys[i] = node.cores_per_proc * node.sockets
-            for c in node.cores:
-                self.core_used[i, c.core] = c.used
-                self.core_socket[i, c.core] = c.socket
-            self.n_gpus[i] = len(node.gpus)
-            switches = sorted(
-                {g.pciesw for g in node.gpus} | {x.pciesw for x in node.nics}
-            )
-            sw_dense = {sw: j for j, sw in enumerate(switches)}
-            for j, g in enumerate(node.gpus):
-                self.gpu_used[i, j] = g.used
-                self.gpu_numa[i, j] = g.numa_node
-                self.gpu_sw[i, j] = g.pciesw
-                self.gpu_sw_dense[i, j] = sw_dense[g.pciesw]
-                self.gpu_devid[i, j] = g.device_id
-            for nic_i, nic in enumerate(node.nics):
-                u, k = nic.numa_node, nic.idx
-                if u >= U or k >= K:
-                    continue
-                self.nic_flat[i, u, k] = nic_i
-                self.nic_cap[i, u, k] = nic.speed_gbps * NIC_BW_AVAIL_PERCENT
-                self.nic_rx_used[i, u, k] = nic.speed_used[0]
-                self.nic_tx_used[i, u, k] = nic.speed_used[1]
-                self.nic_pods[i, u, k] = nic.pods_used
-                self.nic_sw[i, u, k] = nic.pciesw
+            if node._core_used is not None:
+                self.core_used[i, : len(node.cores)] = node._core_used
+            else:
+                # non-identity core layout (hand-assembled node)
+                for c in node.cores:
+                    self.core_used[i, c.core] = c.used
+            m = len(node.gpus)
+            if m:
+                self.gpu_used[i, :m] = node._gpu_used
+            uu, kk, valid = self._nic_idx[i]
+            if uu is not None:
+                self.nic_rx_used[i, uu, kk] = node._nic_bw[valid, 0]
+                self.nic_tx_used[i, uu, kk] = node._nic_bw[valid, 1]
+                self.nic_pods[i, uu, kk] = node._nic_pods[valid]
             self.hp_free[i] = node.mem.free_hugepages_gb
 
-        self._orig_core_used = self.core_used.copy()
-        self._orig_gpu_used = self.gpu_used.copy()
         self._touched: set = set()
 
         # native assignment core (ctypes; None → pure-numpy path)
@@ -148,6 +155,66 @@ class FastCluster:
                     ("gpu_sw", self.gpu_sw),
                 )
             }
+
+    def _build_static(self, key) -> dict:
+        """One pass over the node objects extracting everything assignment
+        never mutates; the result is shareable between FastCluster builds."""
+        N = len(self.node_objs)
+        U, K = self.U, self.K
+        P = max((n.cores_per_proc * n.sockets for n in self.node_objs), default=1)
+        L = max((len(n.cores) for n in self.node_objs), default=1)
+        GM = max((len(n.gpus) for n in self.node_objs), default=1) or 1
+
+        smt = np.zeros(N, bool)
+        phys = np.zeros(N, np.int32)
+        core_socket = np.full((N, L), -1, np.int8)
+        gpu_numa = np.full((N, GM), -1, np.int8)
+        gpu_sw = np.full((N, GM), -1, np.int64)
+        gpu_devid = np.full((N, GM), -1, np.int32)
+        n_gpus = np.zeros(N, np.int32)
+        nic_flat = np.full((N, U, K), -1, np.int32)
+        nic_cap = np.zeros((N, U, K), np.float64)   # schedulable Gbps
+        nic_sw = np.full((N, U, K), -1, np.int64)
+        gpu_sw_dense = np.full((N, GM), -1, np.int32)  # encode_cluster ids
+        nic_idx: List[Tuple] = []
+
+        for i, node in enumerate(self.node_objs):
+            smt[i] = node.smt_enabled
+            phys[i] = node.cores_per_proc * node.sockets
+            if node._core_socket is not None:
+                core_socket[i, : len(node.cores)] = node._core_socket
+            else:
+                for c in node.cores:
+                    core_socket[i, c.core] = c.socket
+            m = len(node.gpus)
+            n_gpus[i] = m
+            if m:
+                gpu_numa[i, :m] = node._gpu_numa
+                gpu_sw[i, :m] = node._gpu_sw
+                gpu_devid[i, :m] = node._gpu_devid
+                # dense switch ids precomputed by _pack_state (the single
+                # definition of the sorted-switches mapping)
+                gpu_sw_dense[i, :m] = node._gpu_sw_dense
+            nb = len(node.nics)
+            if nb:
+                u, k = node._nic_u, node._nic_k
+                valid = (u < U) & (k < K)
+                uu, kk = u[valid], k[valid]
+                nic_flat[i, uu, kk] = np.arange(nb, dtype=np.int32)[valid]
+                nic_cap[i, uu, kk] = node._nic_cap[valid]
+                nic_sw[i, uu, kk] = node._nic_sw[valid]
+                nic_idx.append((uu, kk, valid))
+            else:
+                nic_idx.append((None, None, None))
+
+        return {
+            "key": key, "node_objs": self.node_objs, "P": P, "L": L,
+            "smt": smt, "phys": phys, "core_socket": core_socket,
+            "gpu_numa": gpu_numa, "gpu_sw": gpu_sw, "gpu_devid": gpu_devid,
+            "n_gpus": n_gpus, "nic_flat": nic_flat, "nic_cap": nic_cap,
+            "nic_sw": nic_sw, "gpu_sw_dense": gpu_sw_dense,
+            "nic_idx": nic_idx,
+        }
 
     def _row_addr(self, name: str, n: int) -> int:
         base, stride = self._addr[name]
@@ -712,24 +779,25 @@ class FastCluster:
     # ------------------------------------------------------------------
 
     def sync_to_nodes(self) -> None:
-        """Write allocation changes back to the HostNode mirror."""
+        """Write allocation changes back to the HostNode mirror — one
+        vector write per packed array per touched node (the component
+        objects are views over these arrays, core/node.py _pack_state)."""
         for n in self._touched:
             node = self.node_objs[n]
-            changed = np.flatnonzero(self.core_used[n] != self._orig_core_used[n])
-            for c in changed:
-                node.cores[int(c)].used = bool(self.core_used[n, c])
-            for j in np.flatnonzero(self.gpu_used[n] != self._orig_gpu_used[n]):
-                node.gpus[int(j)].used = bool(self.gpu_used[n, j])
-            for nic in node.nics:
-                u, k = nic.numa_node, nic.idx
-                if u >= self.U or k >= self.K:
-                    continue
-                nic.speed_used[0] = float(self.nic_rx_used[n, u, k])
-                nic.speed_used[1] = float(self.nic_tx_used[n, u, k])
-                nic.pods_used = int(self.nic_pods[n, u, k])
+            if node._core_used is not None:
+                node._core_used[:] = self.core_used[n, : len(node.cores)]
+            else:
+                for c in node.cores:
+                    c.used = bool(self.core_used[n, c.core])
+            m = len(node.gpus)
+            if m:
+                node._gpu_used[:] = self.gpu_used[n, :m]
+            uu, kk, valid = self._nic_idx[n]
+            if uu is not None:
+                node._nic_bw[valid, 0] = self.nic_rx_used[n, uu, kk]
+                node._nic_bw[valid, 1] = self.nic_tx_used[n, uu, kk]
+                node._nic_pods[valid] = self.nic_pods[n, uu, kk]
             node.mem.free_hugepages_gb = int(self.hp_free[n])
-        self._orig_core_used = self.core_used.copy()
-        self._orig_gpu_used = self.gpu_used.copy()
         self._touched.clear()
 
 
