@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from itertools import chain
+from itertools import chain, count
 from typing import Dict, List, Optional, Tuple
 
 from nhd_tpu.core.topology import (
@@ -60,21 +60,24 @@ def parse_range_list(text: str) -> List[int]:
     return sorted(set(chain.from_iterable(one(p) for p in text.split(","))))
 
 
+_PACK_GEN_COUNTER = count(1)
+
+
 def pack_generation_key(node_objs, *extra) -> tuple:
     """Cache key identifying a node list's packed-topology generation.
 
-    _pack_state rebuilds a node's packed arrays on every label reparse,
-    so the arrays' id()s are the generation tokens. Single definition —
-    every id-keyed static cache over a node set (EncodeStatic,
-    FastCluster._build_static) must use this, so a future _pack_state
-    change invalidates them all in lockstep. Callers must PIN node_objs
-    in the cache entry (CPython reuses id()s of dead objects)."""
+    _pack_state stamps a process-monotonic generation number on the node
+    at every rebuild (label reparse), so (node identity, generation)
+    pairs are the tokens — array id()s alone are unsafe because numpy
+    can reallocate a new generation's arrays at a freed generation's
+    addresses. Single definition — every static cache over a node set
+    (EncodeStatic, FastCluster._build_static) must use this, so a future
+    _pack_state change invalidates them all in lockstep. Callers must
+    PIN node_objs in the cache entry (CPython reuses id()s of dead
+    objects)."""
     return (
         *extra,
-        tuple(id(n) for n in node_objs),
-        tuple(id(n._core_socket) for n in node_objs),
-        tuple(id(n._gpu_sw) for n in node_objs),
-        tuple(id(n._nic_u) for n in node_objs),
+        tuple((id(n), n._pack_gen) for n in node_objs),
     )
 
 
@@ -288,6 +291,9 @@ class HostNode:
         self._nic_sw_dense = None  # [n_nics] int64 dense switch ids
         self._nic_cnt = None     # [max_numa+1] int32 NICs per NUMA
 
+    # packed-topology generation (see pack_generation_key); 0 = never packed
+    _pack_gen = 0
+
     def _pack_state(self) -> None:
         """Move the dynamic allocation flags into packed per-node arrays
         (the component objects become views; see NodeCpuCore). Re-run on
@@ -299,6 +305,10 @@ class HostNode:
         node with a different layout keeps per-object flags and the loop
         fallbacks."""
         import numpy as np
+
+        # new generation: any static cache keyed on the previous packing
+        # must miss, even if numpy reuses freed arrays' addresses
+        self._pack_gen = next(_PACK_GEN_COUNTER)
 
         phys = self.cores_per_proc * self.sockets
         identity = all(c.core == i for i, c in enumerate(self.cores)) and (

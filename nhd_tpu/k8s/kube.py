@@ -39,11 +39,15 @@ class KubeClusterBackend(ClusterBackend):
         try:
             import kubernetes  # noqa: F401
             from kubernetes import client, config, watch
-        except ImportError as exc:  # pragma: no cover - env without k8s
-            raise RuntimeError(
-                "KubeClusterBackend requires the 'kubernetes' package; use "
-                "FakeClusterBackend for hermetic runs"
-            ) from exc
+        except ImportError:
+            # no kubernetes package: fall back to the in-repo REST client
+            # (nhd_tpu/k8s/restclient.py — same surface over real HTTP, the
+            # way config/libconfig.py replaces libconf)
+            from nhd_tpu.k8s import restclient
+
+            client = restclient.client
+            config = restclient.config
+            watch = restclient.watch
 
         self.logger = get_logger(__name__)
         self._client = client
@@ -52,10 +56,23 @@ class KubeClusterBackend(ClusterBackend):
             config.load_incluster_config()
         except Exception:
             # outside a pod: fall back to kubeconfig (K8SMgr.py:43-46)
-            config.load_kube_config()
+            try:
+                config.load_kube_config()
+            except Exception as exc:
+                raise RuntimeError(
+                    "no cluster configuration found (neither in-cluster "
+                    "env nor a kubeconfig); KubeClusterBackend needs an "
+                    "API server to talk to — use FakeClusterBackend for "
+                    f"hermetic runs ({exc})"
+                ) from exc
         self.v1 = client.CoreV1Api()
         self.crd = client.CustomObjectsApi()
         self._events: "queue.Queue[WatchEvent]" = queue.Queue()
+        # pause between watch reconnects (the API server ends streams
+        # routinely; an immediate retry loop would hammer it)
+        self._watch_backoff = 1.0
+        self._watch_stop = threading.Event()
+        self._watchers: List[object] = []  # live Watch objects, for stop
         if start_watches:
             self._start_watches()
 
@@ -264,9 +281,10 @@ class KubeClusterBackend(ClusterBackend):
         threading.Thread(target=self._watch_pods, daemon=True).start()
         threading.Thread(target=self._watch_nodes, daemon=True).start()
 
-    def _watch_pods(self) -> None:  # pragma: no cover - live cluster only
+    def _watch_pods(self) -> None:
         w = self._watch_mod.Watch()
-        while True:
+        self._watchers.append(w)
+        while not self._watch_stop.is_set():
             try:
                 for ev in w.stream(self.v1.list_pod_for_all_namespaces):
                     obj = ev["object"]
@@ -287,11 +305,15 @@ class KubeClusterBackend(ClusterBackend):
                     )
             except Exception as exc:
                 self.logger.error(f"pod watch restarted: {exc}")
+            # the server ends watch streams routinely; reconnect after a
+            # pause rather than spinning
+            self._watch_stop.wait(self._watch_backoff)
 
-    def _watch_nodes(self) -> None:  # pragma: no cover - live cluster only
+    def _watch_nodes(self) -> None:
         last: Dict[str, tuple] = {}
         w = self._watch_mod.Watch()
-        while True:
+        self._watchers.append(w)
+        while not self._watch_stop.is_set():
             try:
                 for ev in w.stream(self.v1.list_node):
                     obj = ev["object"]
@@ -313,6 +335,19 @@ class KubeClusterBackend(ClusterBackend):
                     last[name] = (labels, unsched, taints)
             except Exception as exc:
                 self.logger.error(f"node watch restarted: {exc}")
+            self._watch_stop.wait(self._watch_backoff)
+
+    def stop_watches(self) -> None:
+        """Stop watch threads: interrupt in-flight streams (Watch.stop
+        closes the response to unblock the read) and prevent reconnects."""
+        self._watch_stop.set()
+        for w in self._watchers:
+            stop = getattr(w, "stop", None)
+            if stop is not None:
+                try:
+                    stop()
+                except Exception:
+                    pass
 
     def poll_watch_events(self, timeout: float = 0.0) -> Iterable[WatchEvent]:
         out = []

@@ -1,0 +1,368 @@
+"""KubeClusterBackend over REAL HTTP against a stub API server (VERDICT
+r2 item 4): every request the backend makes is serialized onto a socket,
+parsed by an in-process API server (k8s/apistub.py), and asserted at the
+payload level — binding bodies byte-for-byte, strategic-merge patch
+content types, event shapes, watch reconnects, and the V1Binding
+client-quirk path the reference codes around (K8SMgr.py:468-492).
+
+The mocked-module tests (test_kube.py) cover the client-object surface;
+this file covers the wire."""
+
+import json
+import sys
+import time
+
+import pytest
+
+from nhd_tpu.k8s.apistub import StubApiServer, make_pod
+from nhd_tpu.k8s.interface import (
+    CFG_ANNOTATION,
+    EventType,
+    GROUPS_ANNOTATION,
+    NAD_ANNOTATION,
+)
+
+
+class _BlockKubernetesImport:
+    """meta_path finder that makes `import kubernetes` fail even when the
+    real package is installed — these tests must exercise the restclient
+    fallback, not whatever client happens to be available."""
+
+    def find_spec(self, name, path=None, target=None):
+        if name == "kubernetes" or name.startswith("kubernetes."):
+            raise ImportError("kubernetes blocked: restclient contract test")
+        return None
+
+
+@pytest.fixture()
+def stub(monkeypatch):
+    """Stub API server + env pointing the restclient fallback at it."""
+    # the mocked-module suite (test_kube.py) leaves a fake `kubernetes`
+    # in sys.modules; remove it AND block fresh imports so kube.py takes
+    # the restclient fallback regardless of the environment
+    monkeypatch.delitem(sys.modules, "kubernetes", raising=False)
+    blocker = _BlockKubernetesImport()
+    sys.meta_path.insert(0, blocker)
+    srv = StubApiServer().start()
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "127.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", str(srv.port))
+    monkeypatch.setenv("KUBERNETES_SERVICE_SCHEME", "http")
+    monkeypatch.setenv("NHD_K8S_TOKEN_FILE", "/nonexistent-token")
+    try:
+        yield srv
+    finally:
+        sys.meta_path.remove(blocker)
+        srv.stop()
+
+
+def _backend(**kw):
+    from nhd_tpu.k8s.kube import KubeClusterBackend
+
+    return KubeClusterBackend(start_watches=False, **kw)
+
+
+# ---------------------------------------------------------------------------
+# node reads
+# ---------------------------------------------------------------------------
+
+
+def test_node_reads_over_http(stub):
+    stub.add_node("n1", internal_ip="10.1.2.3")
+    stub.add_node("n2", ready=False)
+    stub.add_node("n3", taint=False)
+    stub.add_node("n4", unschedulable=True)
+    b = _backend()
+    assert b.get_nodes() == ["n1", "n3", "n4"]  # KubeletReady filter
+    assert b.is_node_active("n1") is True
+    assert b.is_node_active("n3") is False      # no scheduler taint
+    assert b.is_node_active("n4") is False      # cordoned
+    assert b.get_node_addr("n1") == "10.1.2.3"
+    assert b.get_node_hugepage_resources("n1") == (64, 60)
+    stub.add_node("n5", labels={"a": "1"})
+    assert b.get_node_labels("n5") == {"a": "1"}
+    # the reads actually went over the wire
+    paths = [p for (m, p, _, _) in stub.requests if m == "GET"]
+    assert "/api/v1/nodes" in paths and "/api/v1/nodes/n1" in paths
+
+
+# ---------------------------------------------------------------------------
+# pod reads
+# ---------------------------------------------------------------------------
+
+
+def test_pod_reads_and_filters(stub):
+    stub.add_pod("p1", annotations={GROUPS_ANNOTATION: "grpA.grpB"},
+                 requests={"cpu": "4", "hugepages-1Gi": "8Gi"})
+    stub.add_pod("p2", scheduler="default-scheduler")
+    stub.add_pod("p3", node="n1", phase="Running", uid="uid-3")
+    b = _backend()
+    assert b.pod_exists("p1", "default") is True
+    assert b.pod_exists("nope", "default") is False
+    assert b.get_pod_node("p3", "default") == "n1"
+    assert b.get_pod_node_groups("p1", "default") == ["grpA", "grpB"]
+    assert b.get_pod_node_groups("p3", "default") == ["default"]
+    assert b.get_requested_pod_resources("p1", "default") == {
+        "cpu": "4", "hugepages-1Gi": "8Gi"
+    }
+    # scheduler-name filtering happens on real list responses
+    assert b.get_scheduled_pods("nhd-scheduler") == [
+        ("p3", "default", "uid-3", "Running")
+    ]
+    sp = b.service_pods("nhd-scheduler")
+    assert set(sp) == {("default", "p1", "uid-1"), ("default", "p3", "uid-3")}
+    assert sp[("default", "p3", "uid-3")] == ("Running", "n1")
+
+
+def test_cfg_map_resolution_over_http(stub):
+    stub.add_pod("p1", configmap="cm1")
+    stub.add_configmap("cm1", "default", {"triad.cfg": "cfg-text"})
+    stub.add_pod("p2", configmap="missing-cm")
+    b = _backend()
+    assert b.get_cfg_map("p1", "default") == ("cm1", "cfg-text")
+    # missing ConfigMap: 404 travels back as ApiException, pod fails soft
+    assert b.get_cfg_map("p2", "default") == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# writes: annotations (strategic-merge PATCH)
+# ---------------------------------------------------------------------------
+
+
+def test_annotation_patch_wire_format(stub):
+    stub.add_pod("p1")
+    b = _backend()
+    assert b.annotate_pod_config("default", "p1", "solved-cfg") is True
+    method, path, ctype, body = next(
+        r for r in stub.requests if r[0] == "PATCH"
+    )
+    assert path == "/api/v1/namespaces/default/pods/p1"
+    assert ctype == "application/strategic-merge-patch+json"
+    # byte-level: exactly the strategic-merge shape, nothing else
+    assert body == json.dumps(
+        {"metadata": {"annotations": {CFG_ANNOTATION: "solved-cfg"}}}
+    ).encode()
+    # round-trip through the server's merge
+    assert b.get_cfg_annotations("p1", "default") == "solved-cfg"
+
+
+def test_nad_and_gpu_map_round_trip(stub):
+    stub.add_pod("p1")
+    b = _backend()
+    assert b.add_nad_to_pod("p1", "default", "sriov-a@net1") is True
+    assert b.annotate_pod_gpu_map("default", "p1", {"nvidia0": 1}) is True
+    annots = b.get_pod_annotations("p1", "default")
+    assert annots[NAD_ANNOTATION] == "sriov-a@net1"
+    assert annots["sigproc.viasat.io/nhd_gpu_devices.nvidia0"] == "1"
+
+
+def test_patch_failure_returns_false(stub):
+    stub.add_pod("p1")
+    stub.fail_patches = True
+    b = _backend()
+    assert b.annotate_pod_config("default", "p1", "cfg") is False
+
+
+# ---------------------------------------------------------------------------
+# writes: binding (the schedule commit point)
+# ---------------------------------------------------------------------------
+
+
+def test_bind_payload_and_client_quirk(stub):
+    stub.add_pod("p1")
+    b = _backend()
+    # the stub answers with a Status object (what real API servers do),
+    # which makes the client raise ValueError — the quirk path must still
+    # report success (reference: K8SMgr.py:487-491)
+    assert b.bind_pod_to_node("p1", "n1", "default") is True
+    method, path, ctype, body = next(
+        r for r in stub.requests if r[0] == "POST"
+    )
+    assert path == "/api/v1/namespaces/default/pods/p1/binding"
+    assert ctype == "application/json"
+    assert body == json.dumps(
+        {
+            "metadata": {"name": "p1"},
+            "target": {
+                "apiVersion": "v1", "kind": "Node",
+                "name": "n1", "namespace": "default",
+            },
+        }
+    ).encode()
+    # the server really applied it
+    assert stub.pods[("default", "p1")]["spec"]["nodeName"] == "n1"
+    assert b.get_pod_node("p1", "default") == "n1"
+
+
+def test_bind_conflict_returns_false(stub):
+    stub.add_pod("p1")
+    stub.fail_bindings = True
+    b = _backend()
+    assert b.bind_pod_to_node("p1", "n1", "default") is False
+    assert stub.pods[("default", "p1")]["spec"]["nodeName"] is None
+
+
+# ---------------------------------------------------------------------------
+# events
+# ---------------------------------------------------------------------------
+
+
+def test_event_wire_shape(stub):
+    stub.add_pod("p1", uid="uid-ev")
+    b = _backend()
+    b.generate_pod_event(
+        "p1", "default", "StartedScheduling", EventType.NORMAL, "scheduling p1"
+    )
+    assert len(stub.events) == 1
+    ev = stub.events[0]
+    assert ev["message"] == "NHD: scheduling p1"          # NHD: prefix
+    assert ev["reason"] == "StartedScheduling"
+    assert ev["type"] == "Normal"
+    assert ev["count"] == 1
+    assert ev["involvedObject"] == {
+        "apiVersion": "v1", "kind": "Pod", "name": "p1",
+        "namespace": "default", "uid": "uid-ev",
+    }
+    assert ev["source"] == {"component": "nhd-scheduler"}
+    assert ev["metadata"] == {"generateName": "p1.nhd."}
+    # RFC3339 timestamps
+    assert ev["firstTimestamp"].endswith("Z") or "+" in ev["firstTimestamp"]
+    # missing pod: no event, no crash
+    b.generate_pod_event("ghost", "default", "X", EventType.WARNING, "m")
+    assert len(stub.events) == 1
+
+
+# ---------------------------------------------------------------------------
+# TriadSets (CRD)
+# ---------------------------------------------------------------------------
+
+
+def test_triadset_crd_over_http(stub):
+    template = {
+        "metadata": {"annotations": {"sigproc.viasat.io/cfg_type": "triad"}},
+        "spec": {"schedulerName": "nhd-scheduler", "containers": []},
+    }
+    stub.add_triadset("ts1", "default", replicas=3, service_name="svc",
+                      template=template)
+    stub.add_pod("svc-0")
+    stub.add_pod("svc-x")  # non-ordinal: not a member
+    b = _backend()
+    sets = b.list_triadsets()
+    assert sets == [{
+        "name": "ts1", "ns": "default", "replicas": 3,
+        "service_name": "svc", "template": template,
+    }]
+    assert b.list_pods_of_triadset(sets[0]) == ["svc-0"]
+    assert b.create_pod_for_triadset(sets[0], 1) is True
+    created = stub.pods[("default", "svc-1")]
+    assert created["spec"]["hostname"] == "svc-1"
+    assert created["spec"]["subdomain"] == "svc"
+    assert created["metadata"]["annotations"] == template["metadata"][
+        "annotations"
+    ]
+    # scale-subresource status patch
+    assert b.update_triadset_status(sets[0], 2) is True
+    method, path, ctype, body = [r for r in stub.requests if r[0] == "PATCH"][-1]
+    assert path == (
+        "/apis/sigproc.viasat.io/v1/namespaces/default/triadsets/ts1/status"
+    )
+    assert ctype == "application/merge-patch+json"
+    assert body == b'{"status": {"replicas": 2}}'
+    assert stub.triadsets[("default", "ts1")]["status"] == {"replicas": 2}
+
+
+# ---------------------------------------------------------------------------
+# watch plane: real streams, real reconnects
+# ---------------------------------------------------------------------------
+
+
+def test_watch_stream_and_reconnect(stub):
+    stub.queue_watch_event(
+        "/api/v1/pods", "ADDED",
+        make_pod("w1", annotations={"k": "v"}, uid="uid-w1"),
+    )
+    b = _backend()
+    b._watch_backoff = 0.05
+    b._start_watches()
+    try:
+        deadline = time.time() + 5
+        events = []
+        while time.time() < deadline and not events:
+            events = [
+                e for e in b.poll_watch_events(timeout=0.1)
+                if e.kind == "pod_create"
+            ]
+        assert events, "pod watch event never arrived"
+        ev = events[0]
+        assert ev.name == "w1" and ev.namespace == "default"
+        assert ev.uid == "uid-w1"
+        assert ev.annotations == {"k": "v"}
+        assert ev.scheduler_name == "nhd-scheduler"
+
+        # second batch arrives only via a NEW connection — proves the
+        # reconnect loop survives server-side stream termination
+        first_connects = stub.watch_connects.get("/api/v1/pods", 0)
+        stub.queue_watch_event(
+            "/api/v1/pods", "DELETED", make_pod("w2", uid="uid-w2")
+        )
+        deadline = time.time() + 5
+        events = []
+        while time.time() < deadline and not events:
+            events = [
+                e for e in b.poll_watch_events(timeout=0.1)
+                if e.kind == "pod_delete"
+            ]
+        assert events and events[0].name == "w2"
+        assert stub.watch_connects["/api/v1/pods"] > first_connects
+    finally:
+        b.stop_watches()
+
+
+def test_node_watch_translation(stub):
+    from nhd_tpu.k8s.apistub import make_node
+
+    stub.queue_watch_event(
+        "/api/v1/nodes", "MODIFIED",
+        make_node("n1", unschedulable=True, labels={"NHD_GROUP": "a"}),
+    )
+    b = _backend()
+    b._watch_backoff = 0.05
+    b._start_watches()
+    try:
+        deadline = time.time() + 5
+        events = []
+        while time.time() < deadline and not events:
+            events = [
+                e for e in b.poll_watch_events(timeout=0.1)
+                if e.kind == "node_update"
+            ]
+        assert events
+        ev = events[0]
+        assert ev.name == "n1"
+        assert ev.unschedulable is True
+        assert ev.labels == {"NHD_GROUP": "a"}
+        assert "sigproc.viasat.io/nhd_scheduler" in ev.taints
+    finally:
+        b.stop_watches()
+
+
+# ---------------------------------------------------------------------------
+# auth
+# ---------------------------------------------------------------------------
+
+
+def test_bearer_token_sent(stub, monkeypatch, tmp_path):
+    token_file = tmp_path / "token"
+    token_file.write_text("sekrit-token\n")
+    monkeypatch.setenv("NHD_K8S_TOKEN_FILE", str(token_file))
+    stub.token = "sekrit-token"
+    stub.add_node("n1")
+    b = _backend()
+    assert b.get_nodes() == ["n1"]  # 401 would raise / return []
+
+    # and without the right token the server rejects us
+    from nhd_tpu.k8s.restclient import ApiException, CoreV1Api, _set_config, Configuration
+
+    _set_config(Configuration(f"http://127.0.0.1:{stub.port}", token="wrong"))
+    with pytest.raises(ApiException) as ei:
+        CoreV1Api().read_node("n1")
+    assert ei.value.status == 401

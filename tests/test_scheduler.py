@@ -440,19 +440,24 @@ def test_group_label_removal_resets_to_default():
     assert sched.nodes["node0"].groups == ["default"]
 
 
-def test_kube_backend_gated_import():
-    """The real-cluster backend module imports without the kubernetes
-    package; constructing it raises a clear error naming the fix."""
+def test_kube_backend_config_gate(monkeypatch):
+    """The real-cluster backend imports without the kubernetes package
+    (it falls back to the in-repo restclient), but constructing it with
+    no cluster to talk to raises a clear error naming the fix."""
     import pytest
 
     from nhd_tpu.k8s import kube
 
     try:
         import kubernetes  # noqa: F401
-        pytest.skip("kubernetes installed; gate not exercised")
+        pytest.skip("kubernetes installed; restclient gate not exercised")
     except ImportError:
         pass
-    with pytest.raises(RuntimeError, match="requires the 'kubernetes'"):
+    # neither in-cluster env nor a kubeconfig
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    monkeypatch.delenv("KUBERNETES_SERVICE_PORT", raising=False)
+    monkeypatch.setenv("KUBECONFIG", "/nonexistent-kubeconfig")
+    with pytest.raises(RuntimeError, match="no cluster configuration"):
         kube.KubeClusterBackend()
 
 
