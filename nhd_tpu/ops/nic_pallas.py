@@ -44,7 +44,7 @@ def _kernel(U, K, C, A,
         )
         fit = fit & (unchosen_ref[:, uk][None, :] | ok)
 
-    is_pci = map_pci_ref[0] != 0
+    is_pci = map_pci_ref[0, 0] != 0
     fit = fit & valid_ref[:, :] & (pci_ok_ref[:, :] | ~is_pci)
 
     fit3 = fit.reshape(BN, C, A)
@@ -74,6 +74,10 @@ def nic_any_first(
     assert N % BN == 0, f"node axis must be padded to {BN}"
     grid = (T, N // BN)
 
+    # TPU lowering requires rank-1 blocks to span the whole array; carry
+    # the per-type scalar as [T, 1] so its block is (1, 1) == full extent
+    map_pci = map_pci.reshape(T, 1)
+
     kernel = functools.partial(_kernel, U, K, C, A)
     return pl.pallas_call(
         kernel,
@@ -86,7 +90,7 @@ def nic_any_first(
             pl.BlockSpec((C * A, U * K), lambda t, nb: (0, 0)),  # unchosen
             pl.BlockSpec((BN, C * A), lambda t, nb: (nb, 0)),   # valid
             pl.BlockSpec((BN, C * A), lambda t, nb: (nb, 0)),   # pci_ok
-            pl.BlockSpec((1,), lambda t, nb: (t,)),             # map_pci
+            pl.BlockSpec((1, 1), lambda t, nb: (t, 0)),         # map_pci
         ],
         out_specs=[
             pl.BlockSpec((1, BN, C), lambda t, nb: (t, nb, 0)),

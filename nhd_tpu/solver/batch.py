@@ -53,7 +53,7 @@ from nhd_tpu.solver.fast_assign import (
     apply_record_to_topology,
 )
 from nhd_tpu.solver.jax_matcher import decode_mapping
-from nhd_tpu.solver.kernel import solve_bucket
+from nhd_tpu.solver.kernel import rank_budget, solve_bucket_ranked
 from nhd_tpu.utils import get_logger
 
 
@@ -80,8 +80,10 @@ class BatchAssignment:
 
 from collections import namedtuple
 
-SolveHost = namedtuple(
-    "SolveHost", "cand pref best_c best_m best_a n_combos n_picks"
+# host-side view of the on-device top-R ranking (kernel.RankOut): all
+# arrays are [T, R] — the [T, N] solve outputs never reach the host
+RankHost = namedtuple(
+    "RankHost", "val idx best_c best_m best_a n_picks free_gpu free_cpu free_hp"
 )
 
 
@@ -120,6 +122,40 @@ def _fc_executor():
             max_workers=1, thread_name_prefix="nhd-fastcluster"
         )
     return _FC_EXECUTOR
+
+
+def _filter_types(full, mask: np.ndarray):
+    """Restrict a PodTypeArrays to the types that still have pending pods.
+
+    Late rounds typically carry a handful of contended types; solving the
+    full type axis would cost as much as round 1 (the solve scales with
+    T×N, not pod count). Only worth it when the padded type bucket
+    actually shrinks — otherwise the same jit program is reused and
+    slicing would be pure overhead."""
+    from nhd_tpu.solver.kernel import _pad_pow2
+
+    ptypes = full.pod_type[mask]
+    pidx = full.pod_index[mask]
+    alive = np.unique(ptypes)
+    if _pad_pow2(len(alive)) >= _pad_pow2(full.n_types):
+        return replace(full, pod_type=ptypes, pod_index=pidx)
+    remap = np.full(full.n_types, -1, np.int32)
+    remap[alive] = np.arange(len(alive), dtype=np.int32)
+    return replace(
+        full,
+        requests=[full.requests[t] for t in alive],
+        pod_type=remap[ptypes],
+        pod_index=pidx,
+        cpu_dem_smt=full.cpu_dem_smt[alive],
+        cpu_dem_raw=full.cpu_dem_raw[alive],
+        gpu_dem=full.gpu_dem[alive],
+        rx=full.rx[alive],
+        tx=full.tx[alive],
+        hp=full.hp[alive],
+        needs_gpu=full.needs_gpu[alive],
+        map_pci=full.map_pci[alive],
+        group_mask=full.group_mask[alive],
+    )
 
 
 def _accelerator_backend() -> bool:
@@ -233,44 +269,45 @@ class BatchScheduler:
             return None
         return make_mesh(devices) if len(devices) > 1 else None
 
-    def _capacity_estimate(self, cluster, pods, out) -> np.ndarray:
-        """Optimistic copies-per-node estimate cap[T, N] for one round.
+    def _capacity_at(self, pods, rank: RankHost) -> np.ndarray:
+        """Optimistic copies-per-node estimate cap[T, R] over the ranked
+        candidates for one round.
 
-        Built from node-total aggregates (cheap, may overestimate — the
-        assignment re-verifies and stale claims retry; underestimates only
-        cost extra rounds): feasible NIC picks at the best combo, total free
-        GPUs / cores / hugepages over per-pod demand. GPU pods cap at 1 per
-        node whenever the busy back-off applies (reference: one placement
-        per node per window, Matcher.py:103-111).
+        Built from node-total aggregates gathered on device at the ranked
+        nodes (cheap, may overestimate — the assignment re-verifies and
+        stale claims retry; underestimates only cost extra rounds):
+        feasible NIC picks at the best combo, total free GPUs / cores /
+        hugepages over per-pod demand. GPU pods cap at 1 per node whenever
+        the busy back-off applies (reference: one placement per node per
+        window, Matcher.py:103-111).
         """
-        INF = np.int32(1 << 30)
-        cap = np.where(out.cand, np.maximum(out.n_picks, 1), 0).astype(np.int64)
+        INF = np.int64(1 << 30)
+        cand = rank.val > 0
+        cap = np.where(cand, np.maximum(rank.n_picks, 1), 0).astype(np.int64)
 
         gpus_tot = pods.gpu_dem.sum(axis=1)
-        free_gpu = cluster.gpu_free.sum(axis=1)
         gpu_cap = np.where(
             gpus_tot[:, None] > 0,
-            free_gpu[None, :] // np.maximum(gpus_tot, 1)[:, None],
+            rank.free_gpu // np.maximum(gpus_tot, 1)[:, None],
             INF,
         )
         cpu_tot = np.minimum(
             pods.cpu_dem_smt.sum(axis=1), pods.cpu_dem_raw.sum(axis=1)
         )
-        free_cpu = cluster.cpu_free.sum(axis=1)
         cpu_cap = np.where(
             cpu_tot[:, None] > 0,
-            free_cpu[None, :] // np.maximum(cpu_tot, 1)[:, None],
+            rank.free_cpu // np.maximum(cpu_tot, 1)[:, None],
             INF,
         )
         hp_cap = np.where(
             pods.hp[:, None] > 0,
-            cluster.hp_free[None, :] // np.maximum(pods.hp, 1)[:, None],
+            rank.free_hp // np.maximum(pods.hp, 1)[:, None],
             INF,
         )
         cap = np.minimum(cap, np.minimum(gpu_cap, np.minimum(cpu_cap, hp_cap)))
         if self.respect_busy:
             cap = np.where(pods.needs_gpu[:, None], np.minimum(cap, 1), cap)
-        cap = np.where(out.cand, np.maximum(cap, 1), 0)
+        cap = np.where(cand, np.maximum(cap, 1), 0)
         return cap
 
     def _schedule_serial(
@@ -451,6 +488,9 @@ class BatchScheduler:
         busy_nodes: set = set()
         all_buckets = None
         is_pending = None
+        # top-R rank budget, fixed at round 1 (the largest round) so every
+        # round's ranker hits the same jit program
+        R = None
         # solves for round r+1, dispatched by round r's native-assign path
         # before it materializes results (round pipelining)
         prelaunched = None
@@ -472,6 +512,18 @@ class BatchScheduler:
                         cluster.interner,
                         indices=pending,
                     )
+                    # R >= the largest per-type pod count: every ranked
+                    # candidate carries capacity >= 1, so the top-R cut
+                    # can never force an extra round
+                    max_need = max(
+                        (
+                            int(np.bincount(b.pod_type).max())
+                            for b in all_buckets.values()
+                            if len(b.pod_type)
+                        ),
+                        default=1,
+                    )
+                    R = rank_budget(max_need, cluster.n_nodes)
                     is_pending = np.zeros(len(items), bool)
                 is_pending[:] = False
                 is_pending[pending] = True
@@ -486,15 +538,15 @@ class BatchScheduler:
                     fast_future = None
                 raise
 
-            # (pod index, node index, bucket G, type) chosen this round
-            claims: List[Tuple[int, int, int, int]] = []
+            # (pod index, node index, bucket G, type, rank position)
+            claims: List[Tuple[int, int, int, int, int]] = []
             bucket_out = {}
-            # pins the jax SolveOuts whose buffers SolveHost's zero-copy
+            # pins the jax RankOuts whose buffers RankHost's zero-copy
             # views alias, for the round's lifetime — correctness must not
             # hinge on any particular backend's buffer-export semantics
             keepalive: List[object] = []
 
-            # dispatch every bucket's solve before pulling any result:
+            # dispatch every bucket's solve+rank before pulling any result:
             # jax dispatch is async, so the buckets' XLA programs overlap
             # instead of serializing on the first np.asarray block
             def _dispatch_solves():
@@ -503,12 +555,11 @@ class BatchScheduler:
                     mask = is_pending[full.pod_index]
                     if not mask.any():
                         continue
-                    pods = replace(
-                        full,
-                        pod_type=full.pod_type[mask],
-                        pod_index=full.pod_index[mask],
+                    pods = _filter_types(full, mask)
+                    out = (
+                        dev.solve_ranked(pods, R) if dev
+                        else solve_bucket_ranked(cluster, pods, R)
                     )
-                    out = dev.solve(pods) if dev else solve_bucket(cluster, pods)
                     launched.append((G, pods, out))
                 return launched
 
@@ -539,11 +590,15 @@ class BatchScheduler:
             for G, pods, out in launched:
                 # pull results to host once — element reads off jax arrays
                 # cost ~0.2 ms each and the winner loop does three per pod.
-                # np.asarray is zero-copy on the CPU backend (copying cost
-                # ~1s per 100k pods); `keepalive` holds the owning arrays
-                # until the round's reads are done
+                # Rank outputs are [Tp, R] (padded type rows sliced off
+                # here); np.asarray is zero-copy on the CPU backend, and
+                # `keepalive` holds the owning arrays until the round's
+                # reads are done
                 keepalive.append(out)
-                bucket_out[G] = (pods, SolveHost(*map(np.asarray, out)))
+                T = pods.n_types
+                bucket_out[G] = (
+                    pods, RankHost(*(np.asarray(x)[:T] for x in out))
+                )
             stats.solve_seconds += time.perf_counter() - t0
 
             t0 = time.perf_counter()
@@ -554,15 +609,10 @@ class BatchScheduler:
             # break the documented serialization order
             node_claimed: Dict[int, int] = {}
             for G, (pods, out) in bucket_out.items():
-                cand = out.cand
-                pref = out.pref
-                N = cand.shape[1]
-                sel_val = np.where(
-                    cand, pref * (N + 1) + (N - np.arange(N))[None, :], 0
-                )
-                # rank-ordered candidate nodes per type (desc value)
-                order = np.argsort(-sel_val, axis=1, kind="stable")
-                n_cands = (sel_val > 0).sum(axis=1)
+                # candidates arrive pre-ranked from the device (desc sel
+                # value = pref then low-node-index, kernel._get_ranker);
+                # valid prefix length per type:
+                n_cands = (out.val > 0).sum(axis=1)
 
                 if not apply:
                     # dry-run: every pod reports its own snapshot match (the
@@ -571,7 +621,9 @@ class BatchScheduler:
                     for t, pod_i in zip(pods.pod_type, pods.pod_index):
                         t = int(t)
                         if n_cands[t] > 0:
-                            claims.append((int(pod_i), int(order[t, 0]), G, t))
+                            claims.append(
+                                (int(pod_i), int(out.idx[t, 0]), G, t, 0)
+                            )
                     continue
 
                 # capacity-aware packing (the reference's first-fit shape):
@@ -580,7 +632,7 @@ class BatchScheduler:
                 # ranked nodes by capacity (claims are re-verified against
                 # live state at assignment, so an overestimate just costs a
                 # retry). Pods of one type are in pod-index order already.
-                cap = self._capacity_estimate(cluster, pods, out)
+                cap = self._capacity_at(pods, out)
                 # one-bucket-per-node rule: nodes another bucket claimed
                 # this round are blocked — static within a bucket, so
                 # computed once as a vector mask
@@ -593,18 +645,20 @@ class BatchScheduler:
                 for t, pod_ids in by_type.items():
                     if n_cands[t] == 0:
                         continue
-                    ranked = order[t, : n_cands[t]]
-                    caps_r = cap[t, ranked]
+                    ranked = out.idx[t, : n_cands[t]]
+                    caps_r = cap[t, : n_cands[t]].copy()
                     if len(blocked):
                         caps_r[np.isin(ranked, blocked)] = 0
                     need = len(pod_ids)
                     caps_r = np.minimum(caps_r, need)
                     cut = int(np.searchsorted(np.cumsum(caps_r), need)) + 1
-                    assigned = np.repeat(ranked[:cut], caps_r[:cut])[:need]
-                    for pod_i, n in zip(pod_ids, assigned):
+                    reps = caps_r[:cut]  # cut may overrun: slices clamp
+                    assigned = np.repeat(ranked[: len(reps)], reps)[:need]
+                    ranks = np.repeat(np.arange(len(reps)), reps)[:need]
+                    for pod_i, n, j in zip(pod_ids, assigned, ranks):
                         n = int(n)
                         node_claimed.setdefault(n, G)
-                        claims.append((pod_i, n, G, t))
+                        claims.append((pod_i, n, G, t, int(j)))
             # assignment order = pod index order: per node this is a valid
             # sequential execution (claims re-verified as they apply); the
             # first claim a node actually processes ran against fresh
@@ -632,16 +686,17 @@ class BatchScheduler:
                 # one native call per bucket places every winner of the
                 # round (native/nhd_assign.cc::nhd_assign_round) and
                 # mutates the packed state + solver arrays
-                by_bucket: Dict[int, List[Tuple[int, int, int]]] = {}
-                for pod_i, n, G, t in claims:
-                    by_bucket.setdefault(G, []).append((pod_i, n, t))
+                by_bucket: Dict[int, List[Tuple[int, int, int, int]]] = {}
+                for pod_i, n, G, t, j in claims:
+                    by_bucket.setdefault(G, []).append((pod_i, n, t, j))
                 native_out = []
                 for G, winners in by_bucket.items():
                     pods, out = bucket_out[G]
                     w_node = np.asarray([w[1] for w in winners], np.int32)
                     w_type = np.asarray([w[2] for w in winners], np.int32)
-                    w_c = np.ascontiguousarray(out.best_c[w_type, w_node], np.int32)
-                    w_m = np.ascontiguousarray(out.best_m[w_type, w_node], np.int32)
+                    w_rank = np.asarray([w[3] for w in winners], np.int32)
+                    w_c = np.ascontiguousarray(out.best_c[w_type, w_rank], np.int32)
+                    w_m = np.ascontiguousarray(out.best_m[w_type, w_rank], np.int32)
                     buffers = fast.assign_round(
                         pods, w_node, w_type, w_c, w_m,
                         set_busy=self.respect_busy,
@@ -705,7 +760,7 @@ class BatchScheduler:
                             )
                             if bw > 0
                         ]
-                        for t in {w[2] for w in winners}
+                        for t in {w[2] for w in winners}  # w = (pod, n, t, j)
                     }
                     U_, K_ = cluster.U, cluster.K
                     names = cluster.names
@@ -715,10 +770,10 @@ class BatchScheduler:
                     if all_ok and not want_record:
                         # fast path: no failures → no first-on-node
                         # bookkeeping; bulk set/list updates
-                        busy_nodes.update(n for _, n, _ in winners)
-                        applied_on_node.update(n for _, n, _ in winners)
+                        busy_nodes.update(n for _, n, _, _ in winners)
+                        applied_on_node.update(n for _, n, _, _ in winners)
                         stats.scheduled += len(winners)
-                        for w, (pod_i, n, t) in enumerate(winners):
+                        for w, (pod_i, n, t, _j) in enumerate(winners):
                             item = items[pod_i]
                             mk = (w_c_l[w], w_m_l[w], picks_l[w])
                             mapping = memo.get(mk)
@@ -743,7 +798,7 @@ class BatchScheduler:
                                 round_no,
                             )
                         continue
-                    for w, (pod_i, n, t) in enumerate(winners):
+                    for w, (pod_i, n, t, _j) in enumerate(winners):
                         item = items[pod_i]
                         is_first = n not in applied_on_node
                         applied_on_node.add(n)
@@ -784,12 +839,12 @@ class BatchScheduler:
                 stats.round_end_seconds.append(time.perf_counter() - t_batch)
                 continue
 
-            for pod_i, n, G, t in claims:
+            for pod_i, n, G, t, j in claims:
                 pods, out = bucket_out[G]
                 mapping = decode_mapping(
                     G, cluster.U, cluster.K,
-                    int(out.best_c[t, n]), int(out.best_m[t, n]),
-                    int(out.best_a[t, n]),
+                    int(out.best_c[t, j]), int(out.best_m[t, j]),
+                    int(out.best_a[t, j]),
                 )
                 node = node_list[n]
                 item = items[pod_i]

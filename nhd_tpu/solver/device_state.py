@@ -30,7 +30,9 @@ import numpy as np
 
 from nhd_tpu.solver.encode import ClusterArrays
 from nhd_tpu.solver.kernel import (
+    RankOut,
     SolveOut,
+    _get_ranker,
     pallas_enabled,
     _pad_pow2,
     get_solver,
@@ -157,10 +159,10 @@ class DeviceClusterState:
         updated = scatter(mutable, jnp.asarray(idx), rows)
         self._dev.update(updated)
 
-    def solve(self, pods) -> SolveOut:
-        """solve_bucket against the resident arrays (same outputs)."""
-        T = pods.n_types
-        Tp = _pad_pow2(T)
+    def _solve_raw(self, pods) -> SolveOut:
+        """The padded solver call against the resident arrays
+        ([Tp, Np] outputs, still on device)."""
+        Tp = _pad_pow2(pods.n_types)
 
         def pad_t(a):
             return _pad_rows(a, Tp)
@@ -173,11 +175,37 @@ class DeviceClusterState:
             )
         else:
             solver = get_solver(pods.G, self.cluster.U, self.cluster.K)
-        out = solver(
+        return solver(
             *[self._dev[name] for name in _ARG_ORDER],
             pad_t(pods.cpu_dem_smt), pad_t(pods.cpu_dem_raw),
             pad_t(pods.gpu_dem), pad_t(pods.rx), pad_t(pods.tx),
             pad_t(pods.hp), pad_t(pods.needs_gpu), pad_t(pods.map_pci),
             pad_t(pods.group_mask),
         )
+
+    def solve(self, pods) -> SolveOut:
+        """solve_bucket against the resident arrays (same outputs)."""
+        out = self._solve_raw(pods)
+        T = pods.n_types
         return SolveOut(*(x[:T, : self.N] if x.ndim == 2 else x for x in out))
+
+    def solve_ranked(self, pods, R: int) -> RankOut:
+        """Solve + on-device top-R ranking: only [Tp, R] decision tensors
+        leave the device (the free-total gathers read the RESIDENT free
+        arrays, which update_rows keeps live between rounds). On a mesh
+        the rank outputs are pinned replicated — top_k over the sharded
+        node axis is the one collective this adds."""
+        out = self._solve_raw(pods)
+        R = min(R, self.Np)
+        if self._node_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            ranker = _get_ranker(R, NamedSharding(self.mesh, P()))
+        else:
+            ranker = _get_ranker(R)
+        return ranker(
+            out.cand, out.pref, out.best_c, out.best_m, out.best_a,
+            out.n_picks,
+            self._dev["gpu_free"], self._dev["cpu_free"],
+            self._dev["hp_free"],
+        )

@@ -185,10 +185,10 @@ def test_round_cap_does_not_certify_exhaustion(monkeypatch):
 
     from nhd_tpu.solver.batch import BatchScheduler
 
-    orig = BatchScheduler._capacity_estimate
+    orig = BatchScheduler._capacity_at
     monkeypatch.setattr(
-        BatchScheduler, "_capacity_estimate",
-        lambda self, cluster, pods, out: orig(self, cluster, pods, out) * 4,
+        BatchScheduler, "_capacity_at",
+        lambda self, pods, rank: orig(self, pods, rank) * 4,
     )
     nodes = make_cluster(2)   # one tile of two nodes
     reqs = [simple_request(gpus=1) for _ in range(16)]
@@ -242,3 +242,64 @@ def test_context_reuse_pays_once():
 
     with pytest.raises(ValueError):
         sched.schedule(make_cluster(2), items([simple_request()]), context=ctx)
+
+
+def test_routed_places_everything_capacity_matched():
+    """Routed placement: pods pre-partition across tiles by estimated
+    capacity and every pod still places on a capacity-matched cluster;
+    resource accounting equals a first-fit run's totals."""
+    reqs = [simple_request(gpus=i % 2) for i in range(32)]
+    nodes_r = make_cluster(8)
+    nodes_f = copy.deepcopy(nodes_r)
+    rr, sr = StreamingScheduler(
+        tile_nodes=2, chunk_pods=8, placement="routed", respect_busy=False
+    ).schedule(nodes_r, items(reqs), now=0.0)
+    rf, sf = StreamingScheduler(
+        tile_nodes=2, chunk_pods=8, respect_busy=False
+    ).schedule(nodes_f, items(reqs), now=0.0)
+    assert sr.scheduled == sf.scheduled == 32
+    assert all(r.node for r in rr)
+    # same aggregate consumption even though the tile each pod landed on
+    # may differ (routing is a placement policy, not a capacity change)
+    assert sorted(
+        (tuple(n.free_cpu_cores_per_numa()), n.free_gpu_count())
+        for n in nodes_r.values()
+    ) == sorted(
+        (tuple(n.free_cpu_cores_per_numa()), n.free_gpu_count())
+        for n in nodes_f.values()
+    )
+
+
+def test_routed_spill_wraps_to_earlier_tiles():
+    """A pod routed to a late tile whose capacity estimate was wrong must
+    wrap around and try EVERY tile, including earlier ones."""
+    nodes = make_cluster(4)
+    # consume the later tiles entirely so routed blocks land on full
+    # tiles and must wrap to tile 0
+    names = sorted(nodes)
+    prefill = [simple_request(gpus=1)] * 100
+    BatchScheduler(respect_busy=False).schedule(
+        {n: nodes[n] for n in names[1:]}, items(prefill), now=0.0
+    )
+    reqs = [simple_request(gpus=1) for _ in range(2)]
+    res, stats = StreamingScheduler(
+        tile_nodes=1, chunk_pods=1, placement="routed", respect_busy=False
+    ).schedule(nodes, items(reqs), now=0.0)
+    placed = [r.node for r in res if r.node]
+    assert placed and all(n == names[0] for n in placed)
+
+
+def test_routed_rejects_bad_placement():
+    with pytest.raises(ValueError, match="placement"):
+        StreamingScheduler(placement="best-fit")
+
+
+def test_empty_node_dict_reports_unschedulable():
+    """An empty region (a multihost rank can own zero nodes under the
+    ceil-division block layout) must degrade to all-unschedulable, not
+    crash the tile pipeline."""
+    res, stats = StreamingScheduler(tile_nodes=2, respect_busy=False).schedule(
+        {}, items([simple_request()]), now=0.0
+    )
+    assert [r.node for r in res] == [None]
+    assert stats.scheduled == 0
