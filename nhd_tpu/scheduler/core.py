@@ -43,10 +43,16 @@ STREAM_NODE_THRESH = int(os.environ.get("NHD_STREAM_NODES", "4096"))
 # thread (StreamingScheduler's own constructor check would fire there)
 STREAM_TILE_NODES = int(os.environ.get("NHD_STREAM_TILE_NODES", "2048"))
 STREAM_CHUNK_PODS = int(os.environ.get("NHD_STREAM_CHUNK_PODS", "16384"))
+STREAM_PLACEMENT = os.environ.get("NHD_STREAM_PLACEMENT", "first-fit")
 if STREAM_TILE_NODES < 1 or STREAM_CHUNK_PODS < 1:
     raise ValueError(
         "NHD_STREAM_TILE_NODES and NHD_STREAM_CHUNK_PODS must be >= 1, got "
         f"{STREAM_TILE_NODES} / {STREAM_CHUNK_PODS}"
+    )
+if STREAM_PLACEMENT not in ("first-fit", "routed"):
+    raise ValueError(
+        "NHD_STREAM_PLACEMENT must be 'first-fit' or 'routed', got "
+        f"{STREAM_PLACEMENT!r}"
     )
 
 # commit-path concurrency: 1 (default) = the reference's strictly serial
@@ -309,6 +315,7 @@ class Scheduler(threading.Thread):
                 self._stream = StreamingScheduler(
                     tile_nodes=STREAM_TILE_NODES,
                     chunk_pods=STREAM_CHUNK_PODS,
+                    placement=STREAM_PLACEMENT,
                     respect_busy=self.batch.respect_busy,
                 )
             solver = self._stream
