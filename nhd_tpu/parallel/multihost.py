@@ -65,15 +65,31 @@ def initialize(
     )
 
 
+def node_slice(n_nodes: int, process_id: int, process_count: int) -> slice:
+    """The contiguous node-index range a given process owns under a 1-D
+    nodes mesh (block layout, matching sharding.solve_bucket_sharded
+    padding). Exposed by rank so a survivor can compute a DEAD rank's
+    region for elastic takeover (tests/test_distributed.py failure leg)."""
+    per = -(-n_nodes // process_count)  # ceil division
+    start = per * process_id
+    return slice(start, min(start + per, n_nodes))
+
+
 def local_node_slice(n_nodes: int) -> slice:
-    """The contiguous node-index range this process's devices own under a
-    1-D nodes mesh (block layout, matching sharding.solve_bucket_sharded
-    padding)."""
+    """node_slice for THIS process."""
     import jax
 
-    per = -(-n_nodes // jax.process_count())  # ceil division
-    start = per * jax.process_index()
-    return slice(start, min(start + per, n_nodes))
+    return node_slice(n_nodes, jax.process_index(), jax.process_count())
+
+
+def region_nodes(nodes: dict, process_id: int, process_count: int) -> dict:
+    """The node shard rank *process_id* owns. Names are SORTED before
+    slicing: each host builds its dict from its own API listing whose
+    order is not guaranteed, and the partition must be identical on every
+    host (exact cover, no node owned twice)."""
+    names = sorted(nodes.keys())
+    s = node_slice(len(names), process_id, process_count)
+    return {n: nodes[n] for n in names[s]}
 
 
 def local_nodes(nodes: dict) -> dict:
@@ -81,10 +97,7 @@ def local_nodes(nodes: dict) -> dict:
     streaming pattern: each host runs a StreamingScheduler over its own
     region (`StreamingScheduler.schedule(local_nodes(all), ...)`), so
     tiles stream within a host while the per-tile solve shards over that
-    host's devices. Names are SORTED before slicing: each host builds its
-    dict from its own API listing whose order is not guaranteed, and the
-    partition must be identical on every host (exact cover, no node owned
-    twice)."""
-    names = sorted(nodes.keys())
-    s = local_node_slice(len(names))
-    return {n: nodes[n] for n in names[s]}
+    host's devices."""
+    import jax
+
+    return region_nodes(nodes, jax.process_index(), jax.process_count())
