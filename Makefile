@@ -1,8 +1,13 @@
-# Build/test entry points (reference: Makefile proto rule at :86-89).
+# Build/test entry points (reference: Makefile proto rule at :86-89;
+# release pipeline shape at :237-252).
 
 PROTO_DIR := nhd_tpu/rpc
+IMAGE     ?= nhd-tpu
+VERSION   ?= $(shell python -c "import tomllib;print(tomllib.load(open('pyproject.toml','rb'))['project']['version'])")
+SOAK_SEEDS ?= 100
+SOAK_STEPS ?= 120
 
-.PHONY: test proto bench wheel clean native
+.PHONY: test proto bench wheel clean native soak docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -26,6 +31,29 @@ bench:
 
 wheel:
 	python -m pip wheel --no-deps -w dist .
+
+# chaos soak: the reproducible command behind docs/COVERAGE.md's
+# "100+ seeds soaked clean" (CI runs the 4-seed subset in tests/test_chaos.py)
+soak:
+	python tools/soak.py --seeds $(SOAK_SEEDS) --steps $(SOAK_STEPS)
+
+# container image + in-container smoke test (reference: Makefile:244-252;
+# no registry push here — zero-egress environment, tag locally instead)
+docker:
+	@command -v docker >/dev/null 2>&1 || \
+		{ echo "docker not available; skipping image build"; exit 0; }
+	docker build -t $(IMAGE):$(VERSION) -t $(IMAGE):latest .
+	$(MAKE) docker-smoke
+
+docker-smoke:
+	@command -v docker >/dev/null 2>&1 || \
+		{ echo "docker not available; skipping smoke"; exit 0; }
+	docker run --rm $(IMAGE):latest \
+		nhd-tpu --fake --run-seconds 5
+
+# full release: gate on suite+bench, build the wheel, build+smoke the image
+release: check wheel docker
+	@echo "release $(VERSION): wheel in dist/, image $(IMAGE):$(VERSION)"
 
 clean:
 	rm -rf dist build *.egg-info

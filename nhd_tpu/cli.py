@@ -186,11 +186,19 @@ def main(argv=None) -> int:
 
     # honor an explicit JAX_PLATFORMS choice at the *config* level: some
     # hosts' PJRT plugins (e.g. tunneled TPUs) override jax_platforms in
-    # sitecustomize, and a dead tunnel would hang the scheduler's first solve
+    # sitecustomize, and a dead tunnel would hang the scheduler's first
+    # solve. For cpu the config update alone is NOT enough — the tunnel
+    # plugin initializes regardless, so the shared helper also drops its
+    # backend factory (see nhd_tpu/utils/platform.py)
     if os.environ.get("JAX_PLATFORMS"):
         import jax
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        if os.environ["JAX_PLATFORMS"] == "cpu":
+            from nhd_tpu.utils import force_cpu_backend
+
+            force_cpu_backend(jax)
+        else:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     if args.explain or args.explain_pod:
         return explain_main(args)
