@@ -40,16 +40,19 @@ soak:
 # container image + in-container smoke test (reference: Makefile:244-252;
 # no registry push here — zero-egress environment, tag locally instead)
 docker:
-	@command -v docker >/dev/null 2>&1 || \
-		{ echo "docker not available; skipping image build"; exit 0; }
-	docker build -t $(IMAGE):$(VERSION) -t $(IMAGE):latest .
-	$(MAKE) docker-smoke
+	@if command -v docker >/dev/null 2>&1; then \
+		docker build -t $(IMAGE):$(VERSION) -t $(IMAGE):latest . && \
+		$(MAKE) docker-smoke; \
+	else \
+		echo "docker not available; skipping image build"; \
+	fi
 
 docker-smoke:
-	@command -v docker >/dev/null 2>&1 || \
-		{ echo "docker not available; skipping smoke"; exit 0; }
-	docker run --rm $(IMAGE):latest \
-		nhd-tpu --fake --run-seconds 5
+	@if command -v docker >/dev/null 2>&1; then \
+		docker run --rm $(IMAGE):latest nhd-tpu --fake --run-seconds 5; \
+	else \
+		echo "docker not available; skipping smoke"; \
+	fi
 
 # full release: gate on suite+bench, build the wheel, build+smoke the image
 release: check wheel docker
