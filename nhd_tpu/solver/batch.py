@@ -523,7 +523,13 @@ class BatchScheduler:
                         ),
                         default=1,
                     )
-                    R = rank_budget(max_need, cluster.n_nodes)
+                    # backend decides the cap, not device-residency: even
+                    # the non-resident path executes (and pulls) on the
+                    # default backend
+                    R = rank_budget(
+                        max_need, cluster.n_nodes,
+                        accelerator=_accelerator_backend(),
+                    )
                     is_pending = np.zeros(len(items), bool)
                 is_pending[:] = False
                 is_pending[pending] = True

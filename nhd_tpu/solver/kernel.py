@@ -292,20 +292,31 @@ def _get_ranker(R: int, out_sharding_key=None):
     return jax.jit(rank)
 
 
-RANK_CAP = int(os.environ.get("NHD_TPU_RANK_CAP", "1024"))
+def rank_cap(accelerator: bool) -> int:
+    """Ceiling for the top-R rank width.
+
+    CPU backend: 1024 — pulls are free (zero-copy), so prefer fewer
+    rounds; the cap only guards top_k from degenerating into a full sort
+    at federation scale. Accelerator backend: 128 — the measured tunnel
+    moves ~0.3 MB/s, so the [T, R] pulls dominate the round at large R,
+    while per-node multi-claim capacity (typically ~10 pods/node) keeps
+    128 ranked nodes per type from costing extra rounds. A type that
+    exhausts R candidates while pods remain simply stays pending and the
+    next round re-ranks against advanced state — the cap is never a
+    correctness cut. NHD_TPU_RANK_CAP overrides both."""
+    env = os.environ.get("NHD_TPU_RANK_CAP")
+    if env:
+        return int(env)
+    return 128 if accelerator else 1024
 
 
-def rank_budget(max_need: int, n_padded: int) -> int:
+def rank_budget(max_need: int, n_padded: int, *, accelerator: bool = False) -> int:
     """The R for a batch: covers the largest per-type pod count (every
     candidate carries capacity >= 1, so R >= need never costs extra
-    rounds), bucketed to a power of two for jit-cache reuse.
-
-    Capped at RANK_CAP: an uncapped R makes top_k a full sort at
-    federation scale (100k pods of one type → R = N). A type that
-    exhausts R candidates while pods remain simply stays pending — the
-    next round re-ranks against advanced state, so the cap trades rounds
-    (only in near-worst cap-1 contention) for a much cheaper rank."""
-    return min(n_padded, _pad_pow2(min(max(max_need, 1), RANK_CAP), floor=64))
+    rounds), bucketed to a power of two for jit-cache reuse, under the
+    platform cap (see rank_cap)."""
+    cap = rank_cap(accelerator)
+    return min(n_padded, _pad_pow2(min(max(max_need, 1), cap), floor=64))
 
 
 def solve_bucket_ranked(cluster, pods, R: int) -> RankOut:

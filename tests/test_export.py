@@ -12,6 +12,7 @@ import pytest
 
 from tools.export_tpu import (
     build_headline_buckets,
+    export_ranked_solver,
     export_solver,
     register_solveout_serialization,
 )
@@ -20,14 +21,16 @@ from tools.export_tpu import (
 @pytest.fixture(scope="module")
 def exported_dir(tmp_path_factory):
     out = tmp_path_factory.mktemp("artifacts")
-    metas = export_solver(str(out))
-    return out, metas
+    buckets = build_headline_buckets()
+    metas = export_solver(str(out), buckets)
+    ranked = export_ranked_solver(str(out), buckets)
+    return out, metas, ranked
 
 
 def test_export_metadata(exported_dir):
-    out, metas = exported_dir
-    assert metas, "no buckets exported"
-    for meta in metas:
+    out, metas, ranked = exported_dir
+    assert metas and ranked, "no buckets exported"
+    for meta in metas + ranked:
         assert meta["platforms"] == ["cpu", "tpu"]
         assert meta["bytes"] > 0
         path = out / meta["artifact"]
@@ -42,7 +45,7 @@ def test_roundtrip_executes_and_matches_live_solver(exported_dir):
 
     from nhd_tpu.solver.kernel import get_solver
 
-    out, metas = exported_dir
+    out, metas, _ = exported_dir
     register_solveout_serialization()
     buckets = {tuple(m["bucket"].values()): m for m in metas}
     for args, meta in build_headline_buckets():
@@ -70,3 +73,32 @@ def test_repo_artifacts_committed():
         blob = open(os.path.join(art, meta["artifact"]), "rb").read()
         exported = jexport.deserialize(bytearray(blob))
         assert list(exported.platforms) == ["cpu", "tpu"]
+
+
+def test_ranked_roundtrip_matches_live_ranked_solver(exported_dir):
+    """The PRODUCTION artifact (solve fused with on-device top-R ranking)
+    executes on CPU bit-identically to the live fused program — pins the
+    free-array argument indices and the RankOut serialization."""
+    from jax import export as jexport
+
+    from nhd_tpu.solver.device_state import _ARG_ORDER
+    from nhd_tpu.solver.kernel import _get_ranker, get_solver
+
+    out, _, ranked = exported_dir
+    by_bucket = {tuple(m["bucket"].values()): m for m in ranked}
+    i_hp = _ARG_ORDER.index("hp_free")
+    i_cpu = _ARG_ORDER.index("cpu_free")
+    i_gpu = _ARG_ORDER.index("gpu_free")
+    for args, meta in build_headline_buckets():
+        b = meta["bucket"]
+        m = by_bucket[(b["G"], b["U"], b["K"])]
+        blob = (out / m["artifact"]).read_bytes()
+        exported = jexport.deserialize(bytearray(blob))
+        got = exported.call(*args)
+        solver = get_solver(b["G"], b["U"], b["K"])
+        ranker = _get_ranker(m["rank_width"])
+        o = solver(*args)
+        want = ranker(o.cand, o.pref, o.best_c, o.best_m, o.best_a,
+                      o.n_picks, args[i_gpu], args[i_cpu], args[i_hp])
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.array(g), np.array(w))

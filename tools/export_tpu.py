@@ -76,52 +76,100 @@ def register_solveout_serialization() -> None:
         return
     from jax import export as jexport
 
-    from nhd_tpu.solver.kernel import SolveOut
+    from nhd_tpu.solver.kernel import RankOut, SolveOut
 
     jexport.register_namedtuple_serialization(
         SolveOut, serialized_name="nhd_tpu.solver.kernel.SolveOut"
     )
+    jexport.register_namedtuple_serialization(
+        RankOut, serialized_name="nhd_tpu.solver.kernel.RankOut"
+    )
     _registered = True
 
 
-def export_solver(outdir: str) -> list:
+def _write_artifact(outdir: str, name: str, fn, args, meta: dict,
+                    extra_meta: dict | None = None) -> dict:
+    """Export *fn* at *args*' shapes for cpu+tpu and write blob + meta."""
     import jax
     from jax import export as jexport
 
+    specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
+    exported = jexport.export(fn, platforms=("cpu", "tpu"))(*specs)
+    blob = exported.serialize()
+    bin_path = os.path.join(outdir, f"{name}.stablehlo.bin")
+    with open(bin_path, "wb") as f:
+        f.write(blob)
+    meta = dict(meta)
+    meta.update(extra_meta or {})
+    meta.update({
+        "artifact": os.path.basename(bin_path),
+        "platforms": list(exported.platforms),
+        "calling_convention_version": exported.calling_convention_version,
+        "jax_version": jax.__version__,
+        "bytes": len(blob),
+        "in_avals": [f"{s.dtype}{list(s.shape)}" for s in specs],
+        "out_avals": [str(a) for a in exported.out_avals],
+    })
+    with open(os.path.join(outdir, f"{name}.json"), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    return meta
+
+
+def export_solver(outdir: str, buckets=None) -> list:
     from nhd_tpu.solver.kernel import get_solver
 
     register_solveout_serialization()
     os.makedirs(outdir, exist_ok=True)
     metas = []
-    for args, meta in build_headline_buckets():
+    for args, meta in (buckets or build_headline_buckets()):
         b = meta["bucket"]
         solver = get_solver(b["G"], b["U"], b["K"])
-
-        specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
-        exported = jexport.export(solver, platforms=("cpu", "tpu"))(*specs)
-        blob = exported.serialize()
-
         name = (
             f"solver_g{b['G']}_u{b['U']}_k{b['K']}"
             f"_t{meta['shape']['Tp']}_n{meta['shape']['Np']}"
         )
-        bin_path = os.path.join(outdir, f"{name}.stablehlo.bin")
-        with open(bin_path, "wb") as f:
-            f.write(blob)
+        metas.append(_write_artifact(outdir, name, solver, args, meta))
+    return metas
 
-        meta.update({
-            "artifact": os.path.basename(bin_path),
-            "platforms": list(exported.platforms),
-            "calling_convention_version": exported.calling_convention_version,
-            "jax_version": jax.__version__,
-            "bytes": len(blob),
-            "in_avals": [f"{s.dtype}{list(s.shape)}" for s in specs],
-            "out_avals": [str(a) for a in exported.out_avals],
-        })
-        meta_path = os.path.join(outdir, f"{name}.json")
-        with open(meta_path, "w") as f:
-            json.dump(meta, f, indent=1, sort_keys=True)
-        metas.append(meta)
+
+def export_ranked_solver(outdir: str, buckets=None) -> list:
+    """Export the PRODUCTION path: solve fused with the on-device top-R
+    ranking (solver/batch.py routes every round through this), at the
+    accelerator rank cap so the pinned TPU program is the one a healthy
+    tunnel would run."""
+    import jax
+
+    from nhd_tpu.solver.device_state import _ARG_ORDER
+    from nhd_tpu.solver.kernel import _get_ranker, get_solver, rank_cap
+
+    register_solveout_serialization()
+    os.makedirs(outdir, exist_ok=True)
+    # free-array positions derived from the single argument-order contract
+    i_hp = _ARG_ORDER.index("hp_free")
+    i_cpu = _ARG_ORDER.index("cpu_free")
+    i_gpu = _ARG_ORDER.index("gpu_free")
+    metas = []
+    R = rank_cap(accelerator=True)
+    for args, meta in (buckets or build_headline_buckets()):
+        b = meta["bucket"]
+        solver = get_solver(b["G"], b["U"], b["K"])
+        ranker = _get_ranker(R)
+
+        def fused(*a):
+            out = solver(*a)
+            return ranker(
+                out.cand, out.pref, out.best_c, out.best_m, out.best_a,
+                out.n_picks, a[i_gpu], a[i_cpu], a[i_hp],
+            )
+
+        name = (
+            f"solver_ranked_g{b['G']}_u{b['U']}_k{b['K']}"
+            f"_t{meta['shape']['Tp']}_n{meta['shape']['Np']}_r{R}"
+        )
+        metas.append(_write_artifact(
+            outdir, name, jax.jit(fused), args, meta,
+            extra_meta={"rank_width": R},
+        ))
     return metas
 
 
@@ -133,7 +181,8 @@ def main() -> int:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "artifacts",
     )
-    metas = export_solver(outdir)
+    buckets = build_headline_buckets()  # built once, shared by both families
+    metas = export_solver(outdir, buckets) + export_ranked_solver(outdir, buckets)
     print(json.dumps(metas, indent=1, sort_keys=True))
     return 0
 
