@@ -17,12 +17,31 @@ from typing import FrozenSet, List, Tuple
 from nhd_tpu.core.topology import MapMode, PodTopology, SmtMode
 
 
+def _cached_hash(self) -> int:
+    """Shared lazy hash-cache for the request dataclasses: the
+    dataclass-generated __hash__ rebuilds the field tuple on every call,
+    and the pod-dedupe dict (encode_pods) probes it for every pod of a
+    10k gang. Each class assigns ``__hash__ = _cached_hash`` and defines
+    ``_key()`` over its fields (keep _key in sync when adding fields —
+    eq uses the same tuple)."""
+    h = self.__dict__.get("_hash")
+    if h is None:
+        h = hash(self._key())
+        object.__setattr__(self, "_hash", h)
+    return h
+
+
 @dataclass(frozen=True)
 class CpuRequest:
     """A count of cores plus whether they may ride SMT siblings."""
 
     count: int
     smt: SmtMode
+
+    def _key(self) -> tuple:
+        return (self.count, self.smt)
+
+    __hash__ = _cached_hash
 
     def physical_cores(self, node_smt: bool) -> int:
         """Physical (sibling-pair) cores consumed on a node.
@@ -46,6 +65,12 @@ class GroupRequest:
     gpus: int
     nic_rx_gbps: float
     nic_tx_gbps: float
+
+    def _key(self) -> tuple:
+        return (self.proc, self.misc, self.gpus,
+                self.nic_rx_gbps, self.nic_tx_gbps)
+
+    __hash__ = _cached_hash
 
     def cpu_physical(self, node_smt: bool) -> int:
         """Group total physical cores: proc + helper, each under its own SMT
@@ -78,12 +103,7 @@ class PodRequest:
         return (self.groups, self.misc, self.hugepages_gb, self.map_mode,
                 self.node_groups)
 
-    def __hash__(self) -> int:
-        h = self.__dict__.get("_hash")
-        if h is None:
-            h = hash(self._key())
-            object.__setattr__(self, "_hash", h)
-        return h
+    __hash__ = _cached_hash
 
     def __eq__(self, other) -> bool:
         if not isinstance(other, PodRequest):
