@@ -11,19 +11,25 @@ pod ask for" on both paths.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass
+from dataclasses import dataclass, fields
 from typing import FrozenSet, List, Tuple
 
 from nhd_tpu.core.topology import MapMode, PodTopology, SmtMode
+
+
+def _field_key(self) -> tuple:
+    """All dataclass fields, in declaration order — mechanically derived
+    so hash and eq can never drift from the field set."""
+    return tuple(getattr(self, f.name) for f in fields(self))
 
 
 def _cached_hash(self) -> int:
     """Shared lazy hash-cache for the request dataclasses: the
     dataclass-generated __hash__ rebuilds the field tuple on every call,
     and the pod-dedupe dict (encode_pods) probes it for every pod of a
-    10k gang. Each class assigns ``__hash__ = _cached_hash`` and defines
-    ``_key()`` over its fields (keep _key in sync when adding fields —
-    eq uses the same tuple)."""
+    10k gang. Each class assigns ``__hash__ = _cached_hash``; the key is
+    the mechanical all-fields tuple (_field_key), the same thing the
+    generated __eq__ compares."""
     h = self.__dict__.get("_hash")
     if h is None:
         h = hash(self._key())
@@ -38,9 +44,7 @@ class CpuRequest:
     count: int
     smt: SmtMode
 
-    def _key(self) -> tuple:
-        return (self.count, self.smt)
-
+    _key = _field_key
     __hash__ = _cached_hash
 
     def physical_cores(self, node_smt: bool) -> int:
@@ -66,10 +70,7 @@ class GroupRequest:
     nic_rx_gbps: float
     nic_tx_gbps: float
 
-    def _key(self) -> tuple:
-        return (self.proc, self.misc, self.gpus,
-                self.nic_rx_gbps, self.nic_tx_gbps)
-
+    _key = _field_key
     __hash__ = _cached_hash
 
     def cpu_physical(self, node_smt: bool) -> int:
@@ -99,10 +100,7 @@ class PodRequest:
     map_mode: MapMode
     node_groups: FrozenSet[str] = frozenset({"default"})
 
-    def _key(self) -> tuple:
-        return (self.groups, self.misc, self.hugepages_gb, self.map_mode,
-                self.node_groups)
-
+    _key = _field_key
     __hash__ = _cached_hash
 
     def __eq__(self, other) -> bool:

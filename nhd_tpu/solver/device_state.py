@@ -22,6 +22,7 @@ the jit cache.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Iterable, Optional
 
 import jax
@@ -78,33 +79,51 @@ def _pad_rows(a: np.ndarray, size: int) -> np.ndarray:
 from functools import partial
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _scatter_all(arrays, idx, rows):
+def _scatter_donation() -> bool:
+    """Whether the row scatter donates its input buffers. Donation is the
+    right default (in-place update, no extra HBM); NHD_TPU_SCATTER=fresh
+    disables it — an A/B knob for the tunnel-attached TPU, where the
+    measured 838 ms per 40-row update (docs/TPU_STATUS.md) is suspected
+    to be donation forcing buffer round-trips through the relay."""
+    mode = os.environ.get("NHD_TPU_SCATTER", "donate").lower()
+    if mode not in ("donate", "fresh"):
+        raise ValueError(
+            f"NHD_TPU_SCATTER must be 'donate' or 'fresh', got {mode!r}"
+        )
+    return mode != "fresh"
+
+
+def _scatter_impl(arrays, idx, rows):
     # one dispatch updates every mutable array (a tunnel-attached TPU pays
-    # per-call latency); donation lets XLA update buffers in place since the
-    # caller rebinds the results over the inputs
+    # per-call latency)
     return {
         name: arrays[name].at[idx].set(rows[name]) for name in arrays
     }
+
+
+_scatter_donate = jax.jit(_scatter_impl, donate_argnums=(0,))
+_scatter_fresh = jax.jit(_scatter_impl)
+
+
+def _scatter_all(arrays, idx, rows):
+    fn = _scatter_donate if _scatter_donation() else _scatter_fresh
+    return fn(arrays, idx, rows)
 
 
 from functools import lru_cache
 
 
 @lru_cache(maxsize=None)
-def _get_sharded_scatter(sharding):
+def _get_sharded_scatter(sharding, donate: bool = True):
     """Row scatter that pins its outputs to the node sharding — global row
     indices, each shard applies the rows it owns."""
 
-    @partial(
-        jax.jit,
-        donate_argnums=(0,),
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(
+        _scatter_impl,
         out_shardings={name: sharding for name in _MUTABLE},
+        **kwargs,
     )
-    def scatter(arrays, idx, rows):
-        return {name: arrays[name].at[idx].set(rows[name]) for name in arrays}
-
-    return scatter
 
 
 class DeviceClusterState:
@@ -152,7 +171,7 @@ class DeviceClusterState:
         mutable = {name: self._dev[name] for name in _MUTABLE}
         rows = {name: getattr(self.cluster, name)[idx] for name in _MUTABLE}
         scatter = (
-            _get_sharded_scatter(self._node_sharding)
+            _get_sharded_scatter(self._node_sharding, _scatter_donation())
             if self._node_sharding is not None
             else _scatter_all
         )

@@ -328,3 +328,20 @@ def test_headless_round_path_preserves_busy_and_niclist():
         nodes, [BatchItem(("ns", "late"), simple_request(gpus=1))], now=1010.0
     )
     assert results2[0].node is None
+
+
+def test_rank_cap_exhaustion_only_costs_rounds(monkeypatch):
+    """A type needing more candidate nodes than the rank width R still
+    places everything — exhausted candidates roll to later rounds
+    (kernel.rank_cap's correctness claim)."""
+    monkeypatch.setenv("NHD_TPU_RANK_CAP", "64")
+    from nhd_tpu.sim import make_cluster
+
+    nodes = make_cluster(128)
+    reqs = [simple_request() for _ in range(400)]
+    results, stats = BatchScheduler(
+        respect_busy=False, register_pods=False
+    ).schedule(nodes, items(reqs), now=0.0)
+    assert sum(1 for r in results if r.node) == 400
+    # more than 64 distinct nodes were needed overall
+    assert len({r.node for r in results}) > 64

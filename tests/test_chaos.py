@@ -34,3 +34,18 @@ def test_chaos_through_streaming_scheduler_path(monkeypatch):
     assert stats.violations == []
     assert sim.sched._stream is not None, "streaming path never engaged"
     assert stats.created > 10
+
+
+def test_chaos_through_routed_streaming(monkeypatch):
+    """The routed (capacity-partitioned, concurrent-tile) streaming path
+    must satisfy the same conservation invariants under churn."""
+    from nhd_tpu.scheduler import core as core_mod
+
+    monkeypatch.setattr(core_mod, "STREAM_NODE_THRESH", 1)
+    monkeypatch.setattr(core_mod, "STREAM_PLACEMENT", "routed")
+    sim = ChaosSim(seed=21, n_nodes=4)
+    stats = sim.run(steps=60)
+    assert stats.violations == []
+    assert sim.sched._stream is not None, "streaming path never engaged"
+    assert sim.sched._stream.placement == "routed"
+    assert stats.created > 10
