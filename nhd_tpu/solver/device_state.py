@@ -93,6 +93,11 @@ def _scatter_donation() -> bool:
     return mode != "fresh"
 
 
+# fail fast on a typo'd value at import (matching the scheduler's env
+# knobs) — the per-call read above stays so a bench can A/B in-process
+_scatter_donation()
+
+
 def _scatter_impl(arrays, idx, rows):
     # one dispatch updates every mutable array (a tunnel-attached TPU pays
     # per-call latency)

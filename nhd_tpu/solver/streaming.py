@@ -104,26 +104,32 @@ class StreamingScheduler:
         self.batch = BatchScheduler(**batch_kwargs)
 
     @staticmethod
-    def _tile_capacity(tile: Dict[str, HostNode], items, indices) -> int:
+    def _batch_demand(items, indices) -> Tuple[float, float, float]:
+        """Average per-pod (cores, gpus, hugepages) demand of the batch —
+        computed ONCE per schedule() (walking 100k pods per tile showed
+        up at ~0.7 s in the federation profile)."""
+        n = len(indices)
+        if n == 0:
+            return (1e-6, 0.0, 0.0)
+        cores = gpus = hp = 0
+        for i in indices:
+            req = items[i].request
+            cores += req.misc.count
+            for g in req.groups:
+                cores += g.proc.count + g.misc.count
+                gpus += g.gpus
+            hp += req.hugepages_gb
+        return (max(cores / n, 1e-6), gpus / n, hp / n)
+
+    @staticmethod
+    def _tile_capacity(
+        tile: Dict[str, HostNode], demand: Tuple[float, float, float]
+    ) -> int:
         """Estimated pod count *tile* can absorb for this batch: per-
         resource free totals over the batch's average per-pod demand,
         minimized across resources. Only balance matters — errors spill
         to the next tile."""
-        n = len(indices)
-        if n == 0:
-            return 0
-        avg_cores = max(
-            sum(
-                sum(g.proc.count + g.misc.count for g in items[i].request.groups)
-                + items[i].request.misc.count
-                for i in indices
-            ) / n,
-            1e-6,
-        )
-        avg_gpus = sum(
-            sum(g.gpus for g in items[i].request.groups) for i in indices
-        ) / n
-        avg_hp = sum(items[i].request.hugepages_gb for i in indices) / n
+        avg_cores, avg_gpus, avg_hp = demand
         free_cores = free_gpus = free_hp = 0
         for node in tile.values():
             free_cores += node.free_cpu_core_count()
@@ -356,9 +362,9 @@ class StreamingScheduler:
         # concurrently from t=0
         start_blocks: List[Tuple[int, List[int]]] = []  # (tile, pod indices)
         if self.placement == "routed" and len(tiles) > 1:
+            demand = self._batch_demand(items, schedulable)
             caps = [
-                self._tile_capacity(tile, items, schedulable)
-                for tile in tiles
+                self._tile_capacity(tile, demand) for tile in tiles
             ]
             # group-aware routing: each pod only goes to tiles whose node
             # groups intersect its own, split by capacity share within
