@@ -110,7 +110,8 @@ class StreamingScheduler:
         up at ~0.7 s in the federation profile)."""
         n = len(indices)
         if n == 0:
-            return (1e-6, 0.0, 0.0)
+            # sentinel read by _tile_capacity as "no demand → no capacity"
+            return (0.0, 0.0, 0.0)
         cores = gpus = hp = 0
         for i in indices:
             req = items[i].request
@@ -130,6 +131,8 @@ class StreamingScheduler:
         minimized across resources. Only balance matters — errors spill
         to the next tile."""
         avg_cores, avg_gpus, avg_hp = demand
+        if avg_cores <= 0:
+            return 0  # empty batch: no demand, report no capacity
         free_cores = free_gpus = free_hp = 0
         for node in tile.values():
             free_cores += node.free_cpu_core_count()

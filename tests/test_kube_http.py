@@ -366,3 +366,52 @@ def test_bearer_token_sent(stub, monkeypatch, tmp_path):
     with pytest.raises(ApiException) as ei:
         CoreV1Api().read_node("n1")
     assert ei.value.status == 401
+
+
+def test_watch_resource_version_tracking_and_410_reset(stub):
+    """The watch resumes from the last seen resourceVersion on reconnect,
+    and a 410 Gone resets it so the next reconnect starts fresh."""
+    from nhd_tpu.k8s.restclient import (
+        ApiException, Configuration, CoreV1Api, Watch, _set_config,
+    )
+
+    _set_config(Configuration(f"http://127.0.0.1:{stub.port}"))
+    api = CoreV1Api()
+    pod = make_pod("w1", uid="uid-w1")
+    pod["metadata"]["resourceVersion"] = "42"
+    stub.queue_watch_event("/api/v1/pods", "ADDED", pod)
+
+    w = Watch()
+    events = list(w.stream(api.list_pod_for_all_namespaces))
+    assert [e["object"].metadata.name for e in events] == ["w1"]
+    assert w.resource_version == "42"
+
+    # the reconnect carries resourceVersion=42 on the wire
+    list(w.stream(api.list_pod_for_all_namespaces))
+    watch_paths = [p for (m, p, _, _) in stub.requests if "watch=true" in p]
+    assert watch_paths[-1].endswith("resourceVersion=42")
+
+    # a 410 Gone (simulated via the exception path) must clear the rv
+    def gone(**kw):
+        raise ApiException(status=410, reason="Gone")
+
+    with pytest.raises(ApiException):
+        list(w.stream(gone))
+    assert w.resource_version is None
+
+
+def test_token_rotation_reread_per_request(stub, monkeypatch, tmp_path):
+    """Bound SA tokens rotate on disk; the client re-reads the file per
+    request so a long-lived scheduler never sends a stale token."""
+    token_file = tmp_path / "token"
+    token_file.write_text("token-v1")
+    monkeypatch.setenv("NHD_K8S_TOKEN_FILE", str(token_file))
+    stub.token = "token-v1"
+    stub.add_node("n1")
+    b = _backend()
+    assert b.get_nodes() == ["n1"]
+
+    # rotate: server now only accepts v2; the client must follow
+    token_file.write_text("token-v2")
+    stub.token = "token-v2"
+    assert b.get_nodes() == ["n1"]
