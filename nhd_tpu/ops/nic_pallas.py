@@ -44,12 +44,16 @@ def _kernel(U, K, C, A,
         )
         fit = fit & (unchosen_ref[:, uk][None, :] | ok)
 
-    is_pci = map_pci_ref[0, 0] != 0
+    is_pci = map_pci_ref[pl.program_id(0), 0] != 0
     fit = fit & valid_ref[:, :] & (pci_ok_ref[:, :] | ~is_pci)
 
     fit3 = fit.reshape(BN, C, A)
     any_ref[0] = jnp.any(fit3, axis=-1)
-    first_ref[0] = jnp.argmax(fit3, axis=-1).astype(jnp.int32)
+    # Mosaic's argmax lowering is float32-only; 0.0/1.0 keeps bool-argmax
+    # semantics exactly (first True, else 0)
+    first_ref[0] = jnp.argmax(
+        fit3.astype(jnp.float32), axis=-1
+    ).astype(jnp.int32)
     # real per-combo pick counts: the batch scheduler's multi-claim
     # capacity hint (kernel.py n_picks) — without this the pallas path
     # degraded the hint to 1 and paid extra rounds (VERDICT r1 weak-2)
@@ -74,8 +78,11 @@ def nic_any_first(
     assert N % BN == 0, f"node axis must be padded to {BN}"
     grid = (T, N // BN)
 
-    # TPU lowering requires rank-1 blocks to span the whole array; carry
-    # the per-type scalar as [T, 1] so its block is (1, 1) == full extent
+    # Mosaic block-shape rules: rank-1 blocks must span the whole array,
+    # and the last two dims of rank-2+ blocks must be divisible by (8, 128)
+    # or equal the array dims. A (1, 1) block over [T, 1] violates the
+    # sublane rule whenever T > 1, so the per-type scalar rides as the
+    # FULL [T, 1] array (tiny) and the kernel indexes it by program_id(0).
     map_pci = map_pci.reshape(T, 1)
 
     kernel = functools.partial(_kernel, U, K, C, A)
@@ -90,7 +97,7 @@ def nic_any_first(
             pl.BlockSpec((C * A, U * K), lambda t, nb: (0, 0)),  # unchosen
             pl.BlockSpec((BN, C * A), lambda t, nb: (nb, 0)),   # valid
             pl.BlockSpec((BN, C * A), lambda t, nb: (nb, 0)),   # pci_ok
-            pl.BlockSpec((1, 1), lambda t, nb: (t, 0)),         # map_pci
+            pl.BlockSpec((T, 1), lambda t, nb: (0, 0)),         # map_pci
         ],
         out_specs=[
             pl.BlockSpec((1, BN, C), lambda t, nb: (t, nb, 0)),

@@ -711,7 +711,9 @@ class BatchScheduler:
                         (G, pods, winners, buffers, w_node, w_c, w_m)
                     )
                 if dev is not None:
-                    dev.update_rows(node_claimed)
+                    # deferred: the scatter fuses into the next round's
+                    # solve dispatch (device_state.stage_rows)
+                    dev.stage_rows(node_claimed)
 
                 # pending update, vectorized: a winner leaves pending when
                 # its assignment succeeded (status >= 0) OR it was the
@@ -953,7 +955,7 @@ class BatchScheduler:
                     if not self.respect_busy:
                         cluster.busy[n] = False
             if dev is not None and apply:
-                dev.update_rows(node_claimed)
+                dev.stage_rows(node_claimed)
             stats.assign_seconds += time.perf_counter() - t0
             stats.round_end_seconds.append(time.perf_counter() - t_batch)
 

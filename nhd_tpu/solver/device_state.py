@@ -34,6 +34,8 @@ from nhd_tpu.solver.kernel import (
     RankOut,
     SolveOut,
     _get_ranker,
+    _rank_body,
+    _solve,
     pallas_enabled,
     _pad_pow2,
     get_solver,
@@ -119,6 +121,46 @@ from functools import lru_cache
 
 
 @lru_cache(maxsize=None)
+def _get_fused_ranked(G, U, K, R, n_idx, donate, use_pallas):
+    """One jitted program = (optional row scatter) + solve + top-R rank.
+
+    On a tunnel-attached TPU every jitted call pays per-dispatch relay
+    latency (~hundreds of ms, docs/TPU_STATUS.md), so the three per-round
+    device calls — scatter the claimed rows, solve, rank — collapse into
+    ONE dispatch here. ``n_idx`` is the padded scatter width (0 = no
+    staged rows, round 1); the mutable arrays are donated on the scatter
+    variant so the update is in-place, matching update_rows' semantics.
+    Cache key is the bucket shape + R + scatter width, all pow-2-bucketed,
+    so a whole batch reuses a handful of programs."""
+    from nhd_tpu.solver.combos import get_tables
+
+    tables = get_tables(G, U, K)
+
+    def fn(mutable, static, idx, rows, *pod_args):
+        if n_idx:
+            mutable = {
+                name: mutable[name].at[idx].set(rows[name])
+                for name in mutable
+            }
+        arrays = {**static, **mutable}
+        out = _solve(
+            tables,
+            *[arrays[name] for name in _ARG_ORDER],
+            *pod_args,
+            use_pallas=use_pallas,
+        )
+        rank = _rank_body(
+            R, out.cand, out.pref, out.best_c, out.best_m, out.best_a,
+            out.n_picks,
+            arrays["gpu_free"], arrays["cpu_free"], arrays["hp_free"],
+        )
+        return mutable, rank
+
+    kwargs = {"donate_argnums": (0,)} if (donate and n_idx) else {}
+    return jax.jit(fn, **kwargs)
+
+
+@lru_cache(maxsize=None)
 def _get_sharded_scatter(sharding, donate: bool = True):
     """Row scatter that pins its outputs to the node sharding — global row
     indices, each shard applies the rows it owns."""
@@ -158,6 +200,9 @@ class DeviceClusterState:
 
             self._node_sharding = NamedSharding(self.mesh, P("nodes"))
         self._dev: Dict[str, jax.Array] = {}
+        # rows whose re-ship is deferred into the next solve dispatch
+        # (single-device path only — the mesh path applies immediately)
+        self._staged: set = set()
         for name in _ARG_ORDER:
             padded = _pad_rows(getattr(cluster, name), self.Np)
             if self._node_sharding is not None:
@@ -165,14 +210,62 @@ class DeviceClusterState:
             else:
                 self._dev[name] = jnp.asarray(padded)
 
-    def update_rows(self, indices: Iterable[int]) -> None:
-        """Re-ship the claimed nodes' rows (host ClusterArrays → device)."""
+    def stage_rows(self, indices: Iterable[int]) -> None:
+        """Mark claimed nodes whose host-mirror rows must reach the device
+        before the next solve. Single-device: deferred and FUSED into the
+        next solve_ranked dispatch (one tunnel round-trip instead of two);
+        the row content is read at dispatch time, when the mirror already
+        carries every claim of the round. Mesh: applied immediately via
+        the sharded scatter (the SPMD solve is a separate pjit program)."""
+        if self._node_sharding is not None:
+            self.update_rows(indices)
+        else:
+            self._staged.update(int(i) for i in indices)
+
+    def _flush_staged(self) -> None:
+        if self._staged:
+            staged, self._staged = self._staged, set()
+            self.update_rows(staged)
+
+    def _padded_idx(self, indices: Iterable[int]) -> Optional[np.ndarray]:
+        """Claimed-row indices as a padded vector (padding repeats the
+        last index — idempotent for a row `set`), or None when empty. The
+        single construction every scatter variant shares.
+
+        Widths bucket to powers of FOUR (16, 64, 256, 1024, …): the width
+        is a jit-cache key, and on the fused path each distinct bucket
+        compiles a whole solve+rank program — pow-4 caps that at ~4
+        programs per batch while the padded upload stays within 4× the
+        claimed rows (still O(claimed), never O(N))."""
         idx_list = sorted(set(indices))
         if not idx_list:
-            return
-        padded_len = _pad_pow2(len(idx_list), floor=8)
+            return None
+        padded_len = 16
+        while padded_len < len(idx_list):
+            padded_len *= 4
         idx = np.full(padded_len, idx_list[-1], np.int32)
         idx[: len(idx_list)] = idx_list
+        return idx
+
+    def _pod_args(self, pods) -> list:
+        """The 9 pod-type arrays padded to the pow-2 type bucket, in
+        _solve's positional order — shared by the plain and fused solve
+        paths so the argument list cannot drift between them."""
+        Tp = _pad_pow2(pods.n_types)
+        return [
+            _pad_rows(a, Tp)
+            for a in (
+                pods.cpu_dem_smt, pods.cpu_dem_raw, pods.gpu_dem,
+                pods.rx, pods.tx, pods.hp, pods.needs_gpu, pods.map_pci,
+                pods.group_mask,
+            )
+        ]
+
+    def update_rows(self, indices: Iterable[int]) -> None:
+        """Re-ship the claimed nodes' rows (host ClusterArrays → device)."""
+        idx = self._padded_idx(indices)
+        if idx is None:
+            return
         mutable = {name: self._dev[name] for name in _MUTABLE}
         rows = {name: getattr(self.cluster, name)[idx] for name in _MUTABLE}
         scatter = (
@@ -186,11 +279,7 @@ class DeviceClusterState:
     def _solve_raw(self, pods) -> SolveOut:
         """The padded solver call against the resident arrays
         ([Tp, Np] outputs, still on device)."""
-        Tp = _pad_pow2(pods.n_types)
-
-        def pad_t(a):
-            return _pad_rows(a, Tp)
-
+        self._flush_staged()
         if self.mesh is not None:
             from nhd_tpu.parallel.sharding import get_sharded_solver
 
@@ -201,10 +290,7 @@ class DeviceClusterState:
             solver = get_solver(pods.G, self.cluster.U, self.cluster.K)
         return solver(
             *[self._dev[name] for name in _ARG_ORDER],
-            pad_t(pods.cpu_dem_smt), pad_t(pods.cpu_dem_raw),
-            pad_t(pods.gpu_dem), pad_t(pods.rx), pad_t(pods.tx),
-            pad_t(pods.hp), pad_t(pods.needs_gpu), pad_t(pods.map_pci),
-            pad_t(pods.group_mask),
+            *self._pod_args(pods),
         )
 
     def solve(self, pods) -> SolveOut:
@@ -216,20 +302,59 @@ class DeviceClusterState:
     def solve_ranked(self, pods, R: int) -> RankOut:
         """Solve + on-device top-R ranking: only [Tp, R] decision tensors
         leave the device (the free-total gathers read the RESIDENT free
-        arrays, which update_rows keeps live between rounds). On a mesh
-        the rank outputs are pinned replicated — top_k over the sharded
-        node axis is the one collective this adds."""
-        out = self._solve_raw(pods)
+        arrays, which stage_rows/update_rows keep live between rounds).
+
+        Single device: ONE fused dispatch applies any staged row scatter,
+        solves, and ranks (per-call relay latency dominates the round on
+        the tunnel-attached TPU, so call count is the metric that
+        matters). Mesh: the pjit SPMD solve + a replicated-output ranker —
+        top_k over the sharded node axis is the one collective this adds."""
         R = min(R, self.Np)
         if self._node_sharding is not None:
+            out = self._solve_raw(pods)
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             ranker = _get_ranker(R, NamedSharding(self.mesh, P()))
-        else:
-            ranker = _get_ranker(R)
-        return ranker(
-            out.cand, out.pref, out.best_c, out.best_m, out.best_a,
-            out.n_picks,
-            self._dev["gpu_free"], self._dev["cpu_free"],
-            self._dev["hp_free"],
+            return ranker(
+                out.cand, out.pref, out.best_c, out.best_m, out.best_a,
+                out.n_picks,
+                self._dev["gpu_free"], self._dev["cpu_free"],
+                self._dev["hp_free"],
+            )
+
+        idx = rows = None
+        n_idx = 0
+        idx_np = self._padded_idx(self._staged) if self._staged else None
+        if idx_np is not None:
+            self._staged = set()
+            n_idx = len(idx_np)
+            idx = jnp.asarray(idx_np)
+            rows = {
+                name: getattr(self.cluster, name)[idx_np]
+                for name in _MUTABLE
+            }
+        fused = _get_fused_ranked(
+            pods.G, self.cluster.U, self.cluster.K, R, n_idx,
+            _scatter_donation(), pallas_enabled(),
         )
+        mutable = {name: self._dev[name] for name in _MUTABLE}
+        static = {name: self._dev[name] for name in _STATIC}
+        try:
+            new_mutable, rank = fused(
+                mutable, static, idx, rows, *self._pod_args(pods)
+            )
+        except BaseException:
+            if n_idx:
+                # the donated mutable buffers may already be consumed, and
+                # the staged indices were popped — rebuild the resident
+                # mutable rows wholesale from the host mirror (source of
+                # truth) so a caller that survives the error keeps a
+                # coherent context
+                for name in _MUTABLE:
+                    self._dev[name] = jnp.asarray(
+                        _pad_rows(getattr(self.cluster, name), self.Np)
+                    )
+            raise
+        if n_idx:
+            self._dev.update(new_mutable)
+        return rank

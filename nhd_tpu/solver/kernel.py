@@ -258,6 +258,29 @@ class RankOut(NamedTuple):
     free_hp: jax.Array
 
 
+def _rank_body(R, cand, pref, best_c, best_m, best_a, n_picks,
+               gpu_free, cpu_free, hp_free) -> RankOut:
+    """The top-R ranking math, traceable inside any jitted program — the
+    standalone ranker below and the fused scatter+solve+rank dispatch
+    (solver/device_state.py) share it so their selection semantics cannot
+    drift."""
+    N = cand.shape[1]
+    sel = jnp.where(
+        cand,
+        pref * (N + 1) + (N - jnp.arange(N, dtype=jnp.int32))[None, :],
+        0,
+    )
+    val, idx = jax.lax.top_k(sel, R)
+    gat = lambda a: jnp.take_along_axis(a, idx, axis=1)
+    return RankOut(
+        val, idx.astype(jnp.int32),
+        gat(best_c), gat(best_m), gat(best_a), gat(n_picks),
+        gpu_free.sum(axis=1).astype(jnp.int32)[idx],
+        cpu_free.sum(axis=1).astype(jnp.int32)[idx],
+        hp_free.astype(jnp.int32)[idx],
+    )
+
+
 @lru_cache(maxsize=None)
 def _get_ranker(R: int, out_sharding_key=None):
     """Jitted top-R ranking over a solve's [T, N] outputs. Cached per R
@@ -266,20 +289,9 @@ def _get_ranker(R: int, out_sharding_key=None):
 
     def rank(cand, pref, best_c, best_m, best_a, n_picks,
              gpu_free, cpu_free, hp_free):
-        N = cand.shape[1]
-        sel = jnp.where(
-            cand,
-            pref * (N + 1) + (N - jnp.arange(N, dtype=jnp.int32))[None, :],
-            0,
-        )
-        val, idx = jax.lax.top_k(sel, R)
-        gat = lambda a: jnp.take_along_axis(a, idx, axis=1)
-        return RankOut(
-            val, idx.astype(jnp.int32),
-            gat(best_c), gat(best_m), gat(best_a), gat(n_picks),
-            gpu_free.sum(axis=1).astype(jnp.int32)[idx],
-            cpu_free.sum(axis=1).astype(jnp.int32)[idx],
-            hp_free.astype(jnp.int32)[idx],
+        return _rank_body(
+            R, cand, pref, best_c, best_m, best_a, n_picks,
+            gpu_free, cpu_free, hp_free,
         )
 
     if out_sharding_key is not None:
@@ -297,17 +309,20 @@ def rank_cap(accelerator: bool) -> int:
 
     CPU backend: 1024 — pulls are free (zero-copy), so prefer fewer
     rounds; the cap only guards top_k from degenerating into a full sort
-    at federation scale. Accelerator backend: 128 — the measured tunnel
-    moves ~0.3 MB/s, so the [T, R] pulls dominate the round at large R,
-    while per-node multi-claim capacity (typically ~10 pods/node) keeps
-    128 ranked nodes per type from costing extra rounds. A type that
-    exhausts R candidates while pods remain simply stays pending and the
-    next round re-ranks against advanced state — the cap is never a
-    correctness cut. NHD_TPU_RANK_CAP overrides both."""
+    at federation scale. Accelerator backend: 512 — on the tunnel-attached
+    TPU each ROUND costs ~1.2 s of fixed dispatch latency, which swamps
+    the [T, R] pull-size savings of a tighter cap: measured at cfg4
+    (10k×1k), R=128 needs 7 greedy rounds and R=256 needs 5, while R=512
+    matches the uncapped 3 (the capacity-repeat select runs out of ranked
+    candidates below that and pays whole extra rounds; BENCH_r02's
+    R=128 TPU run was 8.7 s vs 3.6 s uncapped for exactly this reason).
+    A type that exhausts R candidates while pods remain simply stays
+    pending and the next round re-ranks against advanced state — the cap
+    is never a correctness cut. NHD_TPU_RANK_CAP overrides both."""
     env = os.environ.get("NHD_TPU_RANK_CAP")
     if env:
         return int(env)
-    return 128 if accelerator else 1024
+    return 512 if accelerator else 1024
 
 
 def rank_budget(max_need: int, n_padded: int, *, accelerator: bool = False) -> int:

@@ -202,3 +202,53 @@ def test_device_state_update_rows_matches_reupload():
                 np.asarray(dev._dev[name]), np.asarray(fresh._dev[name]),
                 err_msg=f"{name} diverged (mesh={mesh is not None})",
             )
+
+
+def test_staged_rows_fuse_into_solve_dispatch():
+    """Targeted: stage_rows defers the row scatter into the next
+    solve_ranked call (the single-dispatch-per-round path for the
+    tunnel-attached TPU). The fused program's RankOut AND its post-scatter
+    resident arrays must match a fresh full upload's."""
+    import numpy as np
+
+    from nhd_tpu.solver.device_state import _ARG_ORDER, DeviceClusterState
+    from nhd_tpu.solver.encode import (
+        encode_cluster, encode_pods, refresh_node_row,
+    )
+    from tests.test_batch import simple_request
+
+    nodes = make_cluster(6)
+    cluster = encode_cluster(nodes, now=0.0)
+    dev = DeviceClusterState(cluster)  # single device: the fused path
+
+    touched = [1, 3, 4]
+    for i, name in enumerate(nodes):
+        if i in touched:
+            for gpu in nodes[name].gpus[:2]:
+                gpu.used = True
+            nodes[name].mem.free_hugepages_gb -= 8
+            refresh_node_row(cluster, i, nodes[name], now=0.0)
+    dev.stage_rows(touched)
+    # staged, not yet applied: the resident mutable rows still hold the
+    # pre-claim values, not the mirror's current (post-claim) ones
+    post = np.asarray(DeviceClusterState(cluster)._dev["gpu_free"])
+    assert not np.array_equal(np.asarray(dev._dev["gpu_free"]), post)
+
+    (pods,) = encode_pods(
+        [simple_request(gpus=1)], cluster.interner
+    ).values()
+    got = dev.solve_ranked(pods, R=8)
+
+    fresh = DeviceClusterState(cluster)
+    want = fresh.solve_ranked(pods, R=8)
+    for name, g, w in zip(got._fields, got, want):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(w), err_msg=f"RankOut.{name} diverged"
+        )
+    # and the scatter really landed on the resident arrays
+    for name in _ARG_ORDER:
+        np.testing.assert_array_equal(
+            np.asarray(dev._dev[name]), np.asarray(fresh._dev[name]),
+            err_msg=f"{name} diverged after fused scatter",
+        )
+    assert not dev._staged
