@@ -128,10 +128,12 @@ def _get_fused_ranked(G, U, K, R, n_idx, donate, use_pallas):
     latency (~hundreds of ms, docs/TPU_STATUS.md), so the three per-round
     device calls — scatter the claimed rows, solve, rank — collapse into
     ONE dispatch here. ``n_idx`` is the padded scatter width (0 = no
-    staged rows, round 1); the mutable arrays are donated on the scatter
-    variant so the update is in-place, matching update_rows' semantics.
-    Cache key is the bucket shape + R + scatter width, all pow-2-bucketed,
-    so a whole batch reuses a handful of programs."""
+    staged rows — that variant returns only the RankOut, so the untouched
+    mutable arrays are never copied to fresh output buffers); with a
+    scatter, the mutable arrays are donated so the update is in-place,
+    matching update_rows' semantics. Cache key is the bucket shape + R +
+    scatter width (pow-4-bucketed, see _padded_idx) — a whole batch
+    reuses a handful of programs."""
     from nhd_tpu.solver.combos import get_tables
 
     tables = get_tables(G, U, K)
@@ -154,7 +156,7 @@ def _get_fused_ranked(G, U, K, R, n_idx, donate, use_pallas):
             out.n_picks,
             arrays["gpu_free"], arrays["cpu_free"], arrays["hp_free"],
         )
-        return mutable, rank
+        return (mutable, rank) if n_idx else rank
 
     kwargs = {"donate_argnums": (0,)} if (donate and n_idx) else {}
     return jax.jit(fn, **kwargs)
@@ -340,9 +342,8 @@ class DeviceClusterState:
         mutable = {name: self._dev[name] for name in _MUTABLE}
         static = {name: self._dev[name] for name in _STATIC}
         try:
-            new_mutable, rank = fused(
-                mutable, static, idx, rows, *self._pod_args(pods)
-            )
+            out = fused(mutable, static, idx, rows, *self._pod_args(pods))
+            new_mutable, rank = out if n_idx else (None, out)
         except BaseException:
             if n_idx:
                 # the donated mutable buffers may already be consumed, and
