@@ -310,6 +310,98 @@ class BatchScheduler:
         cap = np.where(cand, np.maximum(cap, 1), 0)
         return cap
 
+    def _speculate_dispatch(self, dev, all_buckets, is_pending):
+        """Round 0 of the speculative path: ONE device dispatch runs the
+        whole greedy claim loop (solver/speculate.py megaround) for every
+        eligible bucket jointly. PCI-map-mode types are excluded (their
+        per-switch GPU projection is a native device-pick, not derivable
+        on device) and take the classic rounds. Returns None when nothing
+        is eligible."""
+        from nhd_tpu.solver.kernel import _pad_pow2
+
+        from nhd_tpu.solver.speculate import _T_SHIFT
+
+        bucket_keys, bucket_pods, needs = [], [], []
+        t_total = 0
+        for G, full in all_buckets.items():
+            mask = is_pending[full.pod_index]
+            if not mask.any():
+                continue
+            pods = _filter_types(full, mask)
+            Tp = _pad_pow2(pods.n_types)
+            need = np.bincount(pods.pod_type, minlength=Tp).astype(np.int32)
+            need[: pods.n_types][pods.map_pci] = 0
+            if not need.any():
+                # an all-PCI bucket would solve on every loop iteration
+                # for zero possible claims — leave it to classic rounds
+                continue
+            U, K = dev.cluster.U, dev.cluster.K
+            if (U**pods.G) * (max(K, 1) ** pods.G) * U >= (1 << _T_SHIFT):
+                # the packed claim word's (c*U+m)*A + a field would
+                # overflow (an NHD_TPU_MAX_LATTICE raise can get here):
+                # classic rounds handle any lattice
+                return None
+            bucket_keys.append(G)
+            bucket_pods.append(pods)
+            needs.append(need)
+            t_total += Tp
+        if not bucket_keys or t_total >= (1 << (31 - _T_SHIFT)):
+            # no eligible bucket, or the global type axis would overflow
+            # the claim word's type field
+            return None
+        claims_arr = dev.megaround(bucket_pods, needs, self.respect_busy)
+        return bucket_keys, bucket_pods, claims_arr
+
+    def _expand_speculative(self, spec, cluster):
+        """Expand the megaround's packed claim tensor into the classic
+        round's (claims, bucket_out, node_claimed) shape: pods of a type
+        consume its claims in (iteration, node) order, and the synthetic
+        RankHost carries each claim's (c, m, a) at its rank position for
+        the native apply's gathers."""
+        from nhd_tpu.solver.kernel import _pad_pow2
+        from nhd_tpu.solver.speculate import decode_claims
+
+        bucket_keys, bucket_pods, claims_arr = spec
+        shapes = tuple((p.G, _pad_pow2(p.n_types)) for p in bucket_pods)
+        decoded = decode_claims(
+            claims_arr, shapes, tuple(bucket_keys), cluster.U, cluster.K
+        )
+        claims: List[Tuple[int, int, int, int, int]] = []
+        bucket_out = {}
+        node_claimed: Dict[int, int] = {}
+        for gk, pods in zip(bucket_keys, bucket_pods):
+            per_type = decoded.get(gk, {})
+            by_type: Dict[int, List[int]] = {}
+            for t, pod_i in zip(pods.pod_type, pods.pod_index):
+                by_type.setdefault(int(t), []).append(int(pod_i))
+            T = pods.n_types
+            r_spec = max(
+                (len(v) for v in per_type.values()), default=0
+            ) or 1
+            val = np.zeros((T, r_spec), np.int32)
+            idx = np.zeros((T, r_spec), np.int32)
+            bc = np.zeros((T, r_spec), np.int32)
+            bm = np.zeros((T, r_spec), np.int32)
+            ba = np.zeros((T, r_spec), np.int32)
+            for t, lst in per_type.items():
+                pod_ids = by_type.get(t, [])
+                for j, (n, c, m, a) in enumerate(lst[: len(pod_ids)]):
+                    val[t, j] = 1
+                    idx[t, j] = n
+                    bc[t, j] = c
+                    bm[t, j] = m
+                    ba[t, j] = a
+                    node_claimed.setdefault(n, gk)
+                    claims.append((pod_ids[j], n, gk, t, j))
+            zeros = np.zeros((T, r_spec), np.int32)
+            bucket_out[gk] = (
+                pods,
+                RankHost(val, idx, bc, bm, ba,
+                         np.ones((T, r_spec), np.int32),
+                         zeros, zeros, zeros),
+            )
+        return claims, bucket_out, node_claimed
+
     def _schedule_serial(
         self, nodes, items, indices, results, stats, now, apply
     ) -> None:
@@ -494,6 +586,18 @@ class BatchScheduler:
         # solves for round r+1, dispatched by round r's native-assign path
         # before it materializes results (round pipelining)
         prelaunched = None
+        # speculative on-device multi-round (solver/speculate.py): round 0
+        # runs the whole greedy-round loop in ONE device dispatch and the
+        # host re-verifies its claims through the normal native apply;
+        # anything the native core rejects retries in classic rounds
+        from nhd_tpu.solver.speculate import speculate_enabled
+
+        spec_ok = (
+            apply
+            and dev is not None
+            and dev.mesh is None
+            and speculate_enabled()
+        )
 
         t_batch = time.perf_counter()
         for round_no in range(self.max_rounds):
@@ -569,6 +673,8 @@ class BatchScheduler:
                     launched.append((G, pods, out))
                 return launched
 
+            spec_round = spec_ok and round_no == 0
+            spec = None
             if prelaunched is not None:
                 # round r-1 dispatched this round's solves right after its
                 # native assign; its result materialization ran under the
@@ -578,7 +684,16 @@ class BatchScheduler:
                 prelaunched = None
             else:
                 try:
-                    launched = _dispatch_solves()
+                    if spec_round:
+                        spec = self._speculate_dispatch(
+                            dev, all_buckets, is_pending
+                        )
+                        launched = []
+                    if spec is None:
+                        # nothing to speculate (e.g. all-PCI batch):
+                        # classic round
+                        spec_round = False
+                        launched = _dispatch_solves()
                 except BaseException:
                     if fast_future is not None:
                         try:
@@ -594,17 +709,18 @@ class BatchScheduler:
                 fast = fast_future.result()
                 fast_future = None
             for G, pods, out in launched:
-                # pull results to host once — element reads off jax arrays
-                # cost ~0.2 ms each and the winner loop does three per pod.
-                # Rank outputs are [Tp, R] (padded type rows sliced off
-                # here); np.asarray is zero-copy on the CPU backend, and
-                # `keepalive` holds the owning arrays until the round's
-                # reads are done
+                # pull results to host in ONE transfer — the rank output
+                # is a single packed [9, Tp, R] tensor because each
+                # device→host transfer costs ~84 ms of relay latency on
+                # the tunnel-attached TPU regardless of size (nine
+                # separate field pulls were the round bottleneck,
+                # docs/TPU_STATUS.md). RankHost's fields are zero-copy
+                # row views on CPU; `keepalive` pins the owning array
+                # for the round's lifetime
                 keepalive.append(out)
                 T = pods.n_types
-                bucket_out[G] = (
-                    pods, RankHost(*(np.asarray(x)[:T] for x in out))
-                )
+                arr = np.asarray(out)
+                bucket_out[G] = (pods, RankHost(*arr[:, :T]))
             stats.solve_seconds += time.perf_counter() - t0
 
             t0 = time.perf_counter()
@@ -614,7 +730,15 @@ class BatchScheduler:
             # per node — cross-bucket interleaving on a node would otherwise
             # break the documented serialization order
             node_claimed: Dict[int, int] = {}
-            for G, (pods, out) in bucket_out.items():
+            if spec_round:
+                # the device already ran the whole claim loop — expand its
+                # packed tensor into claims + a RankHost the apply path
+                # reads, exactly like a classic round's selection output;
+                # the per-type capacity select below is skipped entirely
+                claims, bucket_out, node_claimed = self._expand_speculative(
+                    spec, cluster
+                )
+            for G, (pods, out) in ({} if spec_round else bucket_out).items():
                 # candidates arrive pre-ranked from the device (desc sel
                 # value = pref then low-node-index, kernel._get_ranker);
                 # valid prefix length per type:
@@ -675,6 +799,14 @@ class BatchScheduler:
             stats.select_seconds += time.perf_counter() - t0
 
             if not claims:
+                if spec_round:
+                    # an empty speculation is not a saturation verdict —
+                    # fall through to a classic round (keep the round
+                    # timeline aligned for bind-latency percentiles)
+                    stats.round_end_seconds.append(
+                        time.perf_counter() - t_batch
+                    )
+                    continue
                 break  # no pod could be placed: remaining are unschedulable
 
             t0 = time.perf_counter()
@@ -720,14 +852,27 @@ class BatchScheduler:
                 # first claim its node processed and failed (final — it
                 # ran against fresh feasibility); later same-node failures
                 # are stale contention and retry next round. claims.sort()
-                # put winners in pod-index order, and the one-bucket-per-
-                # node rule makes first-occurrence-within-bucket exactly
-                # "first on node this round".
+                # put winners in pod-index order. "First on node" is
+                # tracked ACROSS the per-bucket native calls in their
+                # application order (classic rounds never share a node
+                # between buckets, so the cross-bucket tracking is a
+                # no-op there; the speculative round can share). In the
+                # speculative round NO failure is final — its claims were
+                # solved against projected state mid-loop, not a fresh
+                # snapshot, so every failure retries classically.
                 removed: List[np.ndarray] = []
+                seen_first: set = set()
                 for G, pods, winners, buffers, w_node, w_c, w_m in native_out:
                     ok = buffers[0] >= 0
                     first = np.zeros(len(winners), bool)
-                    first[np.unique(w_node, return_index=True)[1]] = True
+                    if not spec_round:
+                        uniq, fi = np.unique(w_node, return_index=True)
+                        fresh = [
+                            i for u, i in zip(uniq.tolist(), fi.tolist())
+                            if u not in seen_first
+                        ]
+                        first[fresh] = True
+                        seen_first.update(uniq.tolist())
                     pod_arr = np.fromiter(
                         (w[0] for w in winners), np.int64, len(winners)
                     )
@@ -811,8 +956,10 @@ class BatchScheduler:
                         is_first = n not in applied_on_node
                         applied_on_node.add(n)
                         if status_l[w] < 0:
-                            if not is_first:
-                                continue  # stale same-node claim: retry
+                            if not is_first or spec_round:
+                                # stale same-node claim (or a speculative
+                                # claim, never final): retry classically
+                                continue
                             self.logger.error(
                                 f"assignment failed for {item.key} on "
                                 f"{names[n]}: stage {status_l[w]}"
@@ -881,7 +1028,7 @@ class BatchScheduler:
                     try:
                         rec = fast.assign(n, mapping, item.request)
                     except FastAssignError as exc:
-                        if not is_first:
+                        if not is_first or spec_round:
                             continue  # stale same-node claim: retry
                         self.logger.error(
                             f"assignment failed for {item.key} on {node.name}: {exc}"
@@ -923,7 +1070,7 @@ class BatchScheduler:
                 try:
                     nic_list = node.assign_physical_ids(mapping, top)
                 except AssignmentError as exc:
-                    if not is_first:
+                    if not is_first or spec_round:
                         continue  # stale same-node claim: retry
                     # promised mapping didn't materialize (PCI quirk etc.):
                     # fail the pod like the reference (NHDScheduler.py:296-299)

@@ -301,10 +301,11 @@ class DeviceClusterState:
         T = pods.n_types
         return SolveOut(*(x[:T, : self.N] if x.ndim == 2 else x for x in out))
 
-    def solve_ranked(self, pods, R: int) -> RankOut:
-        """Solve + on-device top-R ranking: only [Tp, R] decision tensors
-        leave the device (the free-total gathers read the RESIDENT free
-        arrays, which stage_rows/update_rows keep live between rounds).
+    def solve_ranked(self, pods, R: int) -> jax.Array:
+        """Solve + on-device top-R ranking: only the packed [9, Tp, R]
+        decision tensor leaves the device (the free-total gathers read
+        the RESIDENT free arrays, which stage_rows/update_rows keep live
+        between rounds).
 
         Single device: ONE fused dispatch applies any staged row scatter,
         solves, and ranks (per-call relay latency dominates the round on
@@ -346,16 +347,59 @@ class DeviceClusterState:
             new_mutable, rank = out if n_idx else (None, out)
         except BaseException:
             if n_idx:
-                # the donated mutable buffers may already be consumed, and
-                # the staged indices were popped — rebuild the resident
-                # mutable rows wholesale from the host mirror (source of
-                # truth) so a caller that survives the error keeps a
-                # coherent context
-                for name in _MUTABLE:
-                    self._dev[name] = jnp.asarray(
-                        _pad_rows(getattr(self.cluster, name), self.Np)
-                    )
+                self._rebuild_mutable()
             raise
         if n_idx:
             self._dev.update(new_mutable)
         return rank
+
+    def _rebuild_mutable(self) -> None:
+        """Re-upload the claim-mutated resident arrays wholesale from the
+        host mirror (source of truth) — the recovery path when a dispatch
+        that donated them fails midway."""
+        for name in _MUTABLE:
+            self._dev[name] = jnp.asarray(
+                _pad_rows(getattr(self.cluster, name), self.Np)
+            )
+
+    def megaround(self, bucket_pods: list, needs: list, respect_busy: bool):
+        """Run the speculative on-device multi-round (solver/speculate.py)
+        against the resident arrays: ONE dispatch executes up to
+        spec_iters() claim rounds for every bucket jointly and mutates
+        the resident state with the aggregate claim deltas (donated).
+
+        ``bucket_pods``: PodTypeArrays per bucket, in bucket-dict order;
+        ``needs``: per-bucket int32 [Tp] pending-pod counts (map-PCI type
+        rows zeroed by the caller). Returns the host numpy claims tensor
+        [iters, N] of packed int32 words — ONE pull.
+        Single-device only; callers must check ``self.mesh is None``."""
+        from nhd_tpu.solver.speculate import _get_megaround, spec_iters
+
+        assert self._node_sharding is None
+        self._flush_staged()
+        shapes = tuple(
+            (pods.G, _pad_pow2(pods.n_types)) for pods in bucket_pods
+        )
+        fn = _get_megaround(
+            shapes, self.cluster.U, self.cluster.K, spec_iters(),
+            respect_busy, _scatter_donation(),
+        )
+        pod_args = []
+        for pods in bucket_pods:
+            pod_args.extend(self._pod_args(pods))
+        need = jnp.asarray(np.concatenate(
+            [_pad_rows(n.astype(np.int32), tp) for n, (_, tp) in
+             zip(needs, shapes)]
+        ))
+        mutable = {name: self._dev[name] for name in _MUTABLE}
+        static = {name: self._dev[name] for name in _STATIC}
+        try:
+            new_mutable, claims, _need_left = fn(
+                mutable, static, need, *pod_args
+            )
+        except BaseException:
+            if _scatter_donation():
+                self._rebuild_mutable()
+            raise
+        self._dev.update(new_mutable)
+        return np.asarray(claims)

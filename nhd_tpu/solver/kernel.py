@@ -236,12 +236,17 @@ def pad_nodes(n: int, n_dev: int = 1, floor: int = 8) -> int:
 
 
 class RankOut(NamedTuple):
-    """Per-type TOP-R candidate ranking, computed ON DEVICE so only
-    [T, R] decision tensors ever reach the host (VERDICT r2 item 1: fold
-    candidate ranking into the jitted program; on a tunnel-attached TPU
-    the [T, N] pulls were the round bottleneck). R >= the round's largest
-    per-type pod count, so a capacity>=1 candidate list is never cut
-    short — selection semantics match the old host argsort exactly
+    """Field order of the PACKED per-type top-R ranking tensor.
+
+    The ranking leaves the device as ONE [9, T, R] int32 array whose
+    leading-axis rows are these fields, in this order — nine separate
+    output arrays cost nine device→host transfers, and on the
+    tunnel-attached TPU each transfer pays ~84 ms of relay latency
+    regardless of size (measured: 9 separate [8,512] pulls 756 ms vs one
+    packed pull 77 ms, docs/TPU_STATUS.md). The host slices zero-copy
+    row views back out (solver/batch.py RankHost). R >= the round's
+    largest per-type pod count, so a capacity>=1 candidate list is never
+    cut short — selection semantics match the old host argsort exactly
     (sel value encodes pref then low-node-index tiebreak; lax.top_k
     breaks value ties toward lower index like a stable argsort)."""
 
@@ -259,11 +264,11 @@ class RankOut(NamedTuple):
 
 
 def _rank_body(R, cand, pref, best_c, best_m, best_a, n_picks,
-               gpu_free, cpu_free, hp_free) -> RankOut:
+               gpu_free, cpu_free, hp_free) -> jax.Array:
     """The top-R ranking math, traceable inside any jitted program — the
     standalone ranker below and the fused scatter+solve+rank dispatch
     (solver/device_state.py) share it so their selection semantics cannot
-    drift."""
+    drift. Returns the packed [9, T, R] int32 tensor (RankOut order)."""
     N = cand.shape[1]
     sel = jnp.where(
         cand,
@@ -272,20 +277,21 @@ def _rank_body(R, cand, pref, best_c, best_m, best_a, n_picks,
     )
     val, idx = jax.lax.top_k(sel, R)
     gat = lambda a: jnp.take_along_axis(a, idx, axis=1)
-    return RankOut(
+    return jnp.stack([
         val, idx.astype(jnp.int32),
         gat(best_c), gat(best_m), gat(best_a), gat(n_picks),
         gpu_free.sum(axis=1).astype(jnp.int32)[idx],
         cpu_free.sum(axis=1).astype(jnp.int32)[idx],
         hp_free.astype(jnp.int32)[idx],
-    )
+    ])
 
 
 @lru_cache(maxsize=None)
 def _get_ranker(R: int, out_sharding_key=None):
-    """Jitted top-R ranking over a solve's [T, N] outputs. Cached per R
-    (R is a pow-2 bucket, so a handful of programs total); on a mesh the
-    caller passes a replicated out-sharding via ``out_sharding_key``."""
+    """Jitted top-R ranking over a solve's [T, N] outputs, returning the
+    packed [9, T, R] tensor. Cached per R (R is a pow-2 bucket, so a
+    handful of programs total); on a mesh the caller passes a replicated
+    out-sharding via ``out_sharding_key``."""
 
     def rank(cand, pref, best_c, best_m, best_a, n_picks,
              gpu_free, cpu_free, hp_free):
@@ -295,12 +301,7 @@ def _get_ranker(R: int, out_sharding_key=None):
         )
 
     if out_sharding_key is not None:
-        return jax.jit(
-            rank,
-            out_shardings=RankOut(
-                *([out_sharding_key] * len(RankOut._fields))
-            ),
-        )
+        return jax.jit(rank, out_shardings=out_sharding_key)
     return jax.jit(rank)
 
 
@@ -334,10 +335,10 @@ def rank_budget(max_need: int, n_padded: int, *, accelerator: bool = False) -> i
     return min(n_padded, _pad_pow2(min(max(max_need, 1), cap), floor=64))
 
 
-def solve_bucket_ranked(cluster, pods, R: int) -> RankOut:
+def solve_bucket_ranked(cluster, pods, R: int) -> jax.Array:
     """solve_bucket + on-device top-R ranking, without materializing the
-    [T, N] outputs on host. Returns [Tp, R] arrays — callers slice [:T].
-    """
+    [T, N] outputs on host. Returns the packed [9, Tp, R] tensor —
+    callers slice [:, :T]."""
     N = cluster.n_nodes
     Np = _pad_pow2(N, floor=128 if pallas_enabled() else 8)
 

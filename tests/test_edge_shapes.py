@@ -241,9 +241,13 @@ def test_staged_rows_fuse_into_solve_dispatch():
 
     fresh = DeviceClusterState(cluster)
     want = fresh.solve_ranked(pods, R=8)
-    for name, g, w in zip(got._fields, got, want):
+    from nhd_tpu.solver.kernel import RankOut
+
+    for name, g, w in zip(
+        RankOut._fields, np.asarray(got), np.asarray(want)
+    ):
         np.testing.assert_array_equal(
-            np.asarray(g), np.asarray(w), err_msg=f"RankOut.{name} diverged"
+            g, w, err_msg=f"RankOut row {name} diverged"
         )
     # and the scatter really landed on the resident arrays
     for name in _ARG_ORDER:

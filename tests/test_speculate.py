@@ -1,0 +1,141 @@
+"""Speculative on-device multi-round (solver/speculate.py): the packed
+claim words round-trip, and the end state after a speculative batch is a
+valid execution — all-placed on capacity-matched clusters, conservation
+on random ones — even though placement may differ from the classic
+rounds (claims are re-verified natively either way)."""
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from nhd_tpu.sim import make_cluster
+from nhd_tpu.solver import BatchItem, BatchScheduler
+from tests.test_batch import items, simple_request
+from tests.test_jax_matcher import random_cluster, random_request
+
+
+def spec_scheduler(**kw):
+    """Speculation needs the device-state path; force it on under CPU."""
+    return BatchScheduler(
+        respect_busy=False, register_pods=False, device_state=True,
+        mesh=None, **kw,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _force_spec(monkeypatch):
+    monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+    # small loop depth keeps the CPU-side solves cheap; leftovers take
+    # classic rounds, which is itself part of the path under test
+    monkeypatch.setenv("NHD_TPU_SPEC_ITERS", "8")
+
+
+def test_pack_roundtrip():
+    """decode_claims inverts the device word encoding, per bucket."""
+    from nhd_tpu.solver.speculate import _T_SHIFT, decode_claims
+    from nhd_tpu.solver.combos import get_tables
+
+    U, K = 2, 3
+    shapes = ((1, 8), (2, 8))  # (G, Tp) buckets
+    keys = (1, 2)
+    a1 = get_tables(1, U, K).A
+    a2 = get_tables(2, U, K).A
+    claims = np.full((2, 4), -1, np.int32)
+    # iteration 0: node 1 gets (bucket 1, local t=2, c=1, m=0, a=2)
+    claims[0, 1] = 2 * (1 << _T_SHIFT) + (1 * U + 0) * a1 + 2
+    # iteration 1: node 3 gets (bucket 2, local t=1, c=3, m=1, a=5)
+    tg = 8 + 1
+    claims[1, 3] = tg * (1 << _T_SHIFT) + (3 * U + 1) * a2 + 5
+    out = decode_claims(claims, shapes, keys, U, K)
+    assert out[1] == {2: [(1, 1, 0, 2)]}
+    assert out[2] == {1: [(3, 3, 1, 5)]}
+
+
+def test_speculative_places_all_on_capacity_matched():
+    """The headline shape in miniature: every pod places, and almost all
+    of them in the speculative round 0 (no classic retries needed)."""
+    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+
+    nodes = cap_cluster(32, ["default", "edge", "batch"])
+    reqs = workload_mix(300, ["default", "edge", "batch"])
+    results, stats = spec_scheduler().schedule(nodes, items(reqs), now=0.0)
+    placed = sum(1 for r in results if r.node)
+    assert placed == 300
+    assert stats.failed == 0
+    in_round0 = sum(1 for r in results if r.node and r.round_no == 0)
+    assert in_round0 >= 250, f"only {in_round0}/300 placed speculatively"
+
+
+def test_speculative_end_state_is_valid_and_conserving():
+    """Random heterogeneous cluster: whatever the speculation proposes,
+    the natively-verified end state never oversubscribes a resource.
+    Totals may deviate from the classic rounds by greedy-packing noise
+    (measured ±2 over 20 seeds at 60 pods, net -0.25% — documented in
+    solver/speculate.py), but never materially."""
+    rng = random.Random(11)
+    reqs = [random_request(rng) for _ in range(60)]
+    nodes_s = random_cluster(rng, 12)
+    nodes_c = copy.deepcopy(nodes_s)
+    capacity = {name: n.total_gpus() for name, n in nodes_s.items()}
+
+    rs, ss = spec_scheduler().schedule(nodes_s, items(reqs), now=1010.0)
+    rc, sc = BatchScheduler(
+        respect_busy=False, register_pods=False, device_state=False,
+        mesh=None,
+    ).schedule(nodes_c, items(reqs), now=1010.0)
+
+    assert ss.scheduled == sum(1 for r in rs if r.node)
+    assert abs(ss.scheduled - sc.scheduled) <= max(2, sc.scheduled // 20), (
+        f"speculative {ss.scheduled} vs classic {sc.scheduled}"
+    )
+    for name, n in nodes_s.items():
+        assert 0 <= n.free_gpu_count() <= capacity[name]
+        assert all(c >= 0 for c in n.free_cpu_cores_per_numa())
+        assert n.mem.free_hugepages_gb >= 0
+        for nic in n.nics:
+            rx, tx = nic.free_bw()
+            assert rx >= 0 and tx >= 0
+
+
+def test_pci_pods_fall_through_to_classic_rounds():
+    """PCI-map-mode pods are excluded from the megaround but still place
+    via the classic rounds of the same schedule() call."""
+    from nhd_tpu.core.topology import MapMode
+
+    nodes = make_cluster(4)
+    reqs = [simple_request(gpus=1) for _ in range(6)]
+    pci = [r.with_map_mode(MapMode.PCI) if hasattr(r, "with_map_mode")
+           else r for r in reqs]
+    # PodRequest is frozen; rebuild with PCI map mode
+    from dataclasses import replace
+
+    pci = [replace(r, map_mode=MapMode.PCI) for r in reqs[:3]]
+    mixed = reqs[:3] + pci
+    results, stats = spec_scheduler().schedule(nodes, items(mixed), now=0.0)
+    placed = sum(1 for r in results if r.node)
+    assert placed == len(mixed)
+    # the NUMA pods went speculatively (round 0); PCI pods classically
+    numa_rounds = {r.round_no for r in results[:3]}
+    pci_rounds = {r.round_no for r in results[3:]}
+    assert numa_rounds == {0}
+    assert all(rn >= 1 for rn in pci_rounds)
+
+
+def test_respect_busy_one_gpu_pod_per_node():
+    """With the busy back-off on, the speculative loop must respect the
+    one-GPU-pod-per-node-per-window rule exactly like classic rounds
+    (reference Matcher.py:103-111)."""
+    from collections import Counter
+
+    nodes = make_cluster(3)
+    reqs = [simple_request(gpus=1) for _ in range(9)]
+    sched = BatchScheduler(
+        respect_busy=True, register_pods=False, device_state=True,
+        mesh=None,
+    )
+    results, stats = sched.schedule(nodes, items(reqs), now=0.0)
+    per_node = Counter(r.node for r in results if r.node)
+    assert all(v == 1 for v in per_node.values()), per_node
+    assert sum(per_node.values()) == 3  # one per node, rest deferred

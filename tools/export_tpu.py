@@ -71,18 +71,18 @@ _registered = False
 
 
 def register_solveout_serialization() -> None:
+    # SolveOut still crosses the export boundary (the plain solver
+    # artifacts); the ranked artifacts now return one packed int32
+    # tensor (kernel._rank_body), so RankOut needs no registration
     global _registered
     if _registered:
         return
     from jax import export as jexport
 
-    from nhd_tpu.solver.kernel import RankOut, SolveOut
+    from nhd_tpu.solver.kernel import SolveOut
 
     jexport.register_namedtuple_serialization(
         SolveOut, serialized_name="nhd_tpu.solver.kernel.SolveOut"
-    )
-    jexport.register_namedtuple_serialization(
-        RankOut, serialized_name="nhd_tpu.solver.kernel.RankOut"
     )
     _registered = True
 
