@@ -437,7 +437,8 @@ class BatchScheduler:
             stats.scheduled += 1
 
     def make_context(
-        self, nodes: Dict[str, HostNode], *, now: Optional[float] = None
+        self, nodes: Dict[str, HostNode], *, now: Optional[float] = None,
+        interner=None,
     ) -> ScheduleContext:
         """Encode *nodes* once into a reusable ScheduleContext.
 
@@ -445,11 +446,14 @@ class BatchScheduler:
         (the streaming tile pattern): the encode, FastCluster arrays, and
         device-resident state all persist, and each call pays only for the
         rows its claims touch. Busy stamps are resolved against *now* once,
-        at context creation.
+        at context creation. ``interner``: share one GroupInterner across
+        several contexts so pod encodes (group_mask bit positions) are
+        valid against every one of them — the streaming tiler passes its
+        batch-wide interner here.
         """
         if now is None:
             now = time.monotonic()
-        cluster = encode_cluster(nodes, now=now)
+        cluster = encode_cluster(nodes, now=now, interner=interner)
         if not self.respect_busy:
             cluster.busy[:] = False
         fast = (
@@ -477,6 +481,8 @@ class BatchScheduler:
         now: Optional[float] = None,
         apply: bool = True,
         context: Optional[ScheduleContext] = None,
+        encoded: Optional[Dict[int, "PodTypeArrays"]] = None,
+        offer: Optional[Sequence[int]] = None,
     ) -> Tuple[List[BatchAssignment], BatchStats]:
         """Place every item it can; mutates ``nodes`` when ``apply``.
 
@@ -488,16 +494,33 @@ class BatchScheduler:
         per-call encode and array construction are skipped; combo-oversized
         pods are rejected there (the caller pre-routes them — see
         solver/streaming.py).
+
+        ``encoded``/``offer``: reuse a prior encode_pods of the FULL
+        ``items`` list (built against the context cluster's interner) and
+        restrict the schedulable set to the ``offer`` indices — the
+        streaming tiler encodes each pod chunk once and offers shrinking
+        subsets of it to successive tiles, instead of re-encoding (and
+        re-hashing) the leftovers per tile. With ``offer``, result slots
+        outside the offer are None (not allocated — a late spill offers a
+        handful of pods out of a 100k chunk); the caller reads only the
+        offered indices.
         """
         from nhd_tpu.sim.requests import request_to_topology
 
         stats = BatchStats()
-        results: List[BatchAssignment] = [
-            BatchAssignment(it.key, None) for it in items
-        ]
+        if offer is None:
+            results: List[Optional[BatchAssignment]] = [
+                BatchAssignment(it.key, None) for it in items
+            ]
+        else:
+            results = [None] * len(items)
+            for i in offer:
+                results[i] = BatchAssignment(items[i].key, None)
         pending: List[int] = [
-            i for i, it in enumerate(items)
-            if it.request.map_mode in (MapMode.NUMA, MapMode.PCI)
+            i for i in (
+                range(len(items)) if offer is None else offer
+            )
+            if items[i].request.map_mode in (MapMode.NUMA, MapMode.PCI)
         ]
         if now is None:
             now = context.now if context is not None else time.monotonic()
@@ -609,9 +632,10 @@ class BatchScheduler:
             try:
                 if all_buckets is None:
                     # type-level tensors never change across rounds —
-                    # encode the whole pending set once and only filter
+                    # encode the whole pending set once (or reuse the
+                    # caller's chunk-wide encode) and only filter
                     # membership below
-                    all_buckets = encode_pods(
+                    all_buckets = encoded if encoded is not None else encode_pods(
                         [items[i].request for i in pending],
                         cluster.interner,
                         indices=pending,

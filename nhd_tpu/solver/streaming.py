@@ -217,6 +217,48 @@ class StreamingScheduler:
                 if results[i].node is not None:
                     results[i].round_no = len(stats.round_end_seconds) - 1
 
+        # one interner shared by every tile context so a chunk's pod
+        # encode (group_mask bit positions) is valid against all of them
+        # — each chunk is encoded ONCE and re-offered to successive tiles
+        # via schedule(encoded=..., offer=...) instead of re-encoding
+        # (and re-hashing) the leftovers per tile. Sharing turns the
+        # 63-bit group-mask budget federation-wide, so it only engages
+        # when the whole batch's distinct groups fit with margin;
+        # otherwise every sub-call encodes per tile exactly as before.
+        # Eligible groups are pre-interned here, SORTED, so worker-side
+        # encodes never mutate the interner (no lock; deterministic bits).
+        from nhd_tpu.solver.encode import GroupInterner, encode_pods
+
+        all_groups = set().union(frozenset(), *tile_groups)
+        for i in schedulable:
+            all_groups |= items[i].request.node_groups
+        share_enc = len(all_groups) <= 48
+        interner = None
+        if share_enc:
+            interner = GroupInterner()
+            interner.mask(sorted(all_groups))
+        # per-chunk encode cache: cid -> (items, buckets, global->local);
+        # a chunk lives in exactly one tile queue at a time, so per-cid
+        # calls never race
+        chunk_enc: Dict[int, tuple] = {}
+
+        def chunk_encoded(cid: int, global_ids: List[int]):
+            """First call (the chunk's first tile offer) encodes the full
+            chunk; later offers are shrinking subsets of the same ids and
+            hit the cache."""
+            got = chunk_enc.get(cid)
+            if got is None:
+                sub_items = [items[g] for g in global_ids]
+                buckets = encode_pods(
+                    [it.request for it in sub_items], interner
+                )
+                got = chunk_enc[cid] = (
+                    sub_items,
+                    buckets,
+                    {g: j for j, g in enumerate(global_ids)},
+                )
+            return got
+
         contexts: List[Optional[ScheduleContext]] = [None] * len(tiles)
         # per-tile saturation certificates: a request type that came back
         # unschedulable from a tile stays unschedulable there for the rest
@@ -257,12 +299,26 @@ class StreamingScheduler:
             if not offer:
                 return pending
             if contexts[ti] is None:
-                contexts[ti] = self.batch.make_context(tiles[ti], now=now)
-            sub_items = [items[i] for i in offer]
+                contexts[ti] = self.batch.make_context(
+                    tiles[ti], now=now, interner=interner
+                )
             t_sub = time.perf_counter()
-            sub_results, sub_stats = self.batch.schedule(
-                tiles[ti], sub_items, now=now, context=contexts[ti]
-            )
+            if share_enc:
+                sub_items, encoded, local_of = chunk_encoded(
+                    chunk_id, pending
+                )
+                sub_results, sub_stats = self.batch.schedule(
+                    tiles[ti], sub_items, now=now, context=contexts[ti],
+                    encoded=encoded, offer=[local_of[i] for i in offer],
+                )
+                sub_results = [sub_results[local_of[i]] for i in offer]
+            else:
+                # >48 distinct groups: per-tile interners, per-offer
+                # encode (the pre-sharing behavior)
+                sub_items = [items[i] for i in offer]
+                sub_results, sub_stats = self.batch.schedule(
+                    tiles[ti], sub_items, now=now, context=contexts[ti]
+                )
             # merge: remap round numbers into the streaming timeline
             with lock:
                 offset = len(stats.round_end_seconds)

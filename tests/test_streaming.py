@@ -172,6 +172,31 @@ def test_streaming_over_mesh_equals_single_device():
     assert outs["mesh"] == outs["single"]
 
 
+def test_many_groups_fall_back_to_per_tile_interners():
+    """A federation with more distinct node groups than the shared
+    group-mask budget (48) must still schedule: the once-per-chunk
+    encode disengages and each tile encodes its offers against its own
+    interner, exactly like the pre-sharing behavior."""
+    from dataclasses import replace
+
+    n_groups = 60
+    group_names = [f"region{i:02d}" for i in range(n_groups)]
+    nodes = make_cluster(n_groups, groups=group_names)
+    reqs = [
+        replace(simple_request(gpus=i % 2),
+                node_groups=frozenset({group_names[i % n_groups]}))
+        for i in range(n_groups)
+    ]
+    results, stats = StreamingScheduler(
+        tile_nodes=16, chunk_pods=25, respect_busy=False
+    ).schedule(nodes, items(reqs), now=0.0)
+    placed = [r for r in results if r.node]
+    assert len(placed) == n_groups
+    # each pod landed on a node carrying its group
+    for r, req in zip(results, reqs):
+        assert set(nodes[r.node].groups) & req.node_groups
+
+
 def test_round_cap_does_not_certify_exhaustion(monkeypatch):
     """A max_rounds-capped sub-call can leave feasible pods unplaced
     mid-retry (with tile capacity remaining); that must NOT poison the
