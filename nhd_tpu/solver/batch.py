@@ -224,11 +224,24 @@ class BatchScheduler:
         # "auto": resident device arrays + per-round row scatters pay off on
         # real accelerators (especially across a tunnel/PCIe) but are pure
         # overhead on the CPU backend, where solve inputs are already host
-        # memory
+        # memory. NHD_TPU_DEVICE_STATE=1/0 overrides "auto" from the
+        # environment — chaos/soak runs use it to drive the resident-state
+        # (and, with NHD_TPU_SPECULATE=1, the speculative) path through
+        # the full scheduler on CPU
         if device_state not in (True, False, "auto"):
             raise ValueError(
                 f"device_state must be True, False or 'auto', got {device_state!r}"
             )
+        if device_state == "auto":
+            import os
+
+            env = os.environ.get("NHD_TPU_DEVICE_STATE")
+            if env is not None:
+                if env not in ("0", "1"):
+                    raise ValueError(
+                        f"NHD_TPU_DEVICE_STATE must be 0 or 1, got {env!r}"
+                    )
+                device_state = env == "1"
         self.device_state = device_state
         # mesh: "auto" → shard the solve over every visible device whenever
         # more than one exists (the production multi-chip path, SURVEY §7

@@ -22,6 +22,21 @@ def test_chaos_with_restarts_replays_consistently():
     assert stats.restarts >= 1
 
 
+def test_chaos_through_speculative_device_path(monkeypatch):
+    """The same churn storm with the resident-device-state AND the
+    speculative on-device multi-round forced on (the accelerator
+    production path, driven on CPU): every conservation invariant must
+    hold — speculative claims are natively re-verified, so chaos-driven
+    drift/rollback must behave exactly like the classic rounds."""
+    monkeypatch.setenv("NHD_TPU_DEVICE_STATE", "1")
+    monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+    monkeypatch.setenv("NHD_TPU_SPEC_ITERS", "6")
+    sim = ChaosSim(seed=13, n_nodes=4)
+    stats = sim.run(steps=60)
+    assert stats.violations == []
+    assert stats.created > 10
+
+
 def test_chaos_through_streaming_scheduler_path(monkeypatch):
     """Same churn storm with every scheduler batch routed through the
     streaming tiler (NHD_STREAM_NODES forced to 1) — the federation-scale
