@@ -6,10 +6,18 @@ import __graft_entry__ as graft
 
 
 def test_entry_compiles_and_runs():
+    import numpy as np
+
     fn, args = graft.entry()
-    out = jax.jit(fn)(*args)
-    assert out.cand.shape[0] >= 1
-    assert out.cand.shape == out.best_c.shape
+    mutable, claims, need_left = jax.jit(fn)(*args)
+    # the megaround made real claims and consumed real need
+    claims = np.asarray(claims)
+    assert claims.ndim == 2 and (claims >= 0).sum() > 0
+    assert int(np.asarray(need_left).sum()) < int(np.asarray(args[2]).sum())
+    # the claimed state mutated (GPUs were consumed)
+    assert not np.array_equal(
+        np.asarray(mutable["gpu_free"]), np.asarray(args[0]["gpu_free"])
+    )
 
 
 def test_dryrun_multichip_8():
