@@ -631,7 +631,6 @@ class BatchScheduler:
         spec_ok = (
             apply
             and dev is not None
-            and dev.mesh is None
             and speculate_enabled()
         )
 

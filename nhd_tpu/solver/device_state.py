@@ -206,11 +206,18 @@ class DeviceClusterState:
         # (single-device path only — the mesh path applies immediately)
         self._staged: set = set()
         for name in _ARG_ORDER:
-            padded = _pad_rows(getattr(cluster, name), self.Np)
-            if self._node_sharding is not None:
-                self._dev[name] = jax.device_put(padded, self._node_sharding)
-            else:
-                self._dev[name] = jnp.asarray(padded)
+            self._dev[name] = self._put(
+                _pad_rows(getattr(cluster, name), self.Np)
+            )
+
+    def _put(self, padded: np.ndarray) -> jax.Array:
+        """Upload one padded node array with the resident placement —
+        node-sharded on a mesh, plain on a single device. The single
+        placement rule the initial upload and every recovery re-upload
+        share."""
+        if self._node_sharding is not None:
+            return jax.device_put(padded, self._node_sharding)
+        return jnp.asarray(padded)
 
     def stage_rows(self, indices: Iterable[int]) -> None:
         """Mark claimed nodes whose host-mirror rows must reach the device
@@ -358,7 +365,7 @@ class DeviceClusterState:
         host mirror (source of truth) — the recovery path when a dispatch
         that donated them fails midway."""
         for name in _MUTABLE:
-            self._dev[name] = jnp.asarray(
+            self._dev[name] = self._put(
                 _pad_rows(getattr(self.cluster, name), self.Np)
             )
 
@@ -371,18 +378,27 @@ class DeviceClusterState:
         ``bucket_pods``: PodTypeArrays per bucket, in bucket-dict order;
         ``needs``: per-bucket int32 [Tp] pending-pod counts (map-PCI type
         rows zeroed by the caller). Returns the host numpy claims tensor
-        [iters, N] of packed int32 words — ONE pull.
-        Single-device only; callers must check ``self.mesh is None``."""
+        [iters, N] of packed int32 words — ONE pull. On a mesh the same
+        program runs SPMD over the node-sharded resident arrays
+        (claims bit-identical to single-device; the megaround docstring
+        has the sharding story)."""
         from nhd_tpu.solver.speculate import _get_megaround, spec_iters
 
-        assert self._node_sharding is None
         self._flush_staged()
         shapes = tuple(
             (pods.G, _pad_pow2(pods.n_types)) for pods in bucket_pods
         )
+        out_shardings_key = None
+        if self._node_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            out_shardings_key = (
+                self._node_sharding, NamedSharding(self.mesh, P())
+            )
         fn = _get_megaround(
             shapes, self.cluster.U, self.cluster.K, spec_iters(),
             respect_busy, _scatter_donation(),
+            out_shardings_key=out_shardings_key,
         )
         pod_args = []
         for pods in bucket_pods:

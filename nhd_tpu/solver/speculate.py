@@ -101,8 +101,17 @@ def _get_megaround(
     iters: int,
     respect_busy: bool,
     donate: bool,
+    out_shardings_key=None,  # (node_sharding, replicated) on a mesh
 ):
     """The jitted multi-bucket claim loop for one batch shape.
+
+    On a multi-device mesh the SAME program runs SPMD: the resident node
+    arrays arrive node-sharded, GSPMD partitions the loop (the per-node
+    election's argmax/argsort over the node axis induce the collectives),
+    and the claims come back bit-identical to the single-device run
+    (pinned by tests/test_speculate.py). ``out_shardings_key`` keeps the
+    updated mutable arrays node-sharded for the classic sharded solves
+    that may follow.
 
     Args (all device arrays):
       mutable: dict of the 6 claim-mutated node arrays (device_state)
@@ -115,7 +124,7 @@ def _get_megaround(
     """
     # the single node-array order contract lives in device_state; import
     # here (device_state imports THIS module lazily, so no cycle)
-    from nhd_tpu.solver.device_state import _ARG_ORDER
+    from nhd_tpu.solver.device_state import _ARG_ORDER, _MUTABLE
 
     tables = [get_tables(G, U, K) for G, _ in bucket_shapes]
     offsets = np.cumsum([0] + [tp for _, tp in bucket_shapes])
@@ -306,6 +315,13 @@ def _get_megaround(
         return mutable, claims, need
 
     kwargs = {"donate_argnums": (0,)} if donate else {}
+    if out_shardings_key is not None:
+        node_sharding, replicated = out_shardings_key
+        kwargs["out_shardings"] = (
+            {name: node_sharding for name in _MUTABLE},
+            replicated,
+            replicated,
+        )
     return jax.jit(fn, **kwargs)
 
 

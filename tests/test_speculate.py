@@ -123,6 +123,31 @@ def test_pci_pods_fall_through_to_classic_rounds():
     assert all(rn >= 1 for rn in pci_rounds)
 
 
+def test_speculative_mesh_equals_single_device():
+    """The megaround runs SPMD over the 8-device mesh (GSPMD partitions
+    the while_loop; the election's node-axis reductions become
+    collectives) with placements BIT-IDENTICAL to the single-device
+    speculative run — the multi-chip production path speculates too."""
+    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+
+    reqs = workload_mix(200, ["default", "edge", "batch"])
+    outs = {}
+    for label, mesh in (("mesh", "auto"), ("single", None)):
+        nodes = cap_cluster(16, ["default", "edge", "batch"])
+        results, stats = BatchScheduler(
+            respect_busy=False, register_pods=False, device_state=True,
+            mesh=mesh,
+        ).schedule(nodes, items(reqs), now=0.0)
+        outs[label] = (
+            [(r.node, r.mapping, r.round_no) for r in results],
+            stats.scheduled,
+        )
+    assert outs["mesh"] == outs["single"]
+    assert outs["mesh"][1] == sum(
+        1 for n, _, _ in outs["mesh"][0] if n
+    ) > 0
+
+
 def test_respect_busy_one_gpu_pod_per_node():
     """With the busy back-off on, the speculative loop must respect the
     one-GPU-pod-per-node-per-window rule exactly like classic rounds
