@@ -211,6 +211,14 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40,
         f"serial baseline {per_pod * 1e3:.2f} ms/pod -> est {baseline_wall:.1f}s; "
         f"speedup {speedup:.0f}x"
     )
+    if stats.phases:
+        # the overhead war's tracked metric (VERDICT r3 item 8): per-phase
+        # wall + device-utilization proxy (solve-active / wall)
+        detail = " ".join(
+            f"{k}={v * 1e3:.0f}ms" for k, v in sorted(stats.phases.items())
+        )
+        util = 100.0 * stats.solve_seconds / wall if wall > 0 else 0.0
+        _log(f"bench[{name}]: phases {detail}; solve-active/wall {util:.0f}%")
     return {"wall": wall, "placed": placed, "speedup": speedup}
 
 

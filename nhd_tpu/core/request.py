@@ -19,15 +19,28 @@ from nhd_tpu.core.topology import MapMode, PodTopology, SmtMode
 
 def _field_key(self) -> tuple:
     """All dataclass fields, in declaration order — mechanically derived
-    so hash and eq can never drift from the field set. The field-name
-    tuple is resolved once per class: dataclasses.fields() per call costs
-    ~6 µs and this runs per eq/first-hash of every pod in a 100k batch."""
+    so hash and eq can never drift from the field set. Nested request
+    dataclasses are replaced by their own (primitive) keys, so the result
+    is a tuple tree of primitives that compares at C speed — and it is
+    CACHED on the instance: the pod-dedupe dict (encode_pods) runs one
+    __eq__ per pod of a 10k gang, and rebuilding the tuple per probe was
+    ~60% of the whole encode phase."""
+    cached = self.__dict__.get("_keyt")
+    if cached is not None:
+        return cached
     cls = self.__class__
     names = cls.__dict__.get("_field_names")
     if names is None:
         names = tuple(f.name for f in fields(self))
         cls._field_names = names
-    return tuple(getattr(self, n) for n in names)
+    key = tuple(
+        tuple(x._key() for x in v)
+        if isinstance(v, tuple) and v and hasattr(v[0], "_key")
+        else (v._key() if hasattr(v, "_key") else v)
+        for v in (getattr(self, n) for n in names)
+    )
+    object.__setattr__(self, "_keyt", key)
+    return key
 
 
 def _cached_hash(self) -> int:

@@ -167,6 +167,19 @@ def _accelerator_backend() -> bool:
         return False
 
 
+def _cpu_small_max() -> int:
+    """Pending-pod count at or below which a round's solves run on the
+    HOST CPU backend instead of the accelerator: every accelerator
+    dispatch+sync pays the relay turnaround (~65 ms on the tunnel TPU,
+    docs/TPU_STATUS.md), while the same jitted solve on the host CPU takes
+    ~5-30 ms at benchmark shapes — so small batches and few-pod tail
+    rounds are faster OFF the chip. Same program, same semantics; only
+    the placement device changes."""
+    import os
+
+    return int(os.environ.get("NHD_TPU_CPU_SMALL", "1024"))
+
+
 @dataclass
 class BatchStats:
     rounds: int = 0
@@ -178,6 +191,12 @@ class BatchStats:
     # elapsed seconds from batch start to the end of each round — a pod
     # placed in round r has bind latency <= round_end_seconds[r]
     round_end_seconds: List[float] = field(default_factory=list)
+    # fine-grained wall breakdown (encode / spec_dispatch / spec_pull /
+    # native_assign / materialize) — the overhead war's tracked metric
+    phases: Dict[str, float] = field(default_factory=dict)
+
+    def phase_add(self, name: str, dt: float) -> None:
+        self.phases[name] = self.phases.get(name, 0.0) + dt
 
     def bind_latency_percentile(self, results, q: float) -> float:
         """p-th percentile bind latency over placed pods (seconds)."""
@@ -362,50 +381,119 @@ class BatchScheduler:
             # no eligible bucket, or the global type axis would overflow
             # the claim word's type field
             return None
-        claims_arr = dev.megaround(bucket_pods, needs, self.respect_busy)
-        return bucket_keys, bucket_pods, claims_arr
-
-    def _expand_speculative(self, spec, cluster):
-        """Expand the megaround's packed claim tensor into the classic
-        round's (claims, bucket_out, node_claimed) shape: pods of a type
-        consume its claims in (iteration, node) order, and the synthetic
-        RankHost carries each claim's (c, m, a) at its rank position for
-        the native apply's gathers."""
-        from nhd_tpu.solver.kernel import _pad_pow2
-        from nhd_tpu.solver.speculate import decode_claims
-
-        bucket_keys, bucket_pods, claims_arr = spec
-        shapes = tuple((p.G, _pad_pow2(p.n_types)) for p in bucket_pods)
-        decoded = decode_claims(
-            claims_arr, shapes, tuple(bucket_keys), cluster.U, cluster.K
+        # returns the IN-FLIGHT device (claims, counts) tensors: the
+        # dispatch is async, so the caller overlaps host prep (FastCluster
+        # join) under the relay turnaround before pulling them (np.asarray)
+        claims_arr, counts_arr = dev.megaround(
+            bucket_pods, needs, self.respect_busy
         )
-        claims: List[Tuple[int, int, int, int, int]] = []
-        bucket_out = {}
+        return bucket_keys, bucket_pods, claims_arr, counts_arr
+
+    def _expand_speculative(self, spec, claims_np, counts_np, cluster):
+        """Expand the megaround's packed claim tensor into per-bucket
+        winner ARRAYS: pods of a type consume its claims in (iteration,
+        node) order, re-sorted to pod-index order within the bucket (the
+        classic apply order). Returns
+        ({G: (pods, w_pod, w_node, w_type, w_c, w_m, w_a)}, node_claimed)
+        with every w_* an int32 numpy array — at gang scale this path
+        handles ~10k claims and per-claim Python tuples were the
+        measurable cost of the select phase."""
+        from nhd_tpu.solver.kernel import _pad_pow2
+        from nhd_tpu.solver.speculate import decode_claims_grouped
+
+        bucket_keys, bucket_pods = spec[0], spec[1]
+        shapes = tuple((p.G, _pad_pow2(p.n_types)) for p in bucket_pods)
+        decoded = decode_claims_grouped(
+            claims_np, shapes, tuple(bucket_keys), cluster.U, cluster.K,
+            counts_np,
+        )
+        out = {}
         node_claimed: Dict[int, int] = {}
         for gk, pods in zip(bucket_keys, bucket_pods):
             per_type = decoded.get(gk, {})
-            by_type: Dict[int, List[int]] = {}
-            for t, pod_i in zip(pods.pod_type, pods.pod_index):
-                by_type.setdefault(int(t), []).append(int(pod_i))
+            if not per_type:
+                continue
+            # pod ids per type in pod-index order: pod_index is ascending
+            # within the encode, so a stable sort by type keeps it
+            order = np.argsort(pods.pod_type, kind="stable")
+            types_sorted = pods.pod_type[order]
+            podid_sorted = pods.pod_index[order]
+            t_vals, t_starts = np.unique(types_sorted, return_index=True)
+            t_bounds = np.append(t_starts, len(types_sorted))
+            t_slice = {
+                int(t): (int(lo), int(hi))
+                for t, lo, hi in zip(t_vals, t_bounds[:-1], t_bounds[1:])
+            }
+            cols: List[List[np.ndarray]] = [[] for _ in range(6)]
+            for t, (nds, cs, ms, As) in per_type.items():
+                span = t_slice.get(int(t))
+                if span is None:
+                    continue
+                lo, hi = span
+                k = min(hi - lo, len(nds))
+                if k == 0:
+                    continue
+                cols[0].append(podid_sorted[lo : lo + k])
+                cols[1].append(nds[:k])
+                cols[2].append(np.full(k, int(t), np.int64))
+                cols[3].append(cs[:k])
+                cols[4].append(ms[:k])
+                cols[5].append(As[:k])
+            if not cols[0]:
+                continue
+            w_pod, w_node, w_type, w_c, w_m, w_a = (
+                np.concatenate(c) for c in cols
+            )
+            o = np.argsort(w_pod, kind="stable")
+            entry = (
+                pods,
+                np.ascontiguousarray(w_pod[o], np.int64),
+                np.ascontiguousarray(w_node[o], np.int32),
+                np.ascontiguousarray(w_type[o], np.int32),
+                np.ascontiguousarray(w_c[o], np.int32),
+                np.ascontiguousarray(w_m[o], np.int32),
+                np.ascontiguousarray(w_a[o], np.int32),
+            )
+            out[gk] = entry
+            for n in np.unique(w_node).tolist():
+                node_claimed.setdefault(int(n), gk)
+        return out, node_claimed
+
+    @staticmethod
+    def _spec_tuples(expanded):
+        """Adapter for the object-assignment fallback: per-bucket winner
+        arrays → (claims tuples, bucket_out with a synthetic RankHost
+        carrying each claim's (c, m, a) at its rank position)."""
+        claims: List[Tuple[int, int, int, int, int]] = []
+        bucket_out = {}
+        for gk, (pods, w_pod, w_node, w_type, w_c, w_m, w_a) in (
+            expanded.items()
+        ):
             T = pods.n_types
-            r_spec = max(
-                (len(v) for v in per_type.values()), default=0
-            ) or 1
+            counts = np.bincount(w_type, minlength=T)
+            r_spec = int(counts.max(initial=0)) or 1
             val = np.zeros((T, r_spec), np.int32)
             idx = np.zeros((T, r_spec), np.int32)
             bc = np.zeros((T, r_spec), np.int32)
             bm = np.zeros((T, r_spec), np.int32)
             ba = np.zeros((T, r_spec), np.int32)
-            for t, lst in per_type.items():
-                pod_ids = by_type.get(t, [])
-                for j, (n, c, m, a) in enumerate(lst[: len(pod_ids)]):
-                    val[t, j] = 1
-                    idx[t, j] = n
-                    bc[t, j] = c
-                    bm[t, j] = m
-                    ba[t, j] = a
-                    node_claimed.setdefault(n, gk)
-                    claims.append((pod_ids[j], n, gk, t, j))
+            # rank position = per-type claim ordinal, in (iter, node)
+            # order; winners are pod-sorted but pods of one type consume
+            # claims in order, so the per-type ordinal is the running
+            # count of that type among the sorted winners
+            seen = np.zeros(T, np.int64)
+            for pod_i, n, t, c, m, a in zip(
+                w_pod.tolist(), w_node.tolist(), w_type.tolist(),
+                w_c.tolist(), w_m.tolist(), w_a.tolist(),
+            ):
+                j = int(seen[t])
+                seen[t] += 1
+                val[t, j] = 1
+                idx[t, j] = n
+                bc[t, j] = c
+                bm[t, j] = m
+                ba[t, j] = a
+                claims.append((pod_i, n, gk, t, j))
             zeros = np.zeros((T, r_spec), np.int32)
             bucket_out[gk] = (
                 pods,
@@ -413,7 +501,8 @@ class BatchScheduler:
                          np.ones((T, r_spec), np.int32),
                          zeros, zeros, zeros),
             )
-        return claims, bucket_out, node_claimed
+        claims.sort()
+        return claims, bucket_out
 
     def _schedule_serial(
         self, nodes, items, indices, results, stats, now, apply
@@ -521,14 +610,10 @@ class BatchScheduler:
         from nhd_tpu.sim.requests import request_to_topology
 
         stats = BatchStats()
-        if offer is None:
-            results: List[Optional[BatchAssignment]] = [
-                BatchAssignment(it.key, None) for it in items
-            ]
-        else:
-            results = [None] * len(items)
-            for i in offer:
-                results[i] = BatchAssignment(items[i].key, None)
+        # results materialize lazily: placed pods get their real entry at
+        # assignment, unplaced offered slots are back-filled before return
+        # (building 10k placeholder objects up front was measurable wall)
+        results: List[Optional[BatchAssignment]] = [None] * len(items)
         pending: List[int] = [
             i for i in (
                 range(len(items)) if offer is None else offer
@@ -552,12 +637,18 @@ class BatchScheduler:
 
         # combo lattices too large for dense enumeration take the serial
         # oracle path up front — claims land on the host mirror before the
-        # batched state is snapshotted below
+        # batched state is snapshotted below (tractability memoized per
+        # group count: one bucket verdict covers a whole gang)
+        _tract: Dict[int, bool] = {}
+
+        def _tractable(G: int) -> bool:
+            v = _tract.get(G)
+            if v is None:
+                v = _tract[G] = bucket_tractable(G, cluster.U, cluster.K)
+            return v
+
         oversized = [
-            i for i in pending
-            if not bucket_tractable(
-                items[i].request.n_groups, cluster.U, cluster.K
-            )
+            i for i in pending if not _tractable(items[i].request.n_groups)
         ]
         if oversized and context is not None:
             # serial claims would mutate the HostNode mirror behind the
@@ -652,6 +743,7 @@ class BatchScheduler:
                         cluster.interner,
                         indices=pending,
                     )
+                    stats.phase_add("encode", time.perf_counter() - t0)
                     # R >= the largest per-type pod count: every ranked
                     # candidate carries capacity >= 1, so the top-R cut
                     # can never force an extra round
@@ -694,9 +786,26 @@ class BatchScheduler:
 
             # dispatch every bucket's solve+rank before pulling any result:
             # jax dispatch is async, so the buckets' XLA programs overlap
-            # instead of serializing on the first np.asarray block
-            def _dispatch_solves():
+            # instead of serializing on the first np.asarray block.
+            # ``use_cpu``: small rounds run the SAME jitted programs on
+            # the host CPU backend against the host cluster arrays (always
+            # true state) — an accelerator dispatch pays the fixed relay
+            # turnaround, which swamps small solves (_cpu_small_max)
+            def _dispatch_solves(use_cpu: bool = False):
                 launched = []
+                if use_cpu:
+                    import jax
+
+                    with jax.default_device(jax.devices("cpu")[0]):
+                        for G, full in all_buckets.items():
+                            mask = is_pending[full.pod_index]
+                            if not mask.any():
+                                continue
+                            pods = _filter_types(full, mask)
+                            launched.append(
+                                (G, pods, solve_bucket_ranked(cluster, pods, R))
+                            )
+                    return launched
                 for G, full in all_buckets.items():
                     mask = is_pending[full.pod_index]
                     if not mask.any():
@@ -709,7 +818,15 @@ class BatchScheduler:
                     launched.append((G, pods, out))
                 return launched
 
-            spec_round = spec_ok and round_no == 0
+            def _route_cpu(n_pending: int) -> bool:
+                return (
+                    dev is not None
+                    and _accelerator_backend()
+                    and n_pending <= _cpu_small_max()
+                )
+
+            use_cpu_round = _route_cpu(len(pending))
+            spec_round = spec_ok and round_no == 0 and not use_cpu_round
             spec = None
             if prelaunched is not None:
                 # round r-1 dispatched this round's solves right after its
@@ -721,15 +838,19 @@ class BatchScheduler:
             else:
                 try:
                     if spec_round:
+                        t_sp = time.perf_counter()
                         spec = self._speculate_dispatch(
                             dev, all_buckets, is_pending
                         )
+                        stats.phase_add(
+                            "spec_dispatch", time.perf_counter() - t_sp
+                        )
                         launched = []
                     if spec is None:
-                        # nothing to speculate (e.g. all-PCI batch):
-                        # classic round
+                        # nothing to speculate (e.g. all-PCI batch) or a
+                        # small CPU-routed batch: classic round
                         spec_round = False
-                        launched = _dispatch_solves()
+                        launched = _dispatch_solves(use_cpu_round)
                 except BaseException:
                     if fast_future is not None:
                         try:
@@ -739,11 +860,33 @@ class BatchScheduler:
                         fast_future = None
                     raise
             if fast_future is not None:
-                # join here, while the just-dispatched solves compute in
-                # the XLA pool: the build still hides under the solve
-                # wait, and the worker never outlives schedule()
+                # join here, while the just-dispatched solves (or the
+                # in-flight megaround) compute in the XLA pool: the build
+                # hides under the relay turnaround, and the worker never
+                # outlives schedule()
                 fast = fast_future.result()
                 fast_future = None
+            claims_np = counts_np = None
+            if spec_round:
+                # ONE relay flush pulls the claim tensor AND its counts
+                # plane: copy_to_host_async on both BEFORE the first
+                # blocking asarray batches the transfers (sequential
+                # asarray pulls each pay the full ~65 ms turnaround —
+                # measured 130 ms vs 65 ms, docs/TPU_STATUS.md r4)
+                t_pull = time.perf_counter()
+                try:
+                    spec[2].copy_to_host_async()
+                    spec[3].copy_to_host_async()
+                except Exception:
+                    pass  # backend without async host copies
+                claims_np = np.asarray(spec[2])
+                counts_np = np.asarray(spec[3])
+                stats.phase_add("spec_pull", time.perf_counter() - t_pull)
+            for G, pods, out in launched:
+                try:
+                    out.copy_to_host_async()  # batch all bucket pulls
+                except Exception:
+                    pass
             for G, pods, out in launched:
                 # pull results to host in ONE transfer — the rank output
                 # is a single packed [9, Tp, R] tensor because each
@@ -766,13 +909,14 @@ class BatchScheduler:
             # per node — cross-bucket interleaving on a node would otherwise
             # break the documented serialization order
             node_claimed: Dict[int, int] = {}
+            spec_winners = None
             if spec_round:
                 # the device already ran the whole claim loop — expand its
-                # packed tensor into claims + a RankHost the apply path
-                # reads, exactly like a classic round's selection output;
-                # the per-type capacity select below is skipped entirely
-                claims, bucket_out, node_claimed = self._expand_speculative(
-                    spec, cluster
+                # packed tensor into per-bucket winner arrays (the native
+                # apply's direct input); the per-type capacity select
+                # below is skipped entirely
+                spec_winners, node_claimed = self._expand_speculative(
+                    spec, claims_np, counts_np, cluster
                 )
             for G, (pods, out) in ({} if spec_round else bucket_out).items():
                 # candidates arrive pre-ranked from the device (desc sel
@@ -834,7 +978,7 @@ class BatchScheduler:
             applied_on_node: set = set()
             stats.select_seconds += time.perf_counter() - t0
 
-            if not claims:
+            if not claims and not spec_winners:
                 if spec_round:
                     # an empty speculation is not a saturation verdict —
                     # fall through to a classic round (keep the round
@@ -853,31 +997,62 @@ class BatchScheduler:
                 and fast is not None
                 and fast.round_supported()
                 and all(
-                    fast.round_ok_for(bucket_out[G][0]) for G in bucket_out
+                    fast.round_ok_for(po)
+                    for po in (
+                        [v[0] for v in spec_winners.values()]
+                        if spec_round
+                        else [bucket_out[G][0] for G in bucket_out]
+                    )
                 )
             )
+            if spec_round and not round_ok:
+                # object-assignment fallback consumes claim tuples + a
+                # synthetic RankHost — materialize them from the arrays
+                claims, bucket_out = self._spec_tuples(spec_winners)
             if round_ok:
                 # one native call per bucket places every winner of the
                 # round (native/nhd_assign.cc::nhd_assign_round) and
-                # mutates the packed state + solver arrays
-                by_bucket: Dict[int, List[Tuple[int, int, int, int]]] = {}
-                for pod_i, n, G, t, j in claims:
-                    by_bucket.setdefault(G, []).append((pod_i, n, t, j))
+                # mutates the packed state + solver arrays. The winner
+                # arrays come straight from the speculative expand, or
+                # from the classic round's claim tuples.
+                native_in = []
+                if spec_round:
+                    for G, (pods, w_pod, w_node, w_type, w_c, w_m, _a) in (
+                        spec_winners.items()
+                    ):
+                        native_in.append(
+                            (G, pods, w_pod, w_node, w_type, w_c, w_m)
+                        )
+                else:
+                    by_bucket: Dict[int, List[Tuple[int, int, int, int]]] = {}
+                    for pod_i, n, G, t, j in claims:
+                        by_bucket.setdefault(G, []).append((pod_i, n, t, j))
+                    for G, winners in by_bucket.items():
+                        pods, out = bucket_out[G]
+                        w_pod = np.fromiter(
+                            (w[0] for w in winners), np.int64, len(winners)
+                        )
+                        w_node = np.asarray([w[1] for w in winners], np.int32)
+                        w_type = np.asarray([w[2] for w in winners], np.int32)
+                        w_rank = np.asarray([w[3] for w in winners], np.int32)
+                        w_c = np.ascontiguousarray(
+                            out.best_c[w_type, w_rank], np.int32)
+                        w_m = np.ascontiguousarray(
+                            out.best_m[w_type, w_rank], np.int32)
+                        native_in.append(
+                            (G, pods, w_pod, w_node, w_type, w_c, w_m)
+                        )
                 native_out = []
-                for G, winners in by_bucket.items():
-                    pods, out = bucket_out[G]
-                    w_node = np.asarray([w[1] for w in winners], np.int32)
-                    w_type = np.asarray([w[2] for w in winners], np.int32)
-                    w_rank = np.asarray([w[3] for w in winners], np.int32)
-                    w_c = np.ascontiguousarray(out.best_c[w_type, w_rank], np.int32)
-                    w_m = np.ascontiguousarray(out.best_m[w_type, w_rank], np.int32)
+                t_na = time.perf_counter()
+                for G, pods, w_pod, w_node, w_type, w_c, w_m in native_in:
                     buffers = fast.assign_round(
                         pods, w_node, w_type, w_c, w_m,
                         set_busy=self.respect_busy,
                     )
                     native_out.append(
-                        (G, pods, winners, buffers, w_node, w_c, w_m)
+                        (G, pods, w_pod, w_node, w_type, buffers, w_c, w_m)
                     )
+                stats.phase_add("native_assign", time.perf_counter() - t_na)
                 if dev is not None:
                     # deferred: the scatter fuses into the next round's
                     # solve dispatch (device_state.stage_rows)
@@ -898,9 +1073,11 @@ class BatchScheduler:
                 # snapshot, so every failure retries classically.
                 removed: List[np.ndarray] = []
                 seen_first: set = set()
-                for G, pods, winners, buffers, w_node, w_c, w_m in native_out:
+                for G, pods, w_pod, w_node, w_type, buffers, w_c, w_m in (
+                    native_out
+                ):
                     ok = buffers[0] >= 0
-                    first = np.zeros(len(winners), bool)
+                    first = np.zeros(len(w_pod), bool)
                     if not spec_round:
                         uniq, fi = np.unique(w_node, return_index=True)
                         fresh = [
@@ -909,10 +1086,7 @@ class BatchScheduler:
                         ]
                         first[fresh] = True
                         seen_first.update(uniq.tolist())
-                    pod_arr = np.fromiter(
-                        (w[0] for w in winners), np.int64, len(winners)
-                    )
-                    removed.append(pod_arr[ok | first])
+                    removed.append(w_pod[ok | first])
                 done = (
                     set(np.concatenate(removed).tolist()) if removed else set()
                 )
@@ -921,12 +1095,17 @@ class BatchScheduler:
                 # dispatch round r+1's solves NOW — the arrays already
                 # carry this round's claims, so the Python result
                 # materialization below overlaps the next XLA compute
+                # (a small leftover routes to the host CPU backend: its
+                # solve beats the accelerator's fixed relay turnaround)
                 if pending and round_no + 1 < self.max_rounds:
                     is_pending[:] = False
                     is_pending[pending] = True
-                    prelaunched = _dispatch_solves()
+                    prelaunched = _dispatch_solves(_route_cpu(len(pending)))
 
-                for G, pods, winners, buffers, w_node, w_c, w_m in native_out:
+                t_mat = time.perf_counter()
+                for G, pods, w_pod, w_node, w_type, buffers, w_c, w_m in (
+                    native_out
+                ):
                     # winner loop runs ~10k times a round at gang scale:
                     # one .tolist() per buffer up front (C speed) so the
                     # loop touches only Python ints, per-type NIC
@@ -939,6 +1118,9 @@ class BatchScheduler:
                     w_c_l = w_c.tolist()
                     w_m_l = w_m.tolist()
                     out_nic_l = buffers[3].tolist()
+                    w_pod_l = w_pod.tolist()
+                    w_node_l = w_node.tolist()
+                    w_type_l = w_type.tolist()
                     nic_tmpl: Dict[int, list] = {
                         t: [
                             (g, bw, d)
@@ -949,7 +1131,7 @@ class BatchScheduler:
                             )
                             if bw > 0
                         ]
-                        for t in {w[2] for w in winners}  # w = (pod, n, t, j)
+                        for t in set(w_type_l)
                     }
                     U_, K_ = cluster.U, cluster.K
                     names = cluster.names
@@ -959,10 +1141,12 @@ class BatchScheduler:
                     if all_ok and not want_record:
                         # fast path: no failures → no first-on-node
                         # bookkeeping; bulk set/list updates
-                        busy_nodes.update(n for _, n, _, _ in winners)
-                        applied_on_node.update(n for _, n, _, _ in winners)
-                        stats.scheduled += len(winners)
-                        for w, (pod_i, n, t, _j) in enumerate(winners):
+                        busy_nodes.update(w_node_l)
+                        applied_on_node.update(w_node_l)
+                        stats.scheduled += len(w_pod_l)
+                        for w, (pod_i, n, t) in enumerate(
+                            zip(w_pod_l, w_node_l, w_type_l)
+                        ):
                             item = items[pod_i]
                             mk = (w_c_l[w], w_m_l[w], picks_l[w])
                             mapping = memo.get(mk)
@@ -987,7 +1171,9 @@ class BatchScheduler:
                                 round_no,
                             )
                         continue
-                    for w, (pod_i, n, t, _j) in enumerate(winners):
+                    for w, (pod_i, n, t) in enumerate(
+                        zip(w_pod_l, w_node_l, w_type_l)
+                    ):
                         item = items[pod_i]
                         is_first = n not in applied_on_node
                         applied_on_node.add(n)
@@ -1026,6 +1212,7 @@ class BatchScheduler:
                             round_no,
                         )
                         stats.scheduled += 1
+                stats.phase_add("materialize", time.perf_counter() - t_mat)
                 stats.assign_seconds += time.perf_counter() - t0
                 stats.round_end_seconds.append(time.perf_counter() - t_batch)
                 continue
@@ -1183,6 +1370,12 @@ class BatchScheduler:
                         continue
                     apply_record_to_topology(rec, top)
                     node.add_scheduled_pod(item.key[1], item.key[0], top)
+            stats.phase_add("final_sync", time.perf_counter() - t0)
             stats.assign_seconds += time.perf_counter() - t0
 
+        # back-fill the lazy result slots: every offered-but-unplaced pod
+        # reports an explicit unschedulable entry
+        for i in range(len(items)) if offer is None else offer:
+            if results[i] is None:
+                results[i] = BatchAssignment(items[i].key, None)
         return results, stats

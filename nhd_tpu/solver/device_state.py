@@ -377,11 +377,13 @@ class DeviceClusterState:
 
         ``bucket_pods``: PodTypeArrays per bucket, in bucket-dict order;
         ``needs``: per-bucket int32 [Tp] pending-pod counts (map-PCI type
-        rows zeroed by the caller). Returns the host numpy claims tensor
-        [iters, N] of packed int32 words — ONE pull. On a mesh the same
-        program runs SPMD over the node-sharded resident arrays
-        (claims bit-identical to single-device; the megaround docstring
-        has the sharding story)."""
+        rows zeroed by the caller). Returns the DEVICE claims tensor
+        [iters, N] of packed int32 words, still in flight — the dispatch
+        is async, so the caller can overlap host prep (FastCluster join,
+        pod grouping) under the relay turnaround before pulling it with
+        np.asarray (ONE pull). On a mesh the same program runs SPMD over
+        the node-sharded resident arrays (claims bit-identical to
+        single-device; the megaround docstring has the sharding story)."""
         from nhd_tpu.solver.speculate import _get_megaround, spec_iters
 
         self._flush_staged()
@@ -410,7 +412,7 @@ class DeviceClusterState:
         mutable = {name: self._dev[name] for name in _MUTABLE}
         static = {name: self._dev[name] for name in _STATIC}
         try:
-            new_mutable, claims, _need_left = fn(
+            new_mutable, claims, counts, _need_left = fn(
                 mutable, static, need, *pod_args
             )
         except BaseException:
@@ -418,4 +420,4 @@ class DeviceClusterState:
                 self._rebuild_mutable()
             raise
         self._dev.update(new_mutable)
-        return np.asarray(claims)
+        return claims, counts

@@ -27,9 +27,23 @@ preference first (the gpuless-node preference, Matcher.py:393-421),
 then the type with the largest remaining need (balanced mixes) — and
 each type keeps its elected nodes only up to its remaining need,
 preferring low node indices (the reference's first-candidate order).
-One pod per node per iteration; a node's k-th pod lands in iteration k
-with combo/misc/pick chosen against the then-current state, exactly as
-the k-th claim of a classic round sequence would.
+
+MULTI-COPY claims (round 4): an elected node takes up to cap(t, n)
+copies of its type in ONE iteration — the same optimistic per-node
+capacity estimate the classic select applies host-side
+(batch._capacity_at: free totals over per-pod demand, NIC slots,
+busy=1 for GPU pods) — so a capacity-matched gang lands in ~one
+iteration per type instead of one iteration per pod-per-node. The
+claims tensor gains a parallel counts plane; the host expands a
+count-k claim into k consecutive pods of the type (the native verify
+re-selects NIC picks per copy against live state, as it always did).
+With NIC sharing disabled (the reference default, Node.py:20) the NIC
+projection switches from per-pick bandwidth deltas to OCCUPANCY: a
+copy consumes one free NIC per NIC-needing group per NUMA, and the
+loop zeroes that many lowest-indexed free NICs — exact for the
+skew-preferred cross-NUMA combos, conservative when groups of one pod
+share a NUMA (in-pod sharing can make the real consumption smaller;
+leftovers retry classically).
 
 Reference parity anchor: the loop realizes the same round semantics as
 solver/batch.py (SURVEY §7 hard part 2), which batches the reference's
@@ -50,21 +64,23 @@ from __future__ import annotations
 
 import os
 from functools import lru_cache
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from nhd_tpu.solver.combos import get_tables
-from nhd_tpu.solver.kernel import _pad_pow2, _solve
+from nhd_tpu.solver.kernel import _solve
 
 # The per-(iter, node) claim word, one int32, -1 = no claim:
 #   word = t_global * 2^21 + (c * U + m) * A_bucket(t) + a
 # (c*U + m)*A + a < (C*A)*U <= MAX_LATTICE * 16 = 2^20 for every
-# tractable lattice, and t_global < 128, so the word always fits int32 —
-# and the whole claim tensor leaves the device in ONE transfer (each
-# pull pays ~84 ms of relay latency on the tunnel, docs/TPU_STATUS.md).
+# tractable lattice, and t_global < 1024 (the 31 - _T_SHIFT bound
+# enforced at dispatch, batch._speculate_dispatch), so the word always
+# fits int32 — and the whole claim tensor leaves the device in ONE
+# transfer (each pull pays ~84 ms of relay latency on the tunnel,
+# docs/TPU_STATUS.md).
 _T_SHIFT = 21
 
 
@@ -140,6 +156,8 @@ def _get_megaround(
         arrays = {**static}
         smt = static["smt"]
 
+        from nhd_tpu.core.node import ENABLE_NIC_SHARING
+
         # per-bucket demand projections are state-independent: hoist out
         # of the loop so each iteration only re-solves and re-elects
         per_bucket = []
@@ -150,6 +168,9 @@ def _get_megaround(
             choose = jnp.asarray(tb.choose_onehot)
             misc = jnp.asarray(tb.misc_onehot)
             f32 = jnp.float32
+            # NIC-needing groups per (type, combo, numa): the occupancy
+            # consumption (and per-copy capacity divisor) of a claim
+            needs_nic_g = ((rx + tx) > 0).astype(f32)        # [Tp, G]
             per_bucket.append(dict(
                 pod_args=pod_args[9 * b : 9 * b + 9],
                 G=G, C=tb.C, A=tb.A,
@@ -169,7 +190,9 @@ def _get_megaround(
                     Tp, tb.C * tb.A, U, K),
                 nic_tx=jnp.einsum("tg,caguk->tcauk", tx, choose).reshape(
                     Tp, tb.C * tb.A, U, K),
+                nic_need_u=jnp.einsum("tg,cgu->tcu", needs_nic_g, combo_onehot),
                 hp=hp.astype(jnp.int32),
+                has_nic=jnp.any((rx + tx) > 0, axis=1),
                 needs_gpu=needs_gpu,
             ))
 
@@ -178,7 +201,7 @@ def _get_megaround(
         a_mult_dev = jnp.asarray(a_mult)
 
         def body(state):
-            it, need, mutable, claims, progress = state
+            it, need, mutable, claims, counts, progress = state
             cur = {**arrays, **mutable}
 
             cand_rows, val_rows, c_rows, m_rows, a_rows = [], [], [], [], []
@@ -206,7 +229,7 @@ def _get_megaround(
             best_m = jnp.concatenate(m_rows)
             best_a = jnp.concatenate(a_rows)
 
-            # --- per-node type election ---
+            # --- per-node type election (pure [Tt, N] bool/int ops) ---
             elig = cand & (need > 0)[:, None]
             # preference class dominates (gpuless nodes prefer CPU-only
             # types, like the reference's selection preference), then
@@ -217,77 +240,194 @@ def _get_megaround(
                 -1,
             )
             elect = jnp.argmax(key, axis=0)        # [N]
-            any_elig = jnp.any(elig, axis=0)
             win = (
                 elig
                 & (jnp.arange(t_total, dtype=elect.dtype)[:, None] == elect[None, :])
             )
 
-            # --- type-side cap: keep the best `need_t` elected nodes ---
-            score = jnp.where(win, val, 0)
-            # rank positions within each row, descending score (stable):
-            order = jnp.argsort(-score, axis=1)
-            rank_pos = jnp.argsort(order, axis=1)
-            keep = win & (rank_pos < need[:, None])  # [Tt, N]
-
-            taken_any = jnp.any(keep, axis=0)        # [N]
-            tsel = jnp.argmax(keep, axis=0)          # [N] chosen global type
+            # --- everything after the election runs at [N] scale: exactly
+            # one type wins per node, so the capacity bound, the demand
+            # gathers and the claim deltas are all per-NODE lookups at
+            # (elect, best_c, best_m) — [Tp, N, U]-wide versions of these
+            # were the measured hot spot of the on-chip loop ---
+            INF = jnp.float32(1 << 20)
+            f32 = jnp.float32
             gather_n = lambda x: jnp.take_along_axis(
-                x, tsel[None, :], axis=0)[0]
-            c_n = gather_n(best_c)
+                x, elect[None, :], axis=0)[0]
+            c_n = gather_n(best_c)                 # [N]
             m_n = gather_n(best_m)
             a_n = gather_n(best_a)
 
-            # --- aggregate claim deltas, per bucket ---
-            new_mut = dict(mutable)
-            hp_delta = jnp.zeros(N, jnp.int32)
-            busy_new = mutable["busy"]
-            cpu_delta = jnp.zeros((N, U), jnp.float32)
-            gpu_delta = jnp.zeros((N, U), jnp.float32)
-            nic_delta = jnp.zeros((N, U, K, 2), jnp.float32)
+            cpu_free_u = cur["cpu_free"].astype(f32)      # [N, U]
+            gpu_free_u = cur["gpu_free"].astype(f32)
+            hp_free_n = cur["hp_free"].astype(f32)
+            # free NICs per (node, numa): with sharing off the encode sets
+            # free = cap (> 0) iff the NIC is unoccupied
+            free_nic_cnt = jnp.sum(
+                (cur["nic_free"][..., 0] > 0).astype(f32), axis=2
+            )  # [N, U]
+
+            # per-node gathered quantities, bucket-merged via the elect
+            # range masks (each node's elected row lives in one bucket)
+            cpu_dem_n = jnp.zeros((N, U), f32)   # demand at chosen (c, m)
+            gpu_dem_n = jnp.zeros((N, U), f32)
+            nic_need_n = jnp.zeros((N, U), f32)  # NIC-needing groups per numa
+            hp_n = jnp.zeros(N, f32)
+            cap1_n = jnp.zeros(N, bool)          # force single-copy rows
             for b, (G, Tp) in enumerate(bucket_shapes):
                 pb = per_bucket[b]
                 lo = int(offsets[b])
-                kb = keep[lo : lo + Tp].astype(jnp.float32)   # [Tp, N]
-                cb = jnp.clip(best_c[lo : lo + Tp], 0, pb["C"] - 1)
-                mb = jnp.clip(best_m[lo : lo + Tp], 0, U - 1)
-                ab = jnp.clip(best_a[lo : lo + Tp], 0, pb["A"] - 1)
-                tix = jnp.arange(Tp)[:, None]
-                # [Tp, N, U] gathered per-(type, node) demand at its combo
-                cpu_g = jnp.where(
-                    smt[None, :, None],
-                    pb["cpu_g_smt"][tix, cb],
-                    pb["cpu_g_raw"][tix, cb],
-                ) + jnp.where(
-                    smt[None, :, None],
-                    pb["cpu_m_smt"][tix, mb],
-                    pb["cpu_m_raw"][tix, mb],
+                in_b = (elect >= lo) & (elect < lo + Tp)      # [N]
+                tloc = jnp.clip(elect - lo, 0, Tp - 1)
+                cb = jnp.clip(c_n, 0, pb["C"] - 1)
+                mb = jnp.clip(m_n, 0, U - 1)
+                sel = in_b[:, None]
+                dem = jnp.where(
+                    smt[:, None],
+                    pb["cpu_g_smt"][tloc, cb] + pb["cpu_m_smt"][tloc, mb],
+                    pb["cpu_g_raw"][tloc, cb] + pb["cpu_m_raw"][tloc, mb],
+                )  # [N, U]
+                cpu_dem_n = jnp.where(sel, dem, cpu_dem_n)
+                gpu_dem_n = jnp.where(sel, pb["gpu_g"][tloc, cb], gpu_dem_n)
+                nic_need_n = jnp.where(
+                    sel, pb["nic_need_u"][tloc, cb], nic_need_n)
+                hp_n = jnp.where(in_b, pb["hp"].astype(f32)[tloc], hp_n)
+                one = pb["needs_gpu"][tloc] if respect_busy else False
+                if ENABLE_NIC_SHARING:
+                    one = one | pb["has_nic"][tloc]
+                cap1_n = jnp.where(in_b, one, cap1_n)
+
+            # multi-copy capacity at the chosen (combo, misc), per NUMA —
+            # k copies all apply at the same (c, m), so the bound is
+            # per-NUMA at that placement (node totals over-claim and the
+            # native verify rejects the overflow)
+            def _div_min_u(free_u, dem_u):
+                per_u = jnp.where(
+                    dem_u > 0,
+                    jnp.floor(free_u / jnp.maximum(dem_u, 1e-6)), INF,
                 )
-                cpu_delta = cpu_delta + jnp.einsum("tn,tnu->nu", kb, cpu_g)
-                gpu_delta = gpu_delta + jnp.einsum(
-                    "tn,tnu->nu", kb, pb["gpu_g"][tix, cb])
-                ca = cb * pb["A"] + ab
-                nic_delta = nic_delta.at[..., 0].add(
-                    jnp.einsum("tn,tnuk->nuk", kb, pb["nic_rx"][tix, ca]))
-                nic_delta = nic_delta.at[..., 1].add(
-                    jnp.einsum("tn,tnuk->nuk", kb, pb["nic_tx"][tix, ca]))
-                hp_delta = hp_delta + jnp.einsum(
-                    "tn,t->n", kb, pb["hp"].astype(jnp.float32)
-                ).astype(jnp.int32)
-                if respect_busy:
-                    busy_new = busy_new | jnp.any(
-                        keep[lo : lo + Tp] & pb["needs_gpu"][:, None], axis=0)
+                return jnp.min(per_u, axis=1)      # [N]
+
+            cap_n = _div_min_u(cpu_free_u, cpu_dem_n)
+            cap_n = jnp.minimum(cap_n, _div_min_u(gpu_free_u, gpu_dem_n))
+            if not ENABLE_NIC_SHARING:
+                # occupancy bound: free NICs per NUMA over NIC-needing
+                # groups per NUMA at the chosen combo, min across NUMAs
+                cap_n = jnp.minimum(
+                    cap_n, _div_min_u(free_nic_cnt, nic_need_n))
+            cap_n = jnp.minimum(cap_n, jnp.where(
+                hp_n > 0,
+                jnp.floor(hp_free_n / jnp.maximum(hp_n, 1e-6)), INF,
+            ))
+            # GPU pods under the busy back-off (and NIC-demanding types
+            # under sharing, whose bandwidth projection can't express
+            # pick disjointness) claim one copy per iteration
+            cap_n = jnp.where(cap1_n, jnp.minimum(cap_n, 1.0), cap_n)
+            cap_n = jnp.maximum(cap_n, 0.0).astype(jnp.int32)
+
+            # --- type-side fill: hand the best-ranked elected nodes their
+            # copies until the type's need runs out. The per-node take is
+            # BALANCED at ceil(need / elected nodes): an unbalanced
+            # capacity-fill concentrates one type on the first nodes and
+            # (measured) costs placements on tight instances — the
+            # balanced spread keeps the classic interleave's packing shape
+            # while still claiming multiple copies per dispatch, and
+            # degrades to exactly the old one-per-node interleave as a
+            # type's need runs out ---
+            n_win = jnp.sum(win, axis=1).astype(jnp.int32)      # [Tt]
+            fair = (need + jnp.maximum(n_win, 1) - 1) // jnp.maximum(n_win, 1)
+            # every elected CANDIDATE node may take at least one copy even
+            # when the capacity projection says 0 — the projection is
+            # conservative (per-copy ceil loses SMT-sibling sharing across
+            # copies), the solve's cand is the real one-copy verdict, and
+            # a marginal over-claim just retries after the native verify
+            # (exactly the r3 single-copy optimism). Multi-copy engages on
+            # top wherever the projection clearly allows it.
+            capw = jnp.where(
+                win,
+                jnp.minimum(jnp.maximum(cap_n, 1)[None, :], fair[:, None]),
+                0,
+            )
+            # fill in descending-val order WITHOUT argsort: val encodes
+            # pref then low-node-index, so the fill order is simply
+            # "pref-2 winners by node index, then pref-1 winners by node
+            # index" — two exclusive cumsums give each winner its
+            # fill-prefix (argsort pairs here were the hottest op of the
+            # on-chip loop)
+            hi = win & (val // (N + 1) == 2)
+            cap_hi = jnp.where(hi, capw, 0)
+            cap_lo = jnp.where(win & ~hi, capw, 0)
+            prefix_hi = jnp.cumsum(cap_hi, axis=1) - cap_hi
+            prefix_lo = (
+                jnp.sum(cap_hi, axis=1, keepdims=True)
+                + jnp.cumsum(cap_lo, axis=1) - cap_lo
+            )
+            prefix = jnp.where(hi, prefix_hi, prefix_lo)
+            take = jnp.where(
+                win, jnp.clip(need[:, None] - prefix, 0, capw), 0
+            )  # [Tt, N]
+
+            count_n = jnp.max(take, axis=0)          # [N] copies claimed
+            taken_any = count_n > 0
+            tsel = elect                             # take>0 only on elect row
+
+            # --- aggregate claim deltas, all at [N, U] scale ---
+            new_mut = dict(mutable)
+            busy_new = mutable["busy"]
+            if respect_busy:
+                # a node goes busy on ANY placement, exactly like the
+                # classic apply (NHDScheduler.py:289 per batch.py) — not
+                # just GPU-needing claims
+                busy_new = busy_new | taken_any
+            k_n = count_n.astype(f32)                # [N]
+            cpu_delta = k_n[:, None] * cpu_dem_n
+            gpu_delta = k_n[:, None] * gpu_dem_n
+            hp_delta = (k_n * hp_n).astype(jnp.int32)
+            if ENABLE_NIC_SHARING:
+                # per-pick bandwidth deltas (single-copy for NIC types):
+                # gather each node's (combo, pick) demand row per bucket
+                nic_delta = jnp.zeros((N, U, K, 2), jnp.float32)
+                for b, (G, Tp) in enumerate(bucket_shapes):
+                    pb = per_bucket[b]
+                    lo = int(offsets[b])
+                    in_b = (elect >= lo) & (elect < lo + Tp)
+                    tloc = jnp.clip(elect - lo, 0, Tp - 1)
+                    ca = (
+                        jnp.clip(c_n, 0, pb["C"] - 1) * pb["A"]
+                        + jnp.clip(a_n, 0, pb["A"] - 1)
+                    )
+                    w = (k_n * in_b.astype(f32))[:, None, None]
+                    nic_delta = nic_delta.at[..., 0].add(
+                        w * pb["nic_rx"][tloc, ca])
+                    nic_delta = nic_delta.at[..., 1].add(
+                        w * pb["nic_tx"][tloc, ca])
+            else:
+                nic_consume = k_n[:, None] * nic_need_n      # [N, U]
             new_mut["cpu_free"] = (
                 mutable["cpu_free"].astype(jnp.float32) - cpu_delta
             ).astype(mutable["cpu_free"].dtype)
             new_mut["gpu_free"] = (
                 mutable["gpu_free"].astype(jnp.float32) - gpu_delta
             ).astype(mutable["gpu_free"].dtype)
-            new_mut["nic_free"] = mutable["nic_free"] - nic_delta
+            if ENABLE_NIC_SHARING:
+                new_mut["nic_free"] = mutable["nic_free"] - nic_delta
+            else:
+                # zero out the consumed count of lowest-indexed free NICs
+                # per (node, numa) — occupancy is the whole story with
+                # sharing off (encode: free = cap iff unoccupied)
+                unocc = mutable["nic_free"][..., 0] > 0        # [N, U, K]
+                used = unocc & (
+                    jnp.cumsum(unocc.astype(jnp.int32), axis=2)
+                    <= nic_consume[..., None]
+                )
+                new_mut["nic_free"] = jnp.where(
+                    used[..., None], 0.0, mutable["nic_free"]
+                )
             new_mut["hp_free"] = mutable["hp_free"] - hp_delta
             new_mut["busy"] = busy_new
 
-            # --- record the iteration's claims (one packed word/node) ---
+            # --- record the iteration's claims (one packed word/node,
+            # plus the copy count in the parallel counts plane) ---
             word = (
                 tsel.astype(jnp.int32) * (1 << _T_SHIFT)
                 + (c_n * U + m_n) * a_mult_dev[tsel]
@@ -296,12 +436,15 @@ def _get_megaround(
             enc = jnp.where(taken_any, word, -1)
             claims = jax.lax.dynamic_update_slice(
                 claims, enc[None, :], (it, 0))
+            counts = jax.lax.dynamic_update_slice(
+                counts, jnp.where(taken_any, count_n, 0)[None, :], (it, 0))
 
-            need = need - jnp.sum(keep, axis=1).astype(need.dtype)
-            return (it + 1, need, new_mut, claims, jnp.any(taken_any))
+            need = need - jnp.sum(take, axis=1).astype(need.dtype)
+            return (it + 1, need, new_mut, claims, counts,
+                    jnp.any(taken_any))
 
         def cond(state):
-            it, need, _mut, _c, progress = state
+            it, need, _mut, _c, _cnt, progress = state
             return (it < iters) & (jnp.sum(need) > 0) & progress
 
         init = (
@@ -309,10 +452,13 @@ def _get_megaround(
             need,
             mutable,
             jnp.full((iters, N), -1, jnp.int32),
+            jnp.zeros((iters, N), jnp.int32),
             jnp.asarray(True),
         )
-        it, need, mutable, claims, _ = jax.lax.while_loop(cond, body, init)
-        return mutable, claims, need
+        it, need, mutable, claims, counts, _ = jax.lax.while_loop(
+            cond, body, init
+        )
+        return mutable, claims, counts, need
 
     kwargs = {"donate_argnums": (0,)} if donate else {}
     if out_shardings_key is not None:
@@ -321,30 +467,41 @@ def _get_megaround(
             {name: node_sharding for name in _MUTABLE},
             replicated,
             replicated,
+            replicated,
         )
     return jax.jit(fn, **kwargs)
 
 
-def decode_claims(
+def decode_claims_grouped(
     claims: np.ndarray,       # [iters, N] int32 packed words, -1 = none
     bucket_shapes: Sequence[Tuple[int, int]],
     bucket_keys: Sequence[int],
     U: int,
     K: int,
-) -> Dict[int, Dict[int, List[Tuple[int, int, int, int]]]]:
+    counts: Optional[np.ndarray] = None,  # [iters, N] int32 copies, 0 = none
+) -> Dict[int, Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]]:
     """Unpack the device claim tensor into
-    {bucket key: {local type: [(node, c, m, a), ...]}} with list order =
-    (iteration, node index) — the order speculative copies were made."""
+    {bucket key: {local type: (nodes, c, m, a) arrays}} with array order =
+    (iteration, node index) — the order speculative copies were made. A
+    count-k claim (multi-copy) expands to k consecutive entries.
+
+    Fully vectorized: at gang scale the tensor carries ~10k claims and a
+    per-claim Python loop was the measurable cost of the expand phase."""
     offsets = np.cumsum([0] + [tp for _, tp in bucket_shapes])
     a_width = np.concatenate([
         np.full(tp, get_tables(G, U, K).A, np.int64)
         for G, tp in bucket_shapes
     ])
-    out: Dict[int, Dict[int, List[Tuple[int, int, int, int]]]] = {
-        gk: {} for gk in bucket_keys
-    }
-    its, nodes = np.nonzero(claims >= 0)
+    out: Dict[int, Dict[int, tuple]] = {gk: {} for gk in bucket_keys}
+    its, nodes = np.nonzero(claims >= 0)   # row-major == (iter, node) order
+    if not len(its):
+        return out
     word = claims[its, nodes].astype(np.int64)
+    cnt = (
+        counts[its, nodes].astype(np.int64)
+        if counts is not None
+        else np.ones(len(its), np.int64)
+    )
     tg = word >> _T_SHIFT
     rest = word & ((1 << _T_SHIFT) - 1)
     aw = a_width[tg]
@@ -352,11 +509,45 @@ def decode_claims(
     cm = rest // aw
     c = cm // U
     m = cm % U
-    b_of = np.searchsorted(offsets, tg, side="right") - 1
-    for i in range(len(its)):
-        b = int(b_of[i])
-        t_local = int(tg[i] - offsets[b])
-        out[bucket_keys[b]].setdefault(t_local, []).append(
-            (int(nodes[i]), int(c[i]), int(m[i]), int(a[i]))
+    # stable sort groups claims by global type, preserving (iter, node)
+    # order within each type
+    order = np.argsort(tg, kind="stable")
+    tg_s = tg[order]
+    cnt_s = cnt[order]
+    # multi-copy expansion: k copies become k consecutive rows (pods of a
+    # type consume them in order, so copy order within a claim is moot)
+    nodes_s = np.repeat(nodes[order], cnt_s)
+    c_s = np.repeat(c[order], cnt_s)
+    m_s = np.repeat(m[order], cnt_s)
+    a_s = np.repeat(a[order], cnt_s)
+    tg_x = np.repeat(tg_s, cnt_s)
+    uniq, starts = np.unique(tg_x, return_index=True)
+    bounds = np.append(starts, len(tg_x))
+    b_of = np.searchsorted(offsets, uniq, side="right") - 1
+    for u, b, lo, hi in zip(uniq, b_of, bounds[:-1], bounds[1:]):
+        t_local = int(u - offsets[b])
+        out[bucket_keys[int(b)]][t_local] = (
+            nodes_s[lo:hi], c_s[lo:hi], m_s[lo:hi], a_s[lo:hi]
         )
     return out
+
+
+def decode_claims(
+    claims: np.ndarray,
+    bucket_shapes: Sequence[Tuple[int, int]],
+    bucket_keys: Sequence[int],
+    U: int,
+    K: int,
+    counts: Optional[np.ndarray] = None,
+) -> Dict[int, Dict[int, List[Tuple[int, int, int, int]]]]:
+    """decode_claims_grouped with per-claim tuple lists (test/debug API)."""
+    grouped = decode_claims_grouped(
+        claims, bucket_shapes, bucket_keys, U, K, counts
+    )
+    return {
+        gk: {
+            t: list(zip(n.tolist(), c.tolist(), m.tolist(), a.tolist()))
+            for t, (n, c, m, a) in per.items()
+        }
+        for gk, per in grouped.items()
+    }

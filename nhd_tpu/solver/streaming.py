@@ -331,6 +331,8 @@ class StreamingScheduler:
                 stats.select_seconds += sub_stats.select_seconds
                 stats.assign_seconds += sub_stats.assign_seconds
                 stats.scheduled += sub_stats.scheduled
+                for name, dt in sub_stats.phases.items():
+                    stats.phase_add(name, dt)
                 # NOT sub_stats.failed: a pod failing its first-on-node
                 # claim in one tile is re-offered to later tiles, so
                 # per-tile failure counts would double-book; terminal

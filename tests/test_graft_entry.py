@@ -9,10 +9,13 @@ def test_entry_compiles_and_runs():
     import numpy as np
 
     fn, args = graft.entry()
-    mutable, claims, need_left = jax.jit(fn)(*args)
+    mutable, claims, counts, need_left = jax.jit(fn)(*args)
     # the megaround made real claims and consumed real need
     claims = np.asarray(claims)
+    counts = np.asarray(counts)
     assert claims.ndim == 2 and (claims >= 0).sum() > 0
+    # every claim carries a positive copy count (multi-copy plane)
+    assert (counts[claims >= 0] > 0).all()
     assert int(np.asarray(need_left).sum()) < int(np.asarray(args[2]).sum())
     # the claimed state mutated (GPUs were consumed)
     assert not np.array_equal(

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Round-4 tunnel microbenchmark: what overlaps with what.
+
+Answers the questions the cfg4 <=120 ms design hinges on
+(VERDICT r3 item 1):
+  1. device->host pull latency vs size (is it latency- or bandwidth-bound?)
+  2. do two in-flight async pulls pipeline, or serialize?
+  3. does a pull overlap with on-device compute dispatched after it?
+  4. row-scatter cost today, donate vs fresh (VERDICT item 7)
+  5. megaround-shaped claims pull: [16, 1024] int32 one-shot vs 2 blocks
+
+Writes findings to stderr; exclusive TPU claimant (run nothing else).
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def timeit(fn, n=5, warm=1):
+    for _ in range(warm):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), sum(ts) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/nhd_tpu_jax_cache")
+    dev = jax.devices()[0]
+    log(f"probe: platform={dev.platform} {dev}")
+
+    # --- 1. pull latency vs size ---
+    for kb in (1, 4, 16, 64, 256, 1024):
+        n = kb * 256  # int32 elements
+        x = jnp.arange(n, dtype=jnp.int32)
+        x.block_until_ready()
+        tmin, tavg = timeit(lambda: np.asarray(x), n=5)
+        log(f"probe[pull]: {kb:5d} KB -> min {tmin*1e3:7.1f} ms  avg {tavg*1e3:7.1f} ms")
+
+    # --- 2. two async pulls: pipeline or serialize? ---
+    a = jnp.arange(16 * 1024, dtype=jnp.int32)  # 64 KB
+    b = a + 1
+    jax.block_until_ready((a, b))
+
+    def seq():
+        np.asarray(a)
+        np.asarray(b)
+
+    def overlapped():
+        a.copy_to_host_async()
+        b.copy_to_host_async()
+        np.asarray(a)
+        np.asarray(b)
+
+    tmin, _ = timeit(seq, n=5)
+    log(f"probe[2pulls-seq]:     64KB x2 sequential  min {tmin*1e3:7.1f} ms")
+    tmin, _ = timeit(overlapped, n=5)
+    log(f"probe[2pulls-async]:   64KB x2 async       min {tmin*1e3:7.1f} ms")
+
+    # --- 3. pull overlapping dispatched compute ---
+    m = jnp.ones((2048, 2048), jnp.bfloat16)
+
+    @jax.jit
+    def burn(m):
+        for _ in range(64):
+            m = m @ m
+        return m
+
+    burn(m).block_until_ready()
+    big = jnp.arange(64 * 1024, dtype=jnp.int32)  # 256 KB
+    big.block_until_ready()
+    t_burn, _ = timeit(lambda: burn(m).block_until_ready(), n=3)
+    t_pull, _ = timeit(lambda: np.asarray(big), n=3)
+
+    def both():
+        r = burn(m)          # async dispatch
+        np.asarray(big)      # pull while burning
+        r.block_until_ready()
+
+    t_both, _ = timeit(both, n=3)
+    log(f"probe[overlap]: burn {t_burn*1e3:.1f} ms, pull {t_pull*1e3:.1f} ms, "
+        f"both {t_both*1e3:.1f} ms "
+        f"({'OVERLAPS' if t_both < (t_burn + t_pull) * 0.75 else 'SERIAL'})")
+
+    # --- 4. row scatter, donate vs fresh ---
+    N, U, K = 1024, 4, 8
+    arrays = {
+        "busy": jnp.zeros(N, bool),
+        "hp_free": jnp.zeros(N, jnp.int32),
+        "cpu_free": jnp.zeros((N, U), jnp.float32),
+        "gpu_free": jnp.zeros((N, U), jnp.float32),
+        "nic_free": jnp.zeros((N, U, K, 2), jnp.float32),
+        "gpu_free_sw": jnp.zeros((N, 8), jnp.float32),
+    }
+    jax.block_until_ready(arrays)
+    idx = jnp.arange(64, dtype=jnp.int32)
+    rows = {k: np.asarray(v[:64]) for k, v in arrays.items()}
+
+    def scatter_impl(arrays, idx, rows):
+        return {k: arrays[k].at[idx].set(rows[k]) for k in arrays}
+
+    fresh = jax.jit(scatter_impl)
+
+    def run_fresh():
+        out = fresh(arrays, idx, rows)
+        jax.block_until_ready(out)
+
+    tmin, _ = timeit(run_fresh, n=5)
+    log(f"probe[scatter-fresh]: 64 rows min {tmin*1e3:.1f} ms")
+
+    donate = jax.jit(scatter_impl, donate_argnums=(0,))
+    state = {k: v for k, v in arrays.items()}
+    jax.block_until_ready(state)
+    ts = []
+    out = donate(state, idx, rows)
+    jax.block_until_ready(out)
+    cur = out
+    for _ in range(5):
+        t0 = time.perf_counter()
+        cur = donate(cur, idx, rows)
+        jax.block_until_ready(cur)
+        ts.append(time.perf_counter() - t0)
+    log(f"probe[scatter-donate]: 64 rows min {min(ts)*1e3:.1f} ms")
+
+    # --- 5. dispatch-only cost of a chained jit (queue depth) ---
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    y = tiny(jnp.zeros(8, jnp.int32))
+    y.block_until_ready()
+
+    def chain():
+        z = jnp.zeros(8, jnp.int32)
+        for _ in range(8):
+            z = tiny(z)
+        z.block_until_ready()
+
+    tmin, _ = timeit(chain, n=5)
+    log(f"probe[chain8]: 8 chained tiny dispatches min {tmin*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Round-4 follow-up: is the ~65 ms sync cost per-wait or a poll quantum?
+
+probe_r4 showed: pulls of ready data ~0 ms, but any dispatch+sync ~65 ms.
+This measures (a) cost of a SECOND sync right after a first, (b) fresh
+result pull (dispatch then immediate asarray), (c) whether host sleep
+during in-flight compute absorbs the 65 ms.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/nhd_tpu_jax_cache")
+    log(f"probe: {jax.devices()[0]}")
+
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    @jax.jit
+    def tiny2(x):
+        return x * 2
+
+    x0 = jnp.zeros(1024, jnp.int32)
+    tiny(x0).block_until_ready()
+    tiny2(x0).block_until_ready()
+
+    # (a) two dispatches, two syncs back-to-back
+    for trial in range(4):
+        a = tiny(x0)
+        b = tiny2(x0)
+        t0 = time.perf_counter()
+        a.block_until_ready()
+        t1 = time.perf_counter()
+        b.block_until_ready()
+        t2 = time.perf_counter()
+        log(f"probe[2sync]: first {1e3*(t1-t0):6.1f} ms, second {1e3*(t2-t1):6.1f} ms")
+
+    # (b) fresh-result pull: dispatch then asarray immediately
+    for trial in range(4):
+        a = tiny(x0)
+        t0 = time.perf_counter()
+        arr = np.asarray(a)
+        t1 = time.perf_counter()
+        log(f"probe[fresh-pull]: dispatch->asarray {1e3*(t1-t0):6.1f} ms")
+
+    # (c) host sleep while in flight, then sync
+    for sleep_ms in (0, 30, 60, 90, 120):
+        a = tiny(x0)
+        t0 = time.perf_counter()
+        time.sleep(sleep_ms / 1e3)
+        a.block_until_ready()
+        t1 = time.perf_counter()
+        log(f"probe[sleep{sleep_ms:3d}]: total {1e3*(t1-t0):6.1f} ms "
+            f"(sync after sleep {1e3*(t1-t0)-sleep_ms:6.1f} ms)")
+
+    # (d) repeated immediate syncs on the SAME ready array
+    a = tiny(x0)
+    a.block_until_ready()
+    t0 = time.perf_counter()
+    a.block_until_ready()
+    t1 = time.perf_counter()
+    log(f"probe[resync-ready]: {1e3*(t1-t0):6.3f} ms")
+
+    # (e) interleaved: dispatch A, sync A, host work 30ms, dispatch B, sync B
+    a = tiny(x0)
+    a.block_until_ready()
+    for trial in range(3):
+        t0 = time.perf_counter()
+        a = tiny(x0)
+        a.block_until_ready()
+        t1 = time.perf_counter()
+        b = tiny2(x0)
+        b.block_until_ready()
+        t2 = time.perf_counter()
+        c = tiny(x0)
+        c.block_until_ready()
+        t3 = time.perf_counter()
+        log(f"probe[3roundtrips]: {1e3*(t1-t0):6.1f} {1e3*(t2-t1):6.1f} "
+            f"{1e3*(t3-t2):6.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
