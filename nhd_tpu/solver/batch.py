@@ -124,40 +124,6 @@ def _fc_executor():
     return _FC_EXECUTOR
 
 
-def _filter_types(full, mask: np.ndarray):
-    """Restrict a PodTypeArrays to the types that still have pending pods.
-
-    Late rounds typically carry a handful of contended types; solving the
-    full type axis would cost as much as round 1 (the solve scales with
-    T×N, not pod count). Only worth it when the padded type bucket
-    actually shrinks — otherwise the same jit program is reused and
-    slicing would be pure overhead."""
-    from nhd_tpu.solver.kernel import _pad_pow2
-
-    ptypes = full.pod_type[mask]
-    pidx = full.pod_index[mask]
-    alive = np.unique(ptypes)
-    if _pad_pow2(len(alive)) >= _pad_pow2(full.n_types):
-        return replace(full, pod_type=ptypes, pod_index=pidx)
-    remap = np.full(full.n_types, -1, np.int32)
-    remap[alive] = np.arange(len(alive), dtype=np.int32)
-    return replace(
-        full,
-        requests=[full.requests[t] for t in alive],
-        pod_type=remap[ptypes],
-        pod_index=pidx,
-        cpu_dem_smt=full.cpu_dem_smt[alive],
-        cpu_dem_raw=full.cpu_dem_raw[alive],
-        gpu_dem=full.gpu_dem[alive],
-        rx=full.rx[alive],
-        tx=full.tx[alive],
-        hp=full.hp[alive],
-        needs_gpu=full.needs_gpu[alive],
-        map_pci=full.map_pci[alive],
-        group_mask=full.group_mask[alive],
-    )
-
-
 def _accelerator_backend() -> bool:
     import jax
 
@@ -178,6 +144,17 @@ def _cpu_small_max() -> int:
     import os
 
     return int(os.environ.get("NHD_TPU_CPU_SMALL", "1024"))
+
+
+def _cpu_small_nodes() -> int:
+    """Node-count ceiling for the CPU routing above: the host solve cost
+    scales with nodes × combo lattice (a G=2 bucket at a 4096-node
+    streaming tile walks ~360 MB of predicate tensors, ~0.7 s on this
+    1-core host — far worse than the 65 ms relay turnaround it avoids),
+    so big-tile tail rounds stay on the accelerator."""
+    import os
+
+    return int(os.environ.get("NHD_TPU_CPU_SMALL_NODES", "1536"))
 
 
 @dataclass
@@ -359,7 +336,16 @@ class BatchScheduler:
             mask = is_pending[full.pod_index]
             if not mask.any():
                 continue
-            pods = _filter_types(full, mask)
+            # keep the FULL type rows (no _filter_types shrink): absent
+            # types just carry zero need, and the stable (G, Tp) shape
+            # means every streaming tile of a chunk reuses ONE compiled
+            # megaround — a tile whose pod subset shrank the type bucket
+            # was paying a fresh ~1 s trace+compile through the tunnel
+            pods = replace(
+                full,
+                pod_type=full.pod_type[mask],
+                pod_index=full.pod_index[mask],
+            )
             Tp = _pad_pow2(pods.n_types)
             need = np.bincount(pods.pod_type, minlength=Tp).astype(np.int32)
             need[: pods.n_types][pods.map_pci] = 0
@@ -791,6 +777,21 @@ class BatchScheduler:
             # the host CPU backend against the host cluster arrays (always
             # true state) — an accelerator dispatch pays the fixed relay
             # turnaround, which swamps small solves (_cpu_small_max)
+            def _membership(full, mask):
+                """Restrict pod membership WITHOUT shrinking the type
+                rows: the padded (G, Tp) bucket shape stays stable across
+                every round (and every streaming tile of a chunk), so the
+                whole batch reuses ONE compiled solve program — a late
+                round whose alive types shrank the bucket was paying a
+                fresh multi-second trace+compile through the tunnel for a
+                solve that itself takes milliseconds. Absent type rows
+                simply select nothing."""
+                return replace(
+                    full,
+                    pod_type=full.pod_type[mask],
+                    pod_index=full.pod_index[mask],
+                )
+
             def _dispatch_solves(use_cpu: bool = False):
                 launched = []
                 if use_cpu:
@@ -801,7 +802,7 @@ class BatchScheduler:
                             mask = is_pending[full.pod_index]
                             if not mask.any():
                                 continue
-                            pods = _filter_types(full, mask)
+                            pods = _membership(full, mask)
                             launched.append(
                                 (G, pods, solve_bucket_ranked(cluster, pods, R))
                             )
@@ -810,7 +811,7 @@ class BatchScheduler:
                     mask = is_pending[full.pod_index]
                     if not mask.any():
                         continue
-                    pods = _filter_types(full, mask)
+                    pods = _membership(full, mask)
                     out = (
                         dev.solve_ranked(pods, R) if dev
                         else solve_bucket_ranked(cluster, pods, R)
@@ -823,6 +824,7 @@ class BatchScheduler:
                     dev is not None
                     and _accelerator_backend()
                     and n_pending <= _cpu_small_max()
+                    and cluster.n_nodes <= _cpu_small_nodes()
                 )
 
             use_cpu_round = _route_cpu(len(pending))
@@ -1053,6 +1055,13 @@ class BatchScheduler:
                         (G, pods, w_pod, w_node, w_type, buffers, w_c, w_m)
                     )
                 stats.phase_add("native_assign", time.perf_counter() - t_na)
+                # BIND stamp = native-verify completion: every surviving
+                # claim of the round is now applied to the authoritative
+                # packed state (occupancy + solver arrays); the result
+                # materialization and mirror sync below are bookkeeping
+                # that lags the commit (VERDICT r3 item 2: stamp bind as
+                # the chunk's verify completes, not at sweep end)
+                stats.round_end_seconds.append(time.perf_counter() - t_batch)
                 if dev is not None:
                     # deferred: the scatter fuses into the next round's
                     # solve dispatch (device_state.stage_rows)
@@ -1072,6 +1081,7 @@ class BatchScheduler:
                 # solved against projected state mid-loop, not a fresh
                 # snapshot, so every failure retries classically.
                 removed: List[np.ndarray] = []
+                first_masks: List[np.ndarray] = []
                 seen_first: set = set()
                 for G, pods, w_pod, w_node, w_type, buffers, w_c, w_m in (
                     native_out
@@ -1086,6 +1096,7 @@ class BatchScheduler:
                         ]
                         first[fresh] = True
                         seen_first.update(uniq.tolist())
+                    first_masks.append(first)
                     removed.append(w_pod[ok | first])
                 done = (
                     set(np.concatenate(removed).tolist()) if removed else set()
@@ -1103,17 +1114,20 @@ class BatchScheduler:
                     prelaunched = _dispatch_solves(_route_cpu(len(pending)))
 
                 t_mat = time.perf_counter()
-                for G, pods, w_pod, w_node, w_type, buffers, w_c, w_m in (
-                    native_out
+                for bi, (G, pods, w_pod, w_node, w_type, buffers, w_c, w_m) in (
+                    enumerate(native_out)
                 ):
                     # winner loop runs ~10k times a round at gang scale:
                     # one .tolist() per buffer up front (C speed) so the
                     # loop touches only Python ints, per-type NIC
                     # templates so nic lists need no object-graph walks,
                     # and a local (c, m, pick) memo in front of the
-                    # decode_mapping lru (dict.get beats the lru wrapper)
+                    # decode_mapping lru (dict.get beats the lru wrapper).
+                    # Failures are handled in a separate small pass (their
+                    # final-vs-retry verdict is the precomputed `first`
+                    # mask), so the success loop stays branch-light even
+                    # on contended rounds.
                     status = buffers[0]
-                    status_l = status.tolist()
                     picks_l = buffers[5].tolist()
                     w_c_l = w_c.tolist()
                     w_m_l = w_m.tolist()
@@ -1136,61 +1150,45 @@ class BatchScheduler:
                     U_, K_ = cluster.U, cluster.K
                     names = cluster.names
                     want_record = self.register_pods
-                    all_ok = bool((status >= 0).all())
                     memo: Dict[tuple, object] = {}
-                    if all_ok and not want_record:
-                        # fast path: no failures → no first-on-node
-                        # bookkeeping; bulk set/list updates
-                        busy_nodes.update(w_node_l)
-                        applied_on_node.update(w_node_l)
-                        stats.scheduled += len(w_pod_l)
-                        for w, (pod_i, n, t) in enumerate(
-                            zip(w_pod_l, w_node_l, w_type_l)
-                        ):
-                            item = items[pod_i]
-                            mk = (w_c_l[w], w_m_l[w], picks_l[w])
-                            mapping = memo.get(mk)
-                            if mapping is None:
-                                mapping = memo[mk] = decode_mapping(
-                                    G, U_, K_, mk[0], mk[1], mk[2],
-                                )
-                            if item.topology is not None:
-                                rec = fast.record_from_round(
-                                    pods, w, n, t, buffers
-                                )
-                                records[pod_i] = rec
-                                nic_list = rec.nic_list
-                            else:
-                                row = out_nic_l[w]
-                                nic_list = [
-                                    (row[g], bw, d)
-                                    for g, bw, d in nic_tmpl[t]
-                                ]
-                            results[pod_i] = BatchAssignment(
-                                item.key, names[n], mapping, nic_list,
-                                round_no,
-                            )
-                        continue
-                    for w, (pod_i, n, t) in enumerate(
-                        zip(w_pod_l, w_node_l, w_type_l)
-                    ):
-                        item = items[pod_i]
-                        is_first = n not in applied_on_node
-                        applied_on_node.add(n)
-                        if status_l[w] < 0:
-                            if not is_first or spec_round:
-                                # stale same-node claim (or a speculative
-                                # claim, never final): retry classically
+                    ok = status >= 0
+                    applied_on_node.update(w_node_l)
+                    if not ok.all():
+                        # failure pass: a first-on-node failure is final
+                        # (it ran against fresh feasibility); later
+                        # same-node failures — and every speculative
+                        # failure — retry classically
+                        first = first_masks[bi]
+                        for w in np.nonzero(~ok)[0].tolist():
+                            if spec_round or not first[w]:
                                 continue
+                            pod_i, n = w_pod_l[w], w_node_l[w]
+                            item = items[pod_i]
                             self.logger.error(
                                 f"assignment failed for {item.key} on "
-                                f"{names[n]}: stage {status_l[w]}"
+                                f"{names[n]}: stage {int(status[w])}"
                             )
-                            results[pod_i] = BatchAssignment(item.key, None, failed=True)
+                            results[pod_i] = BatchAssignment(
+                                item.key, None, failed=True
+                            )
                             stats.failed += 1
-                            continue
-                        # the NIC pick is re-selected against live state in
-                        # the native call — decode the actual choice
+                        ok_idx = np.nonzero(ok)[0].tolist()
+                        busy_nodes.update(w_node_l[w] for w in ok_idx)
+                        winner_iter = [
+                            (w, w_pod_l[w], w_node_l[w], w_type_l[w])
+                            for w in ok_idx
+                        ]
+                    else:
+                        busy_nodes.update(w_node_l)
+                        winner_iter = zip(
+                            range(len(w_pod_l)), w_pod_l, w_node_l, w_type_l
+                        )
+                    n_ok = 0
+                    for w, pod_i, n, t in winner_iter:
+                        n_ok += 1
+                        item = items[pod_i]
+                        # the NIC pick is re-selected against live state
+                        # in the native call — decode the actual choice
                         mk = (w_c_l[w], w_m_l[w], picks_l[w])
                         mapping = memo.get(mk)
                         if mapping is None:
@@ -1206,15 +1204,13 @@ class BatchScheduler:
                             nic_list = [
                                 (row[g], bw, d) for g, bw, d in nic_tmpl[t]
                             ]
-                        busy_nodes.add(n)
                         results[pod_i] = BatchAssignment(
                             item.key, names[n], mapping, nic_list,
                             round_no,
                         )
-                        stats.scheduled += 1
+                    stats.scheduled += n_ok
                 stats.phase_add("materialize", time.perf_counter() - t_mat)
                 stats.assign_seconds += time.perf_counter() - t0
-                stats.round_end_seconds.append(time.perf_counter() - t_batch)
                 continue
 
             for pod_i, n, G, t, j in claims:

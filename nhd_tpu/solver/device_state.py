@@ -22,7 +22,6 @@ the jit cache.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, Iterable, Optional
 
 import jax
@@ -78,72 +77,26 @@ def _pad_rows(a: np.ndarray, size: int) -> np.ndarray:
     )
 
 
-from functools import partial
-
-
-def _scatter_donation() -> bool:
-    """Whether the row scatter donates its input buffers. Donation is the
-    right default (in-place update, no extra HBM); NHD_TPU_SCATTER=fresh
-    disables it — an A/B knob for the tunnel-attached TPU, where the
-    measured 838 ms per 40-row update (docs/TPU_STATUS.md) is suspected
-    to be donation forcing buffer round-trips through the relay."""
-    mode = os.environ.get("NHD_TPU_SCATTER", "donate").lower()
-    if mode not in ("donate", "fresh"):
-        raise ValueError(
-            f"NHD_TPU_SCATTER must be 'donate' or 'fresh', got {mode!r}"
-        )
-    return mode != "fresh"
-
-
-# fail fast on a typo'd value at import (matching the scheduler's env
-# knobs) — the per-call read above stays so a bench can A/B in-process
-_scatter_donation()
-
-
-def _scatter_impl(arrays, idx, rows):
-    # one dispatch updates every mutable array (a tunnel-attached TPU pays
-    # per-call latency)
-    return {
-        name: arrays[name].at[idx].set(rows[name]) for name in arrays
-    }
-
-
-_scatter_donate = jax.jit(_scatter_impl, donate_argnums=(0,))
-_scatter_fresh = jax.jit(_scatter_impl)
-
-
-def _scatter_all(arrays, idx, rows):
-    fn = _scatter_donate if _scatter_donation() else _scatter_fresh
-    return fn(arrays, idx, rows)
-
-
 from functools import lru_cache
 
 
 @lru_cache(maxsize=None)
-def _get_fused_ranked(G, U, K, R, n_idx, donate, use_pallas):
-    """One jitted program = (optional row scatter) + solve + top-R rank.
+def _get_fused_ranked(G, U, K, R, use_pallas):
+    """One jitted program = solve + top-R rank in ONE dispatch (the pull
+    of the packed rank tensor is the round's single relay flush). Cache
+    key is the bucket shape + R — a whole batch reuses one program.
 
-    On a tunnel-attached TPU every jitted call pays per-dispatch relay
-    latency (~hundreds of ms, docs/TPU_STATUS.md), so the three per-round
-    device calls — scatter the claimed rows, solve, rank — collapse into
-    ONE dispatch here. ``n_idx`` is the padded scatter width (0 = no
-    staged rows — that variant returns only the RankOut, so the untouched
-    mutable arrays are never copied to fresh output buffers); with a
-    scatter, the mutable arrays are donated so the update is in-place,
-    matching update_rows' semantics. Cache key is the bucket shape + R +
-    scatter width (pow-4-bucketed, see _padded_idx) — a whole batch
-    reuses a handful of programs."""
+    Claim updates reach the device as a wholesale async re-upload of the
+    mutable arrays (see update_rows), NOT as a fused scatter: the relay
+    charges per FLUSH, uploads batch into the next flush for free, and
+    every distinct scatter-width variant used to lazily compile its own
+    program mid-run (~1 s each through the tunnel) — one stable program
+    per shape beats O(claimed-rows) upload savings outright."""
     from nhd_tpu.solver.combos import get_tables
 
     tables = get_tables(G, U, K)
 
-    def fn(mutable, static, idx, rows, *pod_args):
-        if n_idx:
-            mutable = {
-                name: mutable[name].at[idx].set(rows[name])
-                for name in mutable
-            }
+    def fn(mutable, static, *pod_args):
         arrays = {**static, **mutable}
         out = _solve(
             tables,
@@ -151,28 +104,13 @@ def _get_fused_ranked(G, U, K, R, n_idx, donate, use_pallas):
             *pod_args,
             use_pallas=use_pallas,
         )
-        rank = _rank_body(
+        return _rank_body(
             R, out.cand, out.pref, out.best_c, out.best_m, out.best_a,
             out.n_picks,
             arrays["gpu_free"], arrays["cpu_free"], arrays["hp_free"],
         )
-        return (mutable, rank) if n_idx else rank
 
-    kwargs = {"donate_argnums": (0,)} if (donate and n_idx) else {}
-    return jax.jit(fn, **kwargs)
-
-
-@lru_cache(maxsize=None)
-def _get_sharded_scatter(sharding, donate: bool = True):
-    """Row scatter that pins its outputs to the node sharding — global row
-    indices, each shard applies the rows it owns."""
-
-    kwargs = {"donate_argnums": (0,)} if donate else {}
-    return jax.jit(
-        _scatter_impl,
-        out_shardings={name: sharding for name in _MUTABLE},
-        **kwargs,
-    )
+    return jax.jit(fn)
 
 
 class DeviceClusterState:
@@ -202,9 +140,9 @@ class DeviceClusterState:
 
             self._node_sharding = NamedSharding(self.mesh, P("nodes"))
         self._dev: Dict[str, jax.Array] = {}
-        # rows whose re-ship is deferred into the next solve dispatch
-        # (single-device path only — the mesh path applies immediately)
-        self._staged: set = set()
+        # claim-dirty flag: the mutable arrays re-upload wholesale (async)
+        # before the next solve dispatch — see update_rows
+        self._staged: bool = False
         for name in _ARG_ORDER:
             self._dev[name] = self._put(
                 _pad_rows(getattr(cluster, name), self.Np)
@@ -220,41 +158,21 @@ class DeviceClusterState:
         return jnp.asarray(padded)
 
     def stage_rows(self, indices: Iterable[int]) -> None:
-        """Mark claimed nodes whose host-mirror rows must reach the device
-        before the next solve. Single-device: deferred and FUSED into the
-        next solve_ranked dispatch (one tunnel round-trip instead of two);
-        the row content is read at dispatch time, when the mirror already
-        carries every claim of the round. Mesh: applied immediately via
-        the sharded scatter (the SPMD solve is a separate pjit program)."""
-        if self._node_sharding is not None:
-            self.update_rows(indices)
-        else:
-            self._staged.update(int(i) for i in indices)
+        """Mark the resident mutable arrays claim-dirty: the host mirror
+        re-uploads wholesale (async device_put, batched into the next
+        flush) before the next solve dispatch. The per-row scatter this
+        replaces was O(claimed-rows) on upload bytes but lazily compiled
+        a fresh program per scatter-width bucket — on the tunnel relay,
+        which charges ~65 ms per FLUSH and nothing per byte, the stable
+        single program wins outright (docs/TPU_STATUS.md r4)."""
+        for _ in indices:
+            self._staged = True
+            return
 
     def _flush_staged(self) -> None:
         if self._staged:
-            staged, self._staged = self._staged, set()
-            self.update_rows(staged)
-
-    def _padded_idx(self, indices: Iterable[int]) -> Optional[np.ndarray]:
-        """Claimed-row indices as a padded vector (padding repeats the
-        last index — idempotent for a row `set`), or None when empty. The
-        single construction every scatter variant shares.
-
-        Widths bucket to powers of FOUR (16, 64, 256, 1024, …): the width
-        is a jit-cache key, and on the fused path each distinct bucket
-        compiles a whole solve+rank program — pow-4 caps that at ~4
-        programs per batch while the padded upload stays within 4× the
-        claimed rows (still O(claimed), never O(N))."""
-        idx_list = sorted(set(indices))
-        if not idx_list:
-            return None
-        padded_len = 16
-        while padded_len < len(idx_list):
-            padded_len *= 4
-        idx = np.full(padded_len, idx_list[-1], np.int32)
-        idx[: len(idx_list)] = idx_list
-        return idx
+            self._staged = False
+            self._rebuild_mutable()
 
     def _pod_args(self, pods) -> list:
         """The 9 pod-type arrays padded to the pow-2 type bucket, in
@@ -271,19 +189,12 @@ class DeviceClusterState:
         ]
 
     def update_rows(self, indices: Iterable[int]) -> None:
-        """Re-ship the claimed nodes' rows (host ClusterArrays → device)."""
-        idx = self._padded_idx(indices)
-        if idx is None:
+        """Re-ship claim-mutated state (host ClusterArrays → device):
+        wholesale async re-upload of the mutable arrays (the host mirror
+        is the source of truth; ``indices`` only gates emptiness)."""
+        for _ in indices:
+            self._rebuild_mutable()
             return
-        mutable = {name: self._dev[name] for name in _MUTABLE}
-        rows = {name: getattr(self.cluster, name)[idx] for name in _MUTABLE}
-        scatter = (
-            _get_sharded_scatter(self._node_sharding, _scatter_donation())
-            if self._node_sharding is not None
-            else _scatter_all
-        )
-        updated = scatter(mutable, jnp.asarray(idx), rows)
-        self._dev.update(updated)
 
     def _solve_raw(self, pods) -> SolveOut:
         """The padded solver call against the resident arrays
@@ -314,9 +225,10 @@ class DeviceClusterState:
         the RESIDENT free arrays, which stage_rows/update_rows keep live
         between rounds).
 
-        Single device: ONE fused dispatch applies any staged row scatter,
-        solves, and ranks (per-call relay latency dominates the round on
-        the tunnel-attached TPU, so call count is the metric that
+        Single device: any claim-dirty state re-uploads asynchronously,
+        then ONE fused solve+rank dispatch — its result pull is the
+        round's single relay flush (per-flush latency dominates the round
+        on the tunnel-attached TPU, so flush count is the metric that
         matters). Mesh: the pjit SPMD solve + a replicated-output ranker —
         top_k over the sharded node axis is the one collective this adds."""
         R = min(R, self.Np)
@@ -332,33 +244,13 @@ class DeviceClusterState:
                 self._dev["hp_free"],
             )
 
-        idx = rows = None
-        n_idx = 0
-        idx_np = self._padded_idx(self._staged) if self._staged else None
-        if idx_np is not None:
-            self._staged = set()
-            n_idx = len(idx_np)
-            idx = jnp.asarray(idx_np)
-            rows = {
-                name: getattr(self.cluster, name)[idx_np]
-                for name in _MUTABLE
-            }
+        self._flush_staged()  # async wholesale re-upload of dirty state
         fused = _get_fused_ranked(
-            pods.G, self.cluster.U, self.cluster.K, R, n_idx,
-            _scatter_donation(), pallas_enabled(),
+            pods.G, self.cluster.U, self.cluster.K, R, pallas_enabled(),
         )
         mutable = {name: self._dev[name] for name in _MUTABLE}
         static = {name: self._dev[name] for name in _STATIC}
-        try:
-            out = fused(mutable, static, idx, rows, *self._pod_args(pods))
-            new_mutable, rank = out if n_idx else (None, out)
-        except BaseException:
-            if n_idx:
-                self._rebuild_mutable()
-            raise
-        if n_idx:
-            self._dev.update(new_mutable)
-        return rank
+        return fused(mutable, static, *self._pod_args(pods))
 
     def _rebuild_mutable(self) -> None:
         """Re-upload the claim-mutated resident arrays wholesale from the
@@ -399,7 +291,7 @@ class DeviceClusterState:
             )
         fn = _get_megaround(
             shapes, self.cluster.U, self.cluster.K, spec_iters(),
-            respect_busy, _scatter_donation(),
+            respect_busy, donate=True,
             out_shardings_key=out_shardings_key,
         )
         pod_args = []
@@ -416,8 +308,9 @@ class DeviceClusterState:
                 mutable, static, need, *pod_args
             )
         except BaseException:
-            if _scatter_donation():
-                self._rebuild_mutable()
+            # the dispatch donated the mutable arrays: restore them from
+            # the host mirror (source of truth)
+            self._rebuild_mutable()
             raise
         self._dev.update(new_mutable)
         return claims, counts

@@ -159,9 +159,10 @@ class StreamingScheduler:
         t_stream = time.perf_counter()
 
         stats = BatchStats()
-        results: List[BatchAssignment] = [
-            BatchAssignment(it.key, None) for it in items
-        ]
+        # results materialize lazily (sub-calls fill placed/verdict slots;
+        # the rest back-fill before return) — building 100k placeholder
+        # objects up front was measurable federation preamble
+        results: List[Optional[BatchAssignment]] = [None] * len(items)
         schedulable = [
             i for i, it in enumerate(items)
             if it.request.map_mode in (MapMode.NUMA, MapMode.PCI)
@@ -183,7 +184,9 @@ class StreamingScheduler:
             # empty node set (e.g. a multihost rank whose region slice is
             # empty): everything stays unschedulable, like the serial
             # sweep that simply had no tiles to visit
-            return results, stats
+            return (
+                [BatchAssignment(it.key, None) for it in items], stats
+            )
         # per-tile union of node groups: a pod with no group overlap can
         # skip the tile without a solve (same predicate the solver's
         # group_mask lattice applies, hoisted to the offer). No-op on
@@ -214,7 +217,7 @@ class StreamingScheduler:
             schedulable = [i for i in schedulable if i not in ov]
             stats.round_end_seconds.append(time.perf_counter() - t_stream)
             for i in oversized:
-                if results[i].node is not None:
+                if results[i] is not None and results[i].node is not None:
                     results[i].round_no = len(stats.round_end_seconds) - 1
 
         # one interner shared by every tile context so a chunk's pod
@@ -406,10 +409,12 @@ class StreamingScheduler:
                     if outstanding == 0:
                         done.notify_all()
 
-        # default workers to the visible CPU count: tile pipelining only
-        # pays when stages truly run in parallel — on a 1-core box (this
-        # dev image) extra workers just contend for the same core
-        default_workers = min(4, os.cpu_count() or 1)
+        # default 4 workers regardless of core count: tile stages spend
+        # much of their wall blocked on accelerator relay flushes and XLA
+        # solves (both release the GIL), so concurrent stages overlap
+        # those waits even on a 1-core host (measured cfg5 6.1→5.7 s);
+        # pure-Python stages serialize on the GIL either way
+        default_workers = 4
         n_workers = max(
             1,
             min(
@@ -478,6 +483,10 @@ class StreamingScheduler:
                     done.wait()
         if errors:
             raise errors[0]
+        # back-fill the lazy result slots (never-offered / unplaced pods)
+        for i, it in enumerate(items):
+            if results[i] is None:
+                results[i] = BatchAssignment(it.key, None)
         # stats.failed so far counts only the serial pre-pass (never
         # retried); add pods whose final tile verdict was a hard failure
         stats.failed += sum(
