@@ -47,7 +47,7 @@ def workload_mix(n_pods: int, groups_cycle: Sequence[str]) -> List[PodRequest]:
             groups=base.groups, misc=base.misc, hugepages_gb=base.hugepages_gb,
             map_mode=base.map_mode,
             node_groups=frozenset({group}),
-        ))
+        ).interned())
     return out
 
 

@@ -171,9 +171,15 @@ class BatchStats:
     # fine-grained wall breakdown (encode / spec_dispatch / spec_pull /
     # native_assign / materialize) — the overhead war's tracked metric
     phases: Dict[str, float] = field(default_factory=dict)
+    # event counts (per-round pending, speculative claims/rejects) — the
+    # round-convergence diagnostics the phase floats can't carry
+    counters: Dict[str, int] = field(default_factory=dict)
 
     def phase_add(self, name: str, dt: float) -> None:
         self.phases[name] = self.phases.get(name, 0.0) + dt
+
+    def count_add(self, name: str, k: int) -> None:
+        self.counters[name] = self.counters.get(name, 0) + int(k)
 
     def bind_latency_percentile(self, results, q: float) -> float:
         """p-th percentile bind latency over placed pods (seconds)."""
@@ -367,12 +373,20 @@ class BatchScheduler:
             # no eligible bucket, or the global type axis would overflow
             # the claim word's type field
             return None
-        # returns the IN-FLIGHT device (claims, counts) tensors: the
-        # dispatch is async, so the caller overlaps host prep (FastCluster
-        # join) under the relay turnaround before pulling them (np.asarray)
+        # returns the IN-FLIGHT device (claims, counts) tensors. The
+        # copy_to_host_async here is load-bearing: on the tunnel relay it
+        # STARTS the ~65 ms flush immediately (measured r5 — asarray
+        # later completes in flush-minus-elapsed), so every millisecond
+        # of host prep between dispatch and pull (FastCluster join,
+        # expand prep) hides under the in-flight flush
         claims_arr, counts_arr = dev.megaround(
             bucket_pods, needs, self.respect_busy
         )
+        try:
+            claims_arr.copy_to_host_async()
+            counts_arr.copy_to_host_async()
+        except Exception:
+            pass  # backend without async host copies
         return bucket_keys, bucket_pods, claims_arr, counts_arr
 
     def _expand_speculative(self, spec, claims_np, counts_np, cluster):
@@ -600,12 +614,6 @@ class BatchScheduler:
         # assignment, unplaced offered slots are back-filled before return
         # (building 10k placeholder objects up front was measurable wall)
         results: List[Optional[BatchAssignment]] = [None] * len(items)
-        pending: List[int] = [
-            i for i in (
-                range(len(items)) if offer is None else offer
-            )
-            if items[i].request.map_mode in (MapMode.NUMA, MapMode.PCI)
-        ]
         if now is None:
             now = context.now if context is not None else time.monotonic()
 
@@ -621,21 +629,32 @@ class BatchScheduler:
         if context is None and not self.respect_busy:
             cluster.busy[:] = False
 
-        # combo lattices too large for dense enumeration take the serial
-        # oracle path up front — claims land on the host mirror before the
-        # batched state is snapshotted below (tractability memoized per
-        # group count: one bucket verdict covers a whole gang)
+        # ONE fused pass collects the schedulable set AND the combo-
+        # oversized subset (tractability memoized per group count: one
+        # bucket verdict covers a whole gang; two separate comprehensions
+        # each touching 10k request objects were measurable wall). From
+        # here ``pending`` lives as an int64 array — membership updates
+        # are np.isin over winner arrays, not Python set diffs.
         _tract: Dict[int, bool] = {}
-
-        def _tractable(G: int) -> bool:
+        pending_l: List[int] = []
+        oversized: List[int] = []
+        _sched_modes = (MapMode.NUMA, MapMode.PCI)
+        _U, _K = cluster.U, cluster.K
+        t_pre = time.perf_counter()
+        for i in range(len(items)) if offer is None else offer:
+            r = items[i].request
+            if r.map_mode not in _sched_modes:
+                continue
+            pending_l.append(i)
+            G = len(r.groups)
             v = _tract.get(G)
             if v is None:
-                v = _tract[G] = bucket_tractable(G, cluster.U, cluster.K)
-            return v
-
-        oversized = [
-            i for i in pending if not _tractable(items[i].request.n_groups)
-        ]
+                v = _tract[G] = bucket_tractable(G, _U, _K)
+            if not v:
+                oversized.append(i)
+        pending = np.asarray(pending_l, np.int64)
+        del pending_l
+        stats.phase_add("prepass", time.perf_counter() - t_pre)
         if oversized and context is not None:
             # serial claims would mutate the HostNode mirror behind the
             # context's packed arrays
@@ -652,8 +671,7 @@ class BatchScheduler:
             self._schedule_serial(
                 nodes, items, oversized, results, stats, now, apply
             )
-            ov = set(oversized)
-            pending = [i for i in pending if i not in ov]
+            pending = pending[~np.isin(pending, oversized)]
             if apply:  # serial claims mutated the mirror: re-project
                 cluster = encode_cluster(
                     nodes, now=now, interner=cluster.interner
@@ -713,9 +731,11 @@ class BatchScheduler:
 
         t_batch = time.perf_counter()
         for round_no in range(self.max_rounds):
-            if not pending:
+            if not len(pending):
                 break
             stats.rounds = round_no + 1
+            if round_no < 8:
+                stats.count_add(f"pending_r{round_no}", len(pending))
 
             t0 = time.perf_counter()
             try:
@@ -724,10 +744,12 @@ class BatchScheduler:
                     # encode the whole pending set once (or reuse the
                     # caller's chunk-wide encode) and only filter
                     # membership below
+                    pend_list = pending.tolist()  # np iteration boxes per
+                    #                               element; tolist is C
                     all_buckets = encoded if encoded is not None else encode_pods(
-                        [items[i].request for i in pending],
+                        [items[i].request for i in pend_list],
                         cluster.interner,
-                        indices=pending,
+                        indices=pend_list,
                     )
                     stats.phase_add("encode", time.perf_counter() - t0)
                     # R >= the largest per-type pod count: every ranked
@@ -866,21 +888,20 @@ class BatchScheduler:
                 # in-flight megaround) compute in the XLA pool: the build
                 # hides under the relay turnaround, and the worker never
                 # outlives schedule()
+                t_j = time.perf_counter()
                 fast = fast_future.result()
                 fast_future = None
+                stats.phase_add("fast_join", time.perf_counter() - t_j)
             claims_np = counts_np = None
             if spec_round:
                 # ONE relay flush pulls the claim tensor AND its counts
-                # plane: copy_to_host_async on both BEFORE the first
-                # blocking asarray batches the transfers (sequential
-                # asarray pulls each pay the full ~65 ms turnaround —
+                # plane; the flush was started by the copy_to_host_async
+                # at dispatch (_speculate_dispatch), so the FastCluster
+                # join above ran under it and this asarray pays only the
+                # remaining flush time (sequential asarray pulls without
+                # the async batch each pay a full ~65 ms turnaround —
                 # measured 130 ms vs 65 ms, docs/TPU_STATUS.md r4)
                 t_pull = time.perf_counter()
-                try:
-                    spec[2].copy_to_host_async()
-                    spec[3].copy_to_host_async()
-                except Exception:
-                    pass  # backend without async host copies
                 claims_np = np.asarray(spec[2])
                 counts_np = np.asarray(spec[3])
                 stats.phase_add("spec_pull", time.perf_counter() - t_pull)
@@ -1087,6 +1108,11 @@ class BatchScheduler:
                     native_out
                 ):
                     ok = buffers[0] >= 0
+                    if round_no < 8:
+                        stats.count_add(f"claims_r{round_no}", len(w_pod))
+                        stats.count_add(
+                            f"rejects_r{round_no}", int((~ok).sum())
+                        )
                     first = np.zeros(len(w_pod), bool)
                     if not spec_round:
                         uniq, fi = np.unique(w_node, return_index=True)
@@ -1098,17 +1124,17 @@ class BatchScheduler:
                         seen_first.update(uniq.tolist())
                     first_masks.append(first)
                     removed.append(w_pod[ok | first])
-                done = (
-                    set(np.concatenate(removed).tolist()) if removed else set()
-                )
-                pending = [i for i in pending if i not in done]
+                if removed:
+                    pending = pending[
+                        ~np.isin(pending, np.concatenate(removed))
+                    ]
 
                 # dispatch round r+1's solves NOW — the arrays already
                 # carry this round's claims, so the Python result
                 # materialization below overlaps the next XLA compute
                 # (a small leftover routes to the host CPU backend: its
                 # solve beats the accelerator's fixed relay turnaround)
-                if pending and round_no + 1 < self.max_rounds:
+                if len(pending) and round_no + 1 < self.max_rounds:
                     is_pending[:] = False
                     is_pending[pending] = True
                     prelaunched = _dispatch_solves(_route_cpu(len(pending)))
@@ -1153,7 +1179,8 @@ class BatchScheduler:
                     memo: Dict[tuple, object] = {}
                     ok = status >= 0
                     applied_on_node.update(w_node_l)
-                    if not ok.all():
+                    all_ok = bool(ok.all())
+                    if not all_ok:
                         # failure pass: a first-on-node failure is final
                         # (it ran against fresh feasibility); later
                         # same-node failures — and every speculative
@@ -1183,14 +1210,16 @@ class BatchScheduler:
                         winner_iter = zip(
                             range(len(w_pod_l)), w_pod_l, w_node_l, w_type_l
                         )
-                    n_ok = 0
+                        ok_idx = None
+                    n_ok = len(w_pod_l) if all_ok else len(ok_idx)
+                    BA = BatchAssignment
+                    memo_get = memo.get
                     for w, pod_i, n, t in winner_iter:
-                        n_ok += 1
                         item = items[pod_i]
                         # the NIC pick is re-selected against live state
                         # in the native call — decode the actual choice
                         mk = (w_c_l[w], w_m_l[w], picks_l[w])
-                        mapping = memo.get(mk)
+                        mapping = memo_get(mk)
                         if mapping is None:
                             mapping = memo[mk] = decode_mapping(
                                 G, U_, K_, mk[0], mk[1], mk[2],
@@ -1204,7 +1233,7 @@ class BatchScheduler:
                             nic_list = [
                                 (row[g], bw, d) for g, bw, d in nic_tmpl[t]
                             ]
-                        results[pod_i] = BatchAssignment(
+                        results[pod_i] = BA(
                             item.key, names[n], mapping, nic_list,
                             round_no,
                         )
@@ -1325,8 +1354,8 @@ class BatchScheduler:
             stats.assign_seconds += time.perf_counter() - t0
             stats.round_end_seconds.append(time.perf_counter() - t_batch)
 
-            done = set(newly_scheduled)
-            pending = [i for i in pending if i not in done]
+            if newly_scheduled:
+                pending = pending[~np.isin(pending, newly_scheduled)]
             if not apply:
                 break  # without claims, later rounds would repeat choices
 
@@ -1371,7 +1400,9 @@ class BatchScheduler:
 
         # back-fill the lazy result slots: every offered-but-unplaced pod
         # reports an explicit unschedulable entry
+        t_bf = time.perf_counter()
         for i in range(len(items)) if offer is None else offer:
             if results[i] is None:
                 results[i] = BatchAssignment(items[i].key, None)
+        stats.phase_add("backfill", time.perf_counter() - t_bf)
         return results, stats

@@ -395,16 +395,30 @@ def encode_pods(
     if indices is None:
         indices = range(len(pods))
     buckets: Dict[int, Tuple[List[PodRequest], List[int], List[int], Dict[PodRequest, int]]] = {}
+    # gang batches arrive bucket-coherent, so the per-pod loop caches the
+    # last bucket's bindings — this loop runs once per pod of a 10k gang
+    # and is most of the encode phase's wall (r5)
+    last_g = -1
+    reqs: List[PodRequest] = []
+    seen: Dict[PodRequest, int] = {}
+    types_append = positions_append = None
     for pod, idx in zip(pods, indices):
-        G = pod.n_groups
-        reqs, types, positions, seen = buckets.setdefault(G, ([], [], [], {}))
+        G = len(pod.groups)
+        if G != last_g:
+            b = buckets.get(G)
+            if b is None:
+                b = buckets[G] = ([], [], [], {})
+            reqs, types, positions, seen = b
+            types_append = types.append
+            positions_append = positions.append
+            last_g = G
         t = seen.get(pod)
         if t is None:
             t = len(reqs)
             seen[pod] = t
             reqs.append(pod)
-        types.append(t)
-        positions.append(idx)
+        types_append(t)
+        positions_append(idx)
 
     out: Dict[int, PodTypeArrays] = {}
     for G, (reqs, types, positions, _) in buckets.items():

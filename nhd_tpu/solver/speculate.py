@@ -39,11 +39,13 @@ count-k claim into k consecutive pods of the type (the native verify
 re-selects NIC picks per copy against live state, as it always did).
 With NIC sharing disabled (the reference default, Node.py:20) the NIC
 projection switches from per-pick bandwidth deltas to OCCUPANCY: a
-copy consumes one free NIC per NIC-needing group per NUMA, and the
-loop zeroes that many lowest-indexed free NICs — exact for the
-skew-preferred cross-NUMA combos, conservative when groups of one pod
-share a NUMA (in-pod sharing can make the real consumption smaller;
-leftovers retry classically).
+copy consumes the number of DISTINCT NICs the solve's chosen
+(combo, pick) touches per NUMA — groups of one pod sharing a NIC
+(the joint-bandwidth semantics the solve and the native first-
+feasible pick both honor) count once — and the loop zeroes that many
+lowest-indexed free NICs per NUMA. r5: the earlier one-NIC-per-group
+count was conservative under in-pod sharing and stranded the last
+pods of a full cluster into an extra classic round.
 
 Reference parity anchor: the loop realizes the same round semantics as
 solver/batch.py (SURVEY §7 hard part 2), which batches the reference's
@@ -171,9 +173,20 @@ def _get_megaround(
             # NIC-needing groups per (type, combo, numa): the occupancy
             # consumption (and per-copy capacity divisor) of a claim
             needs_nic_g = ((rx + tx) > 0).astype(f32)        # [Tp, G]
+            # distinct NICs a claim at (combo, pick) occupies per NUMA:
+            # groups of ONE pod may share a NIC (the solve's joint-
+            # bandwidth predicate and the native first-feasible pick both
+            # honor it, kernel._solve / fast_assign._reselect_picks), so
+            # occupancy counts distinct chosen (u, k) slots with any
+            # NIC-needing group — NOT one NIC per group, which strands
+            # the last same-NUMA-sharing pods of a full cluster (r5)
+            occ_slots = jnp.einsum(
+                "tg,caguk->tcauk", needs_nic_g, choose
+            ).reshape(Tp, tb.C * tb.A, U, K)
             per_bucket.append(dict(
                 pod_args=pod_args[9 * b : 9 * b + 9],
                 G=G, C=tb.C, A=tb.A,
+                nic_occ=(occ_slots > 0).astype(f32).sum(-1),  # [Tp,C*A,U]
                 # [Tp, C, U] per-combo group demand
                 cpu_g_smt=jnp.einsum(
                     "tg,cgu->tcu", cpu_dem_smt[:, :-1].astype(f32), combo_onehot),
@@ -190,7 +203,6 @@ def _get_megaround(
                     Tp, tb.C * tb.A, U, K),
                 nic_tx=jnp.einsum("tg,caguk->tcauk", tx, choose).reshape(
                     Tp, tb.C * tb.A, U, K),
-                nic_need_u=jnp.einsum("tg,cgu->tcu", needs_nic_g, combo_onehot),
                 hp=hp.astype(jnp.int32),
                 has_nic=jnp.any((rx + tx) > 0, axis=1),
                 needs_gpu=needs_gpu,
@@ -271,7 +283,8 @@ def _get_megaround(
             # range masks (each node's elected row lives in one bucket)
             cpu_dem_n = jnp.zeros((N, U), f32)   # demand at chosen (c, m)
             gpu_dem_n = jnp.zeros((N, U), f32)
-            nic_need_n = jnp.zeros((N, U), f32)  # NIC-needing groups per numa
+            nic_occ_n = jnp.zeros((N, U), f32)   # distinct NICs consumed
+            #                                      per numa at (c, a)
             hp_n = jnp.zeros(N, f32)
             cap1_n = jnp.zeros(N, bool)          # force single-copy rows
             for b, (G, Tp) in enumerate(bucket_shapes):
@@ -289,8 +302,9 @@ def _get_megaround(
                 )  # [N, U]
                 cpu_dem_n = jnp.where(sel, dem, cpu_dem_n)
                 gpu_dem_n = jnp.where(sel, pb["gpu_g"][tloc, cb], gpu_dem_n)
-                nic_need_n = jnp.where(
-                    sel, pb["nic_need_u"][tloc, cb], nic_need_n)
+                ca = cb * pb["A"] + jnp.clip(a_n, 0, pb["A"] - 1)
+                nic_occ_n = jnp.where(
+                    sel, pb["nic_occ"][tloc, ca], nic_occ_n)
                 hp_n = jnp.where(in_b, pb["hp"].astype(f32)[tloc], hp_n)
                 one = pb["needs_gpu"][tloc] if respect_busy else False
                 if ENABLE_NIC_SHARING:
@@ -311,10 +325,10 @@ def _get_megaround(
             cap_n = _div_min_u(cpu_free_u, cpu_dem_n)
             cap_n = jnp.minimum(cap_n, _div_min_u(gpu_free_u, gpu_dem_n))
             if not ENABLE_NIC_SHARING:
-                # occupancy bound: free NICs per NUMA over NIC-needing
-                # groups per NUMA at the chosen combo, min across NUMAs
+                # occupancy bound: free NICs per NUMA over distinct NICs
+                # the chosen (combo, pick) occupies there, min across NUMAs
                 cap_n = jnp.minimum(
-                    cap_n, _div_min_u(free_nic_cnt, nic_need_n))
+                    cap_n, _div_min_u(free_nic_cnt, nic_occ_n))
             cap_n = jnp.minimum(cap_n, jnp.where(
                 hp_n > 0,
                 jnp.floor(hp_free_n / jnp.maximum(hp_n, 1e-6)), INF,
@@ -402,7 +416,7 @@ def _get_megaround(
                     nic_delta = nic_delta.at[..., 1].add(
                         w * pb["nic_tx"][tloc, ca])
             else:
-                nic_consume = k_n[:, None] * nic_need_n      # [N, U]
+                nic_consume = k_n[:, None] * nic_occ_n       # [N, U]
             new_mut["cpu_free"] = (
                 mutable["cpu_free"].astype(jnp.float32) - cpu_delta
             ).astype(mutable["cpu_free"].dtype)

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Round-5 probe: where do cfg4's rounds=2 and cfg5's 4.4 s spec_dispatch
+come from?
+
+Runs cfg4 (and with --fed, cfg5) exactly like bench.py but prints the new
+BatchStats.counters (per-round pending / claims / native rejects) plus the
+phase breakdown, so the leftover-pod source (need_left vs verify
+rejection) is observable instead of guessed.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/nhd_tpu_jax_cache")
+    print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+
+    from bench import run_batch, run_stream
+    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+
+    groups = ["default", "edge", "batch"]
+    reqs = workload_mix(10_000, groups)
+    wall, placed, stats, results = run_batch(cap_cluster(1_000, groups), reqs)
+    print(
+        f"cfg4: wall={wall * 1e3:.0f}ms placed={placed} rounds={stats.rounds}",
+        file=sys.stderr,
+    )
+    print(f"cfg4 phases: {stats.phases}", file=sys.stderr)
+    print(f"cfg4 counters: {stats.counters}", file=sys.stderr)
+    acc = stats.solve_seconds + stats.select_seconds + stats.assign_seconds
+    print(
+        f"cfg4 coarse: solve={stats.solve_seconds * 1e3:.1f}ms "
+        f"select={stats.select_seconds * 1e3:.1f}ms "
+        f"assign={stats.assign_seconds * 1e3:.1f}ms "
+        f"unaccounted={max(0.0, wall - acc) * 1e3:.1f}ms",
+        file=sys.stderr,
+    )
+
+    if "--fed" in sys.argv:
+        groups5 = ["default", "edge", "batch", "fed1", "fed2"]
+        reqs5 = workload_mix(100_000, groups5)
+        wall, placed, stats, results = run_stream(
+            cap_cluster(10_000, groups5), reqs5
+        )
+        print(
+            f"cfg5: wall={wall:.2f}s placed={placed} rounds={stats.rounds} "
+            f"p99={stats.bind_latency_percentile(results, 99):.2f}s",
+            file=sys.stderr,
+        )
+        print(f"cfg5 phases: {stats.phases}", file=sys.stderr)
+        print(f"cfg5 counters: {stats.counters}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
