@@ -85,8 +85,6 @@ def _init_jax(platform: str):
 
 
 def run_batch(nodes, reqs, *, warm: bool = True):
-    import gc
-
     from nhd_tpu.solver import BatchItem, BatchScheduler
 
     sched = BatchScheduler(respect_busy=False, register_pods=False)
@@ -104,16 +102,9 @@ def run_batch(nodes, reqs, *, warm: bool = True):
         sched.schedule(nodes, items, now=0.0)
         for n in nodes.values():
             n.reset_resources()
-        gc.collect()
-        gc.freeze()
     t0 = time.perf_counter()
     results, stats = sched.schedule(nodes, items, now=0.0)
     wall = time.perf_counter() - t0
-    if warm:
-        # un-pin the heap: a permanent freeze would accumulate every
-        # config's dead-but-cyclic objects across the bench sweep
-        gc.unfreeze()
-        gc.collect()
     placed = sum(1 for r in results if r.node)
     return wall, placed, stats, results
 
@@ -137,15 +128,17 @@ def run_serial_baseline(nodes, reqs, sample: int):
     return (time.perf_counter() - t0) / max(sample, 1)
 
 
-def run_stream(nodes, reqs, *, tile_nodes=4096, chunk_pods=None,
+def run_stream(nodes, reqs, *, tile_nodes=16384, chunk_pods=None,
                placement="routed"):
     """Schedule through the streaming solver (cfg5 federation path).
 
-    tile_nodes=4096 keeps tiles exactly at their power-of-two padding
-    (zero solve waste; the 10k-node remainder tile pads 1808→2048) and
-    'routed' placement pre-partitions pods across tiles by estimated
-    capacity so tiles run concurrently (measured best on this config —
-    rounds drop ~2.4× vs first-fit spill through saturated tiles).
+    tile_nodes is an HBM-budget choice: a 16k-node tile's solve fits a
+    16 GB chip with room to spare, and every extra tile costs a relay
+    flush plus a serialized host tail — the 10k-node federation in ONE
+    tile (one megaround, one flush) measured 2.4 s / p99 1.2 s vs
+    2.9 s / p99 2.3 s for three 4096-node tiles (r5). Smaller tiles
+    remain the right call for federations larger than device memory or
+    per-region multi-host splits (solver/streaming.py docstring).
     chunk_pods is backend-dependent: an accelerator pays per-dispatch
     relay latency, so one big chunk minimizes (tile, chunk) sub-calls
     (measured 5.8 s vs 6.6 s on the tunnel TPU); on CPU a 50k chunk
@@ -154,11 +147,15 @@ def run_stream(nodes, reqs, *, tile_nodes=4096, chunk_pods=None,
     A warmup pass on a tile-shaped throwaway cluster takes the solver
     compiles out of the timed run — same policy as cfg1-4, whose shapes
     are warmed by the earlier configs; true cold behavior is what
-    bench[cold-start] reports.
+    bench[cold-start] reports. The warm cluster MUST be the same node
+    family as the measured one: solver programs key on the (U, K)
+    paddings, and cap_cluster's K=7 NIC shape is not bench_cluster's
+    K=2 — warming the wrong family left every megaround compile inside
+    the timed run (r4/r5: multi-second spec_dispatch).
     """
     import jax
 
-    from nhd_tpu.sim.workloads import bench_cluster, workload_mix
+    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
     from nhd_tpu.solver import BatchItem, StreamingScheduler
 
     if chunk_pods is None:
@@ -168,9 +165,13 @@ def run_stream(nodes, reqs, *, tile_nodes=4096, chunk_pods=None,
         respect_busy=False, register_pods=False,
     )
 
-    warm_nodes = bench_cluster(
-        min(tile_nodes + 1808, len(nodes)), ["default", "edge", "batch",
-                                            "fed1", "fed2"],
+    # warm-cluster sizing must reproduce the REAL run's tile shapes (the
+    # compiled programs key on the padded node count): one full tile plus
+    # the real run's remainder tile, if any
+    rem = len(nodes) % tile_nodes
+    warm_n = min(len(nodes), tile_nodes + rem if rem else tile_nodes)
+    warm_nodes = cap_cluster(
+        warm_n, ["default", "edge", "batch", "fed1", "fed2"],
     )
     warm_reqs = workload_mix(4096, ["default", "edge", "batch", "fed1",
                                     "fed2"])
@@ -183,18 +184,12 @@ def run_stream(nodes, reqs, *, tile_nodes=4096, chunk_pods=None,
     )
 
     items = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
-    # pin the warm heap: a major gc pass over the ~10M-object federation
-    # working set costs seconds mid-run (measured as multi-second stalls
-    # inside otherwise-tiny spill sub-calls)
-    import gc
-
-    gc.collect()
-    gc.freeze()
+    # heap pinning for the sweep lives in StreamingScheduler.schedule
+    # itself (gc.freeze over the federation mirror) — the bench adds no
+    # gc management of its own
     t0 = time.perf_counter()
     results, stats = sched.schedule(nodes, items, now=0.0)
     wall = time.perf_counter() - t0
-    gc.unfreeze()
-    gc.collect()
     placed = sum(1 for r in results if r.node)
     return wall, placed, stats, results
 

@@ -124,6 +124,55 @@ def _fc_executor():
     return _FC_EXECUTOR
 
 
+class GcPin:
+    """Process-wide heap pin for scheduler sweeps (see
+    BatchScheduler.schedule). Reentrancy is tracked with an explicit
+    flag, NOT gc.get_freeze_count(): interpreter startup can leave a
+    nonzero permanent generation (observed 375 objects on this image),
+    and keying on the count would silently disable pinning forever.
+    The streaming sweep takes the pin once for its whole run; the
+    per-tile BatchScheduler calls inside it see ``active`` and leave gc
+    alone. An embedding app that manages its own freeze should set
+    NHD_TPU_GC_PIN=0 (our unfreeze would return its frozen objects to
+    the normal generations)."""
+
+    active = False
+
+    @classmethod
+    def acquire(cls) -> bool:
+        import gc
+        import os
+
+        if cls.active or os.environ.get("NHD_TPU_GC_PIN", "1") == "0":
+            return False
+        cls.active = True
+        gc.freeze()
+        return True
+
+    @classmethod
+    def release(cls, held: bool) -> None:
+        if held:
+            import gc
+
+            gc.unfreeze()
+            cls.active = False
+
+
+def _gc_pinned(fn):
+    """Wrap a schedule call in GcPin acquire/release."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        held = GcPin.acquire()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            GcPin.release(held)
+
+    return wrapper
+
+
 def _accelerator_backend() -> bool:
     import jax
 
@@ -338,15 +387,19 @@ class BatchScheduler:
 
         bucket_keys, bucket_pods, needs = [], [], []
         t_total = 0
+        need_total = 0
         for G, full in all_buckets.items():
             mask = is_pending[full.pod_index]
-            if not mask.any():
-                continue
-            # keep the FULL type rows (no _filter_types shrink): absent
-            # types just carry zero need, and the stable (G, Tp) shape
-            # means every streaming tile of a chunk reuses ONE compiled
-            # megaround — a tile whose pod subset shrank the type bucket
-            # was paying a fresh ~1 s trace+compile through the tunnel
+            # keep the FULL type rows (no _filter_types shrink) AND keep
+            # empty/all-PCI buckets in the dispatch: absent types and
+            # dead buckets just carry zero need, and the stable
+            # bucket_shapes tuple means every sub-call of a streaming
+            # chunk (spill offers often hold pods of only some buckets)
+            # reuses ONE compiled megaround — a changed bucket subset was
+            # paying a fresh ~1 s trace+compile through the tunnel per
+            # distinct subset (r5: 4 subset shapes = 4.4 s of cfg5's
+            # spec_dispatch). The loop body skips zero-need buckets at
+            # runtime via lax.cond, so they cost no device compute.
             pods = replace(
                 full,
                 pod_type=full.pod_type[mask],
@@ -355,23 +408,31 @@ class BatchScheduler:
             Tp = _pad_pow2(pods.n_types)
             need = np.bincount(pods.pod_type, minlength=Tp).astype(np.int32)
             need[: pods.n_types][pods.map_pci] = 0
-            if not need.any():
-                # an all-PCI bucket would solve on every loop iteration
-                # for zero possible claims — leave it to classic rounds
-                continue
             U, K = dev.cluster.U, dev.cluster.K
-            if (U**pods.G) * (max(K, 1) ** pods.G) * U >= (1 << _T_SHIFT):
+            if (
+                need.any()
+                and (U**pods.G) * (max(K, 1) ** pods.G) * U
+                >= (1 << _T_SHIFT)
+            ):
                 # the packed claim word's (c*U+m)*A + a field would
                 # overflow (an NHD_TPU_MAX_LATTICE raise can get here):
-                # classic rounds handle any lattice
+                # classic rounds handle any lattice. A ZERO-need bucket
+                # of that size is harmless — it can never claim (the
+                # election requires need > 0), so it rides along for
+                # shape stability like any other dead bucket
                 return None
             bucket_keys.append(G)
             bucket_pods.append(pods)
             needs.append(need)
             t_total += Tp
-        if not bucket_keys or t_total >= (1 << (31 - _T_SHIFT)):
-            # no eligible bucket, or the global type axis would overflow
-            # the claim word's type field
+            need_total += int(need.sum())
+        if (
+            not bucket_keys
+            or need_total == 0
+            or t_total >= (1 << (31 - _T_SHIFT))
+        ):
+            # nothing to speculate (e.g. all-PCI batch), or the global
+            # type axis would overflow the claim word's type field
             return None
         # returns the IN-FLIGHT device (claims, counts) tensors. The
         # copy_to_host_async here is load-bearing: on the tunnel relay it
@@ -575,6 +636,7 @@ class BatchScheduler:
         dev = DeviceClusterState(cluster, mesh) if use_dev else None
         return ScheduleContext(nodes, cluster, fast, dev, now)
 
+    @_gc_pinned
     def schedule(
         self,
         nodes: Dict[str, HostNode],
@@ -587,6 +649,15 @@ class BatchScheduler:
         offer: Optional[Sequence[int]] = None,
     ) -> Tuple[List[BatchAssignment], BatchStats]:
         """Place every item it can; mutates ``nodes`` when ``apply``.
+
+        The pre-existing heap (node mirror, contexts) is gc.freeze-pinned
+        for the duration of gang-scale calls so generational collections
+        scan only batch-allocated objects — a major pass over a large
+        mirror mid-batch is a multi-ms stall the scheduler, not the
+        caller, should prevent. Skipped when the embedding process (e.g.
+        the streaming sweep, which freezes once for its whole run)
+        already holds a freeze. Both freeze() and unfreeze() are O(1)
+        generation-list splices.
 
         Items without a topology get a synthetic one (sim.requests), so
         physical assignment always runs — claims must hit the host mirror
@@ -850,6 +921,8 @@ class BatchScheduler:
                 )
 
             use_cpu_round = _route_cpu(len(pending))
+            if use_cpu_round:
+                stats.count_add("cpu_routed_rounds", 1)
             spec_round = spec_ok and round_no == 0 and not use_cpu_round
             spec = None
             if prelaunched is not None:

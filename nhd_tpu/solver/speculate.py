@@ -218,23 +218,46 @@ def _get_megaround(
 
             cand_rows, val_rows, c_rows, m_rows, a_rows = [], [], [], [], []
             for b, tb in enumerate(tables):
-                out = _solve(
-                    tb,
-                    *[cur[name] for name in _ARG_ORDER],
-                    *per_bucket[b]["pod_args"],
-                    use_pallas=False,
-                )
-                cand_rows.append(out.cand)
-                val_rows.append(
-                    jnp.where(
+                # dead buckets (all needs zero — spill offers often hold
+                # pods of one bucket only, and late iterations drain
+                # buckets at different rates) skip their solve at RUNTIME:
+                # the bucket stays in the program so the compiled shape is
+                # stable across every sub-call of a streaming chunk
+                Tp_b = bucket_shapes[b][1]
+                lo_b = int(offsets[b])
+
+                def _solve_b(_, b=b, tb=tb):
+                    out = _solve(
+                        tb,
+                        *[cur[name] for name in _ARG_ORDER],
+                        *per_bucket[b]["pod_args"],
+                        use_pallas=False,
+                    )
+                    val = jnp.where(
                         out.cand,
                         out.pref * (N + 1) + (N - n_idx)[None, :],
                         0,
                     )
+                    return (
+                        out.cand, val,
+                        out.best_c.astype(jnp.int32),
+                        out.best_m.astype(jnp.int32),
+                        out.best_a.astype(jnp.int32),
+                    )
+
+                def _skip_b(_, Tp_b=Tp_b):
+                    z = jnp.zeros((Tp_b, N), jnp.int32)
+                    return jnp.zeros((Tp_b, N), bool), z, z, z, z
+
+                cand_b, val_b, c_b, m_b, a_b = jax.lax.cond(
+                    jnp.sum(need[lo_b : lo_b + Tp_b]) > 0,
+                    _solve_b, _skip_b, operand=None,
                 )
-                c_rows.append(out.best_c)
-                m_rows.append(out.best_m)
-                a_rows.append(out.best_a)
+                cand_rows.append(cand_b)
+                val_rows.append(val_b)
+                c_rows.append(c_b)
+                m_rows.append(m_b)
+                a_rows.append(a_b)
             cand = jnp.concatenate(cand_rows)      # [Tt, N]
             val = jnp.concatenate(val_rows)        # [Tt, N] int32
             best_c = jnp.concatenate(c_rows)

@@ -158,6 +158,30 @@ class StreamingScheduler:
             now = time.monotonic()
         t_stream = time.perf_counter()
 
+        # pin the pre-existing heap for the sweep: a federation-scale node
+        # mirror is ~10M objects, and a major gc pass mid-run traverses
+        # all of them (measured as multi-second stalls inside otherwise-
+        # tiny spill sub-calls). freeze() moves the current generations to
+        # the permanent set (cheap, no collection) so in-sweep collections
+        # scan only sweep-allocated objects; unfreeze() at exit returns
+        # them to the normal generations for the next natural collection.
+        # GcPin holds the pin across every per-tile sub-call (their own
+        # acquire sees it active and leaves gc alone).
+        from nhd_tpu.solver.batch import GcPin
+
+        held = GcPin.acquire()
+        try:
+            return self._schedule_inner(nodes, items, now, t_stream)
+        finally:
+            GcPin.release(held)
+
+    def _schedule_inner(
+        self,
+        nodes: Dict[str, HostNode],
+        items: Sequence[BatchItem],
+        now: float,
+        t_stream: float,
+    ) -> Tuple[List[BatchAssignment], BatchStats]:
         stats = BatchStats()
         # results materialize lazily (sub-calls fill placed/verdict slots;
         # the rest back-fill before return) — building 100k placeholder
@@ -336,6 +360,8 @@ class StreamingScheduler:
                 stats.scheduled += sub_stats.scheduled
                 for name, dt in sub_stats.phases.items():
                     stats.phase_add(name, dt)
+                for name, k in sub_stats.counters.items():
+                    stats.count_add(name, k)
                 # NOT sub_stats.failed: a pod failing its first-on-node
                 # claim in one tile is re-offered to later tiles, so
                 # per-tile failure counts would double-book; terminal
@@ -356,7 +382,10 @@ class StreamingScheduler:
                     if certify and not r.failed:
                         exhausted[ti].add(items[pod_i].request)
                     continue
-                if r.round_no >= 0:
+                if r.round_no >= 0 and offset:
+                    # remap the sub-call round into the streaming timeline;
+                    # the first sub-call (offset 0) needs no remap, and at
+                    # federation scale 100k reconstructions are real wall
                     r = BatchAssignment(
                         r.key, r.node, r.mapping, r.nic_list,
                         r.round_no + offset,

@@ -33,8 +33,14 @@ _WORKER = textwrap.dedent("""
     import os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
     # the virtual 8-device mesh of the parent suite must not leak in:
-    # each process contributes exactly one device to the global mesh
-    os.environ["XLA_FLAGS"] = ""
+    # each process contributes exactly dev_per_proc devices (argv[5],
+    # default one) to the global mesh — the multi-device-per-process
+    # shape is a real TPU host's (several chips per process)
+    dev_per_proc = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={dev_per_proc}"
+        if dev_per_proc > 1 else ""
+    )
     import jax
     jax.config.update("jax_platforms", "cpu")
     from jax._src import xla_bridge as _xb
@@ -109,8 +115,8 @@ _WORKER = textwrap.dedent("""
         nodes = make_cluster(8)
         cluster = encode_cluster(nodes, now=0.0)
         pods = encode_pods([simple_request(gpus=1)], cluster.interner)[1]
-        mesh = make_mesh(jax.devices())   # global: one device per process
-        assert mesh.devices.size == nproc
+        mesh = make_mesh(jax.devices())   # global: all devices, all processes
+        assert mesh.devices.size == nproc * dev_per_proc
         out = solve_bucket_sharded(cluster, pods, mesh)
         ref = solve_bucket(cluster, pods)
         np.testing.assert_array_equal(out.cand, np.asarray(ref.cand))
@@ -131,7 +137,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_procs_once(scenario: str, nproc: int, dead_rank: int) -> Optional[str]:
+def _run_procs_once(
+    scenario: str, nproc: int, dead_rank: int, dev_per_proc: int = 1
+) -> Optional[str]:
     """One orchestration attempt; returns an error description or None."""
     from tests.conftest import subprocess_env
 
@@ -140,7 +148,7 @@ def _run_procs_once(scenario: str, nproc: int, dead_rank: int) -> Optional[str]:
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, str(rank), str(nproc), str(port),
-             scenario],
+             scenario, str(dev_per_proc)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env,
         )
@@ -171,15 +179,17 @@ def _run_procs_once(scenario: str, nproc: int, dead_rank: int) -> Optional[str]:
     return None
 
 
-def _run_procs(scenario: str, nproc: int, dead_rank: int = -1) -> None:
+def _run_procs(
+    scenario: str, nproc: int, dead_rank: int = -1, dev_per_proc: int = 1
+) -> None:
     """Run the scenario, retrying ONCE with a fresh coordinator port: the
     bind-then-release port probe (_free_port) can race another process
     grabbing the same ephemeral port before the coordinator rebinds it —
     a rare flake observed only when several distributed tests run
     back-to-back. A real regression fails both attempts."""
-    err = _run_procs_once(scenario, nproc, dead_rank)
+    err = _run_procs_once(scenario, nproc, dead_rank, dev_per_proc)
     if err is not None:
-        err = _run_procs_once(scenario, nproc, dead_rank)
+        err = _run_procs_once(scenario, nproc, dead_rank, dev_per_proc)
     if err is not None:
         pytest.fail(err)
 
@@ -192,6 +202,15 @@ def test_multi_process_region_scheduling(nproc):
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_multi_process_global_spmd_solve(nproc):
     _run_procs("spmd", nproc)
+
+
+def test_multi_process_multi_device_spmd_solve():
+    """2 processes × 4 virtual devices each — the real TPU-host shape
+    (several chips per process) for the global SPMD solve: an 8-device
+    mesh whose shards live in two OS processes, cross-process collectives
+    included, bit-identical to the local single-device solve (VERDICT r4
+    item 6: no multi-device-per-process leg existed)."""
+    _run_procs("spmd", 2, dev_per_proc=4)
 
 
 def test_rank_failure_survivors_and_takeover():

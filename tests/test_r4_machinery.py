@@ -1,0 +1,268 @@
+"""Pins for the round-4/5 hot-path machinery (VERDICT r4 task 4).
+
+Three mechanisms got semantic rewrites without dedicated tests:
+multi-copy speculative claims (the counts plane, per-node capacity caps,
+balanced fill, and the r5 exact NIC-occupancy projection), the CPU
+routing of small rounds (`use_cpu=True` dispatch branch — previously
+unreachable in CI because the suite forces the CPU backend), and the
+wholesale async re-upload that replaced per-row scatters
+(update_rows → _rebuild_mutable). Each is named and pinned here.
+"""
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+from nhd_tpu.core.topology import MapMode, SmtMode
+from nhd_tpu.sim import SynthNodeSpec, make_node
+from nhd_tpu.solver import BatchItem, BatchScheduler
+from tests.test_batch import items
+from tests.test_jax_matcher import random_cluster, random_request
+
+
+def spec_scheduler(**kw):
+    return BatchScheduler(
+        respect_busy=False, register_pods=False, device_state=True,
+        mesh=None, **kw,
+    )
+
+
+def uniform_cluster(n_nodes: int, **spec_kw):
+    nodes = {}
+    for i in range(n_nodes):
+        spec = SynthNodeSpec(name=f"uni{i:03d}", **spec_kw)
+        nodes[spec.name] = make_node(spec)
+    return nodes
+
+
+def plain_pod(cores: int = 2, gpus: int = 0, rx: float = 0.0,
+              tx: float = 0.0, n_groups: int = 1) -> PodRequest:
+    return PodRequest(
+        groups=tuple(
+            GroupRequest(
+                proc=CpuRequest(cores, SmtMode.ON),
+                misc=CpuRequest(0, SmtMode.ON),
+                gpus=gpus, nic_rx_gbps=rx, nic_tx_gbps=tx,
+            )
+            for _ in range(n_groups)
+        ),
+        misc=CpuRequest(0, SmtMode.ON),
+        hugepages_gb=0,
+        map_mode=MapMode.NUMA,
+    ).interned()
+
+
+# ---------------------------------------------------------------------------
+# (a) multi-copy claims: counts plane, capacity caps, balanced fill,
+#     exact NIC occupancy
+# ---------------------------------------------------------------------------
+
+
+def test_multicopy_lands_a_gang_in_few_iterations(monkeypatch):
+    """The counts plane must carry multiple copies per (iter, node): a
+    gang far larger than iters × nodes can only land speculatively if
+    cap(t, n) > 1 engages. iters=2, 2 nodes, 24 identical pods — the
+    single-copy loop could claim at most 4 in round 0."""
+    monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+    monkeypatch.setenv("NHD_TPU_SPEC_ITERS", "2")
+    nodes = uniform_cluster(2, phys_cores=32, gpus_per_numa=0,
+                            nics_per_numa=2, hugepages_gb=64)
+    reqs = [plain_pod(cores=2) for _ in range(24)]
+    results, stats = spec_scheduler().schedule(nodes, items(reqs), now=0.0)
+    placed_r0 = sum(1 for r in results if r.node and r.round_no == 0)
+    assert placed_r0 == 24, (placed_r0, stats.counters)
+
+
+def test_multicopy_balanced_fill_spreads_across_nodes(monkeypatch):
+    """The per-node take is ceil(need / elected), not cap: a gang whose
+    nodes could each absorb the whole batch must still spread evenly
+    (the classic interleave's packing shape — an unbalanced fill
+    concentrates types and costs placements on tight instances)."""
+    monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+    monkeypatch.setenv("NHD_TPU_SPEC_ITERS", "8")
+    n_nodes, n_pods = 4, 8
+    nodes = uniform_cluster(n_nodes, phys_cores=32, gpus_per_numa=0,
+                            nics_per_numa=2, hugepages_gb=64)
+    reqs = [plain_pod(cores=2) for _ in range(n_pods)]
+    results, stats = spec_scheduler().schedule(nodes, items(reqs), now=0.0)
+    from collections import Counter
+
+    per_node = Counter(r.node for r in results if r.node)
+    assert sum(per_node.values()) == n_pods
+    assert set(per_node.values()) == {n_pods // n_nodes}, per_node
+
+
+def test_nic_occupancy_counts_shared_nics_once(monkeypatch):
+    """r5 regression pin: a two-NIC-group pod whose groups share one NIC
+    (joint bandwidth fits) must be claimable speculatively even when
+    free NICs per NUMA < NIC-needing groups. The pre-r5 projection
+    charged one NIC per group and stranded exactly these pods into an
+    extra classic round (observed as cfg4 rounds=2 on the capacity-
+    matched bench)."""
+    monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+    monkeypatch.setenv("NHD_TPU_SPEC_ITERS", "8")
+    # one NIC per NUMA: a 2-group NIC pod MUST share (cross-NUMA combos
+    # also exist, so fill both NUMAs' NICs with single-group pods first
+    # is fiddly — instead give the pod two groups whose joint bw fits
+    # one NIC and make the node single-NUMA-ish by packing)
+    nodes = uniform_cluster(1, phys_cores=16, gpus_per_numa=0,
+                            nics_per_numa=1, hugepages_gb=64)
+    # two NIC-needing groups, joint 15+7 Gbps on a 100G NIC: the node has
+    # 2 NUMAs x 1 NIC. Two such pods exhaust both NICs only if sharing
+    # is honored per pod (each pod fits on ONE numa's NIC or cross-numa);
+    # four single-NIC-group pods then need the remaining NICs.
+    two_group = plain_pod(cores=2, rx=10.0, tx=5.0, n_groups=2)
+    reqs = [two_group, two_group]
+    results, stats = spec_scheduler().schedule(nodes, items(reqs), now=0.0)
+    placed = sum(1 for r in results if r.node)
+    assert placed == 2, (placed, stats.counters)
+    # the r5 projection lands both in the speculative round — no classic
+    # retry round for a workload the native verify accepts outright
+    assert stats.rounds == 1, stats.counters
+    assert all(r.round_no == 0 for r in results if r.node)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_multicopy_random_never_oversubscribes_and_matches_classic(
+    seed, monkeypatch
+):
+    """Property sweep: on random degraded clusters the multi-copy
+    speculative path (a) never oversubscribes any resource, and (b)
+    places within greedy-packing noise of the classic rounds."""
+    monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+    monkeypatch.setenv("NHD_TPU_SPEC_ITERS", "8")
+    rng = random.Random(1000 + seed)
+    reqs = [random_request(rng) for _ in range(50)]
+    nodes_s = random_cluster(rng, 10)
+    nodes_c = copy.deepcopy(nodes_s)
+    gpu_cap = {name: n.total_gpus() for name, n in nodes_s.items()}
+
+    rs, ss = spec_scheduler().schedule(nodes_s, items(reqs), now=1010.0)
+    rc, sc = BatchScheduler(
+        respect_busy=False, register_pods=False, device_state=False,
+        mesh=None,
+    ).schedule(nodes_c, items(reqs), now=1010.0)
+
+    for name, n in nodes_s.items():
+        assert 0 <= n.free_gpu_count() <= gpu_cap[name]
+        assert all(c >= 0 for c in n.free_cpu_cores_per_numa())
+        assert n.mem.free_hugepages_gb >= 0
+        for nic in n.nics:
+            rx, tx = nic.free_bw()
+            assert rx >= 0 and tx >= 0
+    assert abs(ss.scheduled - sc.scheduled) <= max(2, sc.scheduled // 20), (
+        f"speculative {ss.scheduled} vs classic {sc.scheduled}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# (b) CPU routing of small rounds: the use_cpu=True dispatch branch
+# ---------------------------------------------------------------------------
+
+
+def test_cpu_routed_round_runs_and_places(monkeypatch):
+    """_route_cpu needs an accelerator default backend, which CI never
+    has — monkeypatch the probe so the `use_cpu=True` branch (solving
+    under jax.default_device against host arrays while device state is
+    live) actually executes, and assert it both ran and placed
+    everything the classic path places."""
+    import nhd_tpu.solver.batch as batch_mod
+
+    monkeypatch.setattr(batch_mod, "_accelerator_backend", lambda: True)
+    monkeypatch.setenv("NHD_TPU_SPECULATE", "0")  # classic rounds only
+    monkeypatch.setenv("NHD_TPU_CPU_SMALL", "1024")
+    monkeypatch.setenv("NHD_TPU_CPU_SMALL_NODES", "1536")
+
+    nodes = uniform_cluster(8, phys_cores=16, gpus_per_numa=1,
+                            nics_per_numa=2, hugepages_gb=64)
+    reqs = [plain_pod(cores=2, gpus=(i % 2)) for i in range(24)]
+    results, stats = BatchScheduler(
+        respect_busy=False, register_pods=False, device_state=True,
+        mesh=None,
+    ).schedule(nodes, items(reqs), now=0.0)
+    assert stats.counters.get("cpu_routed_rounds", 0) >= 1, stats.counters
+    placed = sum(1 for r in results if r.node)
+    assert placed == 24, placed
+
+
+def test_cpu_routed_after_speculative_round(monkeypatch):
+    """The common production shape: a megaround places the bulk, the
+    small leftover routes to the host CPU backend. Forcing iters=1
+    guarantees a leftover, and the tail round must report cpu routing
+    while still converging."""
+    import nhd_tpu.solver.batch as batch_mod
+
+    monkeypatch.setattr(batch_mod, "_accelerator_backend", lambda: True)
+    monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+    monkeypatch.setenv("NHD_TPU_SPEC_ITERS", "1")
+
+    nodes = uniform_cluster(2, phys_cores=16, gpus_per_numa=0,
+                            nics_per_numa=2, hugepages_gb=64)
+    # two types => iters=1 can elect at most one type per node; with a
+    # fair fill the leftover is nonzero and takes the CPU-routed tail
+    reqs = [plain_pod(cores=2) for _ in range(8)] + [
+        plain_pod(cores=4) for _ in range(8)
+    ]
+    results, stats = spec_scheduler().schedule(nodes, items(reqs), now=0.0)
+    placed = sum(1 for r in results if r.node)
+    assert placed == 16, (placed, stats.counters)
+    assert stats.counters.get("cpu_routed_rounds", 0) >= 1, stats.counters
+
+
+# ---------------------------------------------------------------------------
+# (c) wholesale re-upload: update_rows / _rebuild_mutable convergence
+# ---------------------------------------------------------------------------
+
+
+def test_update_rows_converges_device_to_host_truth():
+    """After host-side claims mutate the cluster arrays, update_rows
+    must make the resident device state solve identically to a fresh
+    encode — the wholesale async re-upload is the only coherence
+    mechanism left since the row scatters were removed (r4)."""
+    from nhd_tpu.solver.device_state import DeviceClusterState
+    from nhd_tpu.solver.encode import encode_cluster, encode_pods
+    from nhd_tpu.solver.kernel import solve_bucket
+
+    nodes = uniform_cluster(6, phys_cores=16, gpus_per_numa=1,
+                            nics_per_numa=2, hugepages_gb=64)
+    cluster = encode_cluster(nodes, now=0.0)
+    dev = DeviceClusterState(cluster)
+    buckets = encode_pods([plain_pod(cores=2, gpus=1)], cluster.interner)
+    (pods,) = buckets.values()
+
+    # host-side mutation: consume most of nodes 0-2 directly in the
+    # packed arrays (the FastCluster/native path writes these in place)
+    cluster.cpu_free[0:3] = 1
+    cluster.gpu_free[0:3] = 0
+    cluster.hp_free[0:3] = 0
+    dev.update_rows([0, 1, 2])
+
+    got = dev.solve(pods)
+    want = solve_bucket(cluster, pods)
+    np.testing.assert_array_equal(
+        np.asarray(got.cand), np.asarray(want.cand)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.best_c), np.asarray(want.best_c)
+    )
+    # the mutated rows must actually be infeasible now
+    assert not np.asarray(got.cand)[:, 0:3].any()
+    assert np.asarray(got.cand)[:, 3:6].any()
+
+
+def test_update_rows_noop_on_empty_indices():
+    """update_rows with no indices must not re-upload (the emptiness
+    gate is what keeps claim-free rounds from paying an upload)."""
+    from nhd_tpu.solver.device_state import DeviceClusterState
+    from nhd_tpu.solver.encode import encode_cluster
+
+    nodes = uniform_cluster(2, phys_cores=8)
+    cluster = encode_cluster(nodes, now=0.0)
+    dev = DeviceClusterState(cluster)
+    before = {name: dev._dev[name] for name in dev._dev}
+    dev.update_rows([])
+    for name, arr in before.items():
+        assert dev._dev[name] is arr
