@@ -31,7 +31,6 @@ import os
 import subprocess
 import sys
 import time
-from typing import Optional
 
 
 def _log(msg: str) -> None:
@@ -64,8 +63,7 @@ def _pick_platform() -> str:
         if plat == "tpu":
             _log(f"bench: TPU probe OK (platform={plat})")
             return "default"
-        # a healthy probe on a TPU-less box reports its cpu backend —
-        # that must NOT arm the TPU-only legs (the pallas-compare child)
+        # a healthy probe on a TPU-less box reports its cpu backend
         _log(f"bench: probe OK but platform={plat}; running on CPU")
         return "cpu"
     _log("bench: TPU backend unavailable; falling back to CPU\n"
@@ -226,95 +224,6 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40,
     return {"wall": wall, "placed": placed, "speedup": speedup}
 
 
-def bench_pallas_compare(deadline: Optional[int] = None) -> None:
-    """TPU-only: the raw bucket solve with the Pallas NIC path vs plain
-    XLA at the headline shape, both compiled on the real chip (VERDICT r1
-    weak-2: the kernel had never been compiled or timed on hardware).
-    Informational — the default path is chosen from these numbers.
-
-    Runs as a DEADLINE-BOUNDED SUBPROCESS before the parent claims the
-    chip: the first on-chip Mosaic compile of the kernel was observed to
-    hang >28 min through the tunnel relay (r3), and an in-process hang
-    would eat the whole bench — the driver would record nothing for the
-    round. The child claims the chip, measures, and exits; on timeout it
-    is killed and the parent re-probes before claiming (a killed claimant
-    can wedge the relay — docs/TPU_STATUS.md — so the probe result
-    decides whether the rest of the bench still runs on TPU)."""
-    deadline = deadline or int(os.environ.get("NHD_BENCH_PALLAS_DEADLINE", "180"))
-    try:
-        child = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--pallas-child"],
-            capture_output=True, text=True, timeout=deadline,
-        )
-    except subprocess.TimeoutExpired as exc:
-        _log(f"bench[pallas-compare]: exceeded {deadline}s deadline "
-             "(on-chip Mosaic compile hang); killed — skipping")
-        partial = exc.stderr or ""
-        if isinstance(partial, bytes):
-            partial = partial.decode(errors="replace")
-        for line in partial.splitlines():
-            if "pallas-compare" in line:
-                _log(line)  # e.g. the xla leg, measured before the hang
-        return
-    tail = child.stderr.strip().splitlines()
-    for line in tail:
-        if "pallas-compare" in line:
-            _log(line)
-    if child.returncode != 0 and not any("pallas-compare" in l for l in tail):
-        _log(f"bench[pallas-compare]: child failed rc={child.returncode}: "
-             + "\n".join(tail[-3:]))
-
-
-def _pallas_compare_child() -> None:
-    """The measurement body, executed in the deadline-bounded child."""
-    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
-    from nhd_tpu.solver.encode import encode_cluster, encode_pods
-    from nhd_tpu.solver.kernel import solve_bucket
-
-    nodes = cap_cluster(1000, ["default", "edge", "batch"])
-    reqs = workload_mix(64, ["default", "edge", "batch"])
-    cluster = encode_cluster(nodes, now=0.0)
-    buckets = encode_pods(reqs, cluster.interner)
-
-    results = {}
-    saved = os.environ.get("NHD_TPU_PALLAS")
-    try:
-        for label, flag in (("xla", "0"), ("pallas", "1")):
-            os.environ["NHD_TPU_PALLAS"] = flag
-            try:
-                for G, pods in buckets.items():  # warm/compile
-                    out = solve_bucket(cluster, pods)
-                    out.cand.block_until_ready()
-                t0 = time.perf_counter()
-                for _ in range(10):
-                    for G, pods in buckets.items():
-                        out = solve_bucket(cluster, pods)
-                    out.cand.block_until_ready()
-                results[label] = (time.perf_counter() - t0) / 10
-                # log each leg the moment it lands: if the next leg's
-                # on-chip compile hangs past the deadline, the parent
-                # still forwards this line from the killed child's
-                # partial stderr
-                _log(f"bench[pallas-compare]: {label} leg = "
-                     f"{results[label] * 1e3:.2f}ms")
-            except Exception as exc:  # pallas lowering may fail on some shapes
-                _log(f"bench[pallas-compare]: {label} path failed: {exc!r:.200}")
-                results[label] = None
-    finally:
-        # restore the caller's choice — the rest of the bench must run the
-        # path the user asked for
-        if saved is None:
-            os.environ.pop("NHD_TPU_PALLAS", None)
-        else:
-            os.environ["NHD_TPU_PALLAS"] = saved
-    if results.get("xla") and results.get("pallas"):
-        ratio = results["xla"] / results["pallas"]
-        _log(f"bench[pallas-compare]: solve 10kx1k shape — "
-             f"xla={results['xla'] * 1e3:.2f}ms "
-             f"pallas={results['pallas'] * 1e3:.2f}ms "
-             f"(pallas {ratio:.2f}x vs xla)")
-
-
 def make_fake_sched(n_nodes: int, prefix: str, hugepages_gb: int = None):
     """Fake backend + initialized Scheduler — shared bench scaffolding."""
     import queue as queue_mod
@@ -429,13 +338,6 @@ def bench_bind_latency(n_pods: int = 200) -> None:
 
 def main() -> None:
     platform = _pick_platform()
-    if platform != "cpu" and not os.environ.get("NHD_BENCH_SKIP_PALLAS"):
-        # deadline-bounded child claims the chip, measures, exits —
-        # BEFORE this process claims it (two concurrent claims contend).
-        # A killed child can wedge the relay, so re-probe: the second
-        # probe decides whether the rest of the bench still sees the TPU.
-        bench_pallas_compare()
-        platform = _pick_platform()
     jax = _init_jax(platform)
     _log(f"bench platform: {jax.devices()[0].platform} "
          f"({len(jax.devices())} device(s))")
@@ -485,8 +387,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--pallas-child" in sys.argv:
-        _init_jax("default")
-        _pallas_compare_child()
-    else:
-        main()
+    main()

@@ -158,15 +158,24 @@ class GcPin:
             cls.active = False
 
 
+_GC_PIN_MIN_ITEMS = 4096
+
+
 def _gc_pinned(fn):
-    """Wrap a schedule call in GcPin acquire/release."""
+    """Wrap a schedule call in GcPin acquire/release — but only for
+    gang-scale batches (>= _GC_PIN_MIN_ITEMS items). Pinning every
+    small bind would promote the whole young heap to the oldest
+    generation per call (gc.unfreeze feeds the permanent set into
+    gen2), starving generational collection on the frequent small-batch
+    path of a long-running daemon — the exact stall class the pin
+    exists to prevent."""
     import functools
 
     @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        held = GcPin.acquire()
+    def wrapper(self, nodes, items, **kwargs):
+        held = GcPin.acquire() if len(items) >= _GC_PIN_MIN_ITEMS else False
         try:
-            return fn(*args, **kwargs)
+            return fn(self, nodes, items, **kwargs)
         finally:
             GcPin.release(held)
 
@@ -409,17 +418,23 @@ class BatchScheduler:
             need = np.bincount(pods.pod_type, minlength=Tp).astype(np.int32)
             need[: pods.n_types][pods.map_pci] = 0
             U, K = dev.cluster.U, dev.cluster.K
-            if (
-                need.any()
-                and (U**pods.G) * (max(K, 1) ** pods.G) * U
-                >= (1 << _T_SHIFT)
-            ):
+            word_overflow = (
+                (U**pods.G) * (max(K, 1) ** pods.G) * U >= (1 << _T_SHIFT)
+            )
+            if word_overflow or not bucket_tractable(pods.G, U, K):
+                if not need.any():
+                    # a zero-need bucket whose lattice is word-overflowing
+                    # or intractable must NOT ride along for shape
+                    # stability: merely building its combo tables
+                    # (get_tables) is the explosion the tractability
+                    # budget exists to prevent. It can never GAIN need
+                    # within a chunk (oversized pods are pre-routed to
+                    # the serial path), so skipping it keeps shapes
+                    # stable across the chunk's sub-calls anyway.
+                    continue
                 # the packed claim word's (c*U+m)*A + a field would
                 # overflow (an NHD_TPU_MAX_LATTICE raise can get here):
-                # classic rounds handle any lattice. A ZERO-need bucket
-                # of that size is harmless — it can never claim (the
-                # election requires need > 0), so it rides along for
-                # shape stability like any other dead bucket
+                # classic rounds handle any lattice
                 return None
             bucket_keys.append(G)
             bucket_pods.append(pods)

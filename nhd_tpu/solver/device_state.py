@@ -35,25 +35,10 @@ from nhd_tpu.solver.kernel import (
     _get_ranker,
     _rank_body,
     _solve,
-    pallas_enabled,
     _pad_pow2,
     get_solver,
     pad_nodes,
 )
-
-_pallas_mesh_warned = False
-
-
-def _warn_pallas_mesh_once() -> None:
-    global _pallas_mesh_warned
-    if not _pallas_mesh_warned:
-        _pallas_mesh_warned = True
-        from nhd_tpu.utils import get_logger
-
-        get_logger(__name__).warning(
-            "NHD_TPU_PALLAS=1 is ignored on the sharded (mesh) solve path;"
-            " solving via the pjit SPMD solver without the Pallas kernel"
-        )
 
 
 # node arrays that claims mutate; the rest are uploaded once and never touched
@@ -81,7 +66,7 @@ from functools import lru_cache
 
 
 @lru_cache(maxsize=None)
-def _get_fused_ranked(G, U, K, R, use_pallas):
+def _get_fused_ranked(G, U, K, R):
     """One jitted program = solve + top-R rank in ONE dispatch (the pull
     of the packed rank tensor is the round's single relay flush). Cache
     key is the bucket shape + R — a whole batch reuses one program.
@@ -102,7 +87,6 @@ def _get_fused_ranked(G, U, K, R, use_pallas):
             tables,
             *[arrays[name] for name in _ARG_ORDER],
             *pod_args,
-            use_pallas=use_pallas,
         )
         return _rank_body(
             R, out.cand, out.pref, out.best_c, out.best_m, out.best_a,
@@ -127,13 +111,7 @@ class DeviceClusterState:
         self.N = cluster.n_nodes
         self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
         n_dev = self.mesh.devices.size if self.mesh else 1
-        # the sharded solver never lowers through Pallas (per-shard node
-        # extents fall below the kernel's lane tile), so on the mesh path
-        # NHD_TPU_PALLAS must not inflate padding it can't benefit from
-        use_pallas = pallas_enabled() and self.mesh is None
-        if pallas_enabled() and self.mesh is not None:
-            _warn_pallas_mesh_once()
-        self.Np = pad_nodes(self.N, n_dev, floor=128 if use_pallas else 8)
+        self.Np = pad_nodes(self.N, n_dev, floor=8)
         self._node_sharding = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -246,7 +224,7 @@ class DeviceClusterState:
 
         self._flush_staged()  # async wholesale re-upload of dirty state
         fused = _get_fused_ranked(
-            pods.G, self.cluster.U, self.cluster.K, R, pallas_enabled(),
+            pods.G, self.cluster.U, self.cluster.K, R,
         )
         mutable = {name: self._dev[name] for name in _MUTABLE}
         static = {name: self._dev[name] for name in _STATIC}

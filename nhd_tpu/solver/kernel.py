@@ -45,7 +45,6 @@ def _solve(
     # pod-type arrays
     cpu_dem_smt, cpu_dem_raw, gpu_dem, rx, tx, hp, needs_gpu, map_pci,
     pod_gmask,
-    *, use_pallas: bool = False,
 ) -> SolveOut:
     C, A, U, K = tables.C, tables.A, tables.U, tables.K
     combo_onehot = jnp.asarray(tables.combo_onehot)          # [C,G,U]
@@ -125,33 +124,19 @@ def _solve(
     sw_need = jnp.einsum("cauk,nuks->ncas", chosen_cnt, sw_onehot)
     pci_ok = jnp.all(sw_need <= gpu_free_sw[:, None, None, :], axis=-1)  # [N,C,A]
 
-    if use_pallas:
-        # stream node blocks through VMEM instead of materializing the
-        # [T, N, C, A] lattice (nhd_tpu/ops/nic_pallas.py)
-        from nhd_tpu.ops.nic_pallas import nic_any_first
-
-        T, N = rx.shape[0], nic_free.shape[0]
-        nic_any, first_a, nic_pick_count = nic_any_first(
-            nic_free[..., 0].reshape(N, U * K),
-            nic_free[..., 1].reshape(N, U * K),
-            dem_rx.reshape(T, C * A, U * K),
-            dem_tx.reshape(T, C * A, U * K),
-            jnp.asarray(tables.chosen_cnt == 0).reshape(C * A, U * K),
-            pick_valid.reshape(N, C * A),
-            pci_ok.reshape(N, C * A),
-            map_pci.astype(jnp.int32),
-            U=U, K=K, C=C, A=A,
-            interpret=jax.default_backend() != "tpu",
-        )
-    else:
-        nic_ok = (
-            fit
-            & pick_valid[None]
-            & (pci_ok[None] | ~map_pci[:, None, None, None])
-        )  # [T, N, C, A]
-        nic_any = jnp.any(nic_ok, axis=-1)  # [T, N, C]
-        first_a = jnp.argmax(nic_ok, axis=-1).astype(jnp.int32)  # [T, N, C]
-        nic_pick_count = jnp.sum(nic_ok, axis=-1).astype(jnp.int32)
+    # the [T, N, C, A] lattice fuses into these reductions (XLA never
+    # materializes it in HBM). A Pallas VMEM-streaming variant of this
+    # nest was retired 2026-07-29 after four rounds of unresolvable
+    # on-chip Mosaic compile hangs; the artifact lives in
+    # attic/nic_pallas.py and the decision record in docs/DESIGN.md.
+    nic_ok = (
+        fit
+        & pick_valid[None]
+        & (pci_ok[None] | ~map_pci[:, None, None, None])
+    )  # [T, N, C, A]
+    nic_any = jnp.any(nic_ok, axis=-1)  # [T, N, C]
+    first_a = jnp.argmax(nic_ok, axis=-1).astype(jnp.int32)  # [T, N, C]
+    nic_pick_count = jnp.sum(nic_ok, axis=-1).astype(jnp.int32)
 
     # ---- intersection on the group prefix (reference: Matcher.py:337-390) ----
     feasible = (
@@ -184,12 +169,6 @@ def _solve(
     return SolveOut(cand, pref, best_c, best_m, best_a, n_combos, n_picks)
 
 
-def pallas_enabled() -> bool:
-    """Whether the Pallas NIC path is on (NHD_TPU_PALLAS=1), read
-    dynamically so a benchmark can A/B it in one process. Must not change
-    mid-batch: the padding floor and the solver cache key both consult it."""
-    return os.environ.get("NHD_TPU_PALLAS") == "1"
-
 # combo-lattice ceiling: (U^G) * (K^G) above this routes the bucket to the
 # serial oracle instead of enumerating a huge static axis (a 6-group pod on
 # a 4-NUMA/8-NIC cluster would otherwise demand a 2^30-wide tensor)
@@ -201,19 +180,14 @@ def bucket_tractable(n_groups: int, n_numa: int, max_nic: int) -> bool:
     return (n_numa ** n_groups) * (max(max_nic, 1) ** n_groups) <= MAX_LATTICE
 
 
+@lru_cache(maxsize=None)
 def get_solver(n_groups: int, n_numa: int, max_nic: int):
     """A jitted solver specialized to one bucket shape; tables are closure
-    constants so XLA folds them. The Pallas toggle is part of the cache
-    key so an in-process A/B (bench.py on TPU) gets distinct programs."""
-    return _get_solver(n_groups, n_numa, max_nic, pallas_enabled())
-
-
-@lru_cache(maxsize=None)
-def _get_solver(n_groups: int, n_numa: int, max_nic: int, use_pallas: bool):
+    constants so XLA folds them."""
     tables = get_tables(n_groups, n_numa, max_nic)
 
     def fn(*args):
-        return _solve(tables, *args, use_pallas=use_pallas)
+        return _solve(tables, *args)
 
     return jax.jit(fn)
 
@@ -340,7 +314,7 @@ def solve_bucket_ranked(cluster, pods, R: int) -> jax.Array:
     [T, N] outputs on host. Returns the packed [9, Tp, R] tensor —
     callers slice [:, :T]."""
     N = cluster.n_nodes
-    Np = _pad_pow2(N, floor=128 if pallas_enabled() else 8)
+    Np = _pad_pow2(N, floor=8)
 
     def pad_n(a):
         if a.shape[0] == Np:
@@ -361,7 +335,7 @@ def solve_bucket_ranked(cluster, pods, R: int) -> jax.Array:
 def _solve_padded(cluster, pods) -> SolveOut:
     """The padded solver call (full [Tp, Np] outputs, no host slicing)."""
     T, N = pods.n_types, cluster.n_nodes
-    Tp, Np = _pad_pow2(T), _pad_pow2(N, floor=128 if pallas_enabled() else 8)
+    Tp, Np = _pad_pow2(T), _pad_pow2(N, floor=8)
 
     def pad_n(a):
         if a.shape[0] == Np:

@@ -231,7 +231,6 @@ def _get_megaround(
                         tb,
                         *[cur[name] for name in _ARG_ORDER],
                         *per_bucket[b]["pod_args"],
-                        use_pallas=False,
                     )
                     val = jnp.where(
                         out.cand,

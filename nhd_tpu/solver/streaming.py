@@ -166,10 +166,14 @@ class StreamingScheduler:
         # scan only sweep-allocated objects; unfreeze() at exit returns
         # them to the normal generations for the next natural collection.
         # GcPin holds the pin across every per-tile sub-call (their own
-        # acquire sees it active and leaves gc alone).
-        from nhd_tpu.solver.batch import GcPin
+        # acquire sees it active and leaves gc alone). Small sweeps skip
+        # the pin — see batch._gc_pinned for why per-call pinning of
+        # small batches would starve generational collection.
+        from nhd_tpu.solver.batch import _GC_PIN_MIN_ITEMS, GcPin
 
-        held = GcPin.acquire()
+        held = (
+            GcPin.acquire() if len(items) >= _GC_PIN_MIN_ITEMS else False
+        )
         try:
             return self._schedule_inner(nodes, items, now, t_stream)
         finally:
