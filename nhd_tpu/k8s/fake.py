@@ -62,6 +62,8 @@ class FakeClusterBackend(ClusterBackend):
     def __init__(self) -> None:
         self.logger = get_logger(__name__)
         self._lock = threading.RLock()
+        # wakes a blocked poll_watch_events the moment an event lands
+        self._watch_cv = threading.Condition(self._lock)
         self.nodes: Dict[str, FakeNode] = {}
         self.pods: Dict[Tuple[str, str], FakePod] = {}
         self.configmaps: Dict[Tuple[str, str], str] = {}  # (ns, name) → text
@@ -123,7 +125,7 @@ class FakeClusterBackend(ClusterBackend):
                 pod.configmap_name = cm
             self.pods[(ns, name)] = pod
             if emit_watch:
-                self._watch.append(
+                self._emit_watch(
                     WatchEvent(kind="pod_create", name=name, namespace=ns,
                                annotations=dict(pod.annotations), uid=uid,
                                scheduler_name=pod.scheduler_name)
@@ -135,7 +137,7 @@ class FakeClusterBackend(ClusterBackend):
         with self._lock:
             pod = self.pods.pop((ns, name), None)
             if pod and emit_watch:
-                self._watch.append(
+                self._emit_watch(
                     WatchEvent(kind="pod_delete", name=name, namespace=ns,
                                annotations=dict(pod.annotations), uid=pod.uid,
                                scheduler_name=pod.scheduler_name,
@@ -151,7 +153,7 @@ class FakeClusterBackend(ClusterBackend):
             node = self.nodes[name]
             was = node.unschedulable
             node.unschedulable = cordon
-            self._watch.append(
+            self._emit_watch(
                 WatchEvent(kind="node_update", name=name,
                            labels=dict(node.labels), old_labels=dict(node.labels),
                            unschedulable=cordon, was_unschedulable=was,
@@ -168,7 +170,7 @@ class FakeClusterBackend(ClusterBackend):
                     node.labels.pop(k, None)
                 else:
                     node.labels[k] = v
-            self._watch.append(
+            self._emit_watch(
                 WatchEvent(kind="node_update", name=name,
                            labels=dict(node.labels), old_labels=old,
                            unschedulable=node.unschedulable,
@@ -325,8 +327,19 @@ class FakeClusterBackend(ClusterBackend):
     # watch + TriadSets
     # ------------------------------------------------------------------
 
+    def _emit_watch(self, ev: WatchEvent) -> None:
+        with self._watch_cv:
+            self._watch.append(ev)
+            self._watch_cv.notify_all()
+
     def poll_watch_events(self, timeout: float = 0.0) -> Iterable[WatchEvent]:
-        with self._lock:
+        with self._watch_cv:
+            if not self._watch and timeout:
+                # block until an emitter notifies (or the timeout lapses):
+                # the controller's event loop wakes on arrival instead of
+                # sleeping out its poll interval (bind latency is queue
+                # latency on this path)
+                self._watch_cv.wait(timeout)
             out, self._watch = self._watch, []
             return out
 

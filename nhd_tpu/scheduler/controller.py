@@ -151,8 +151,10 @@ class Controller(threading.Thread):
 
     # ------------------------------------------------------------------
 
-    def run_once(self, now: Optional[float] = None) -> None:
-        for ev in self.backend.poll_watch_events():
+    def run_once(
+        self, now: Optional[float] = None, timeout: float = 0.0
+    ) -> None:
+        for ev in self.backend.poll_watch_events(timeout):
             if ev.kind == "node_update":
                 self.handle_node_update(ev)
             elif ev.kind in ("pod_create", "pod_delete"):
@@ -163,9 +165,14 @@ class Controller(threading.Thread):
             self.reconcile_triadsets()
 
     def run(self) -> None:
+        # BLOCKING poll with poll_interval as the timeout, not a sleep:
+        # the loop wakes the moment the backend emits an event (both
+        # backends support a blocking first get), so pod create→bind
+        # pays solver time, not poll-cadence time — with the sleep the
+        # daemon's bind latency was quantized at ~poll_interval
+        # (measured r5, bench[daemon-mode])
         while not self._stop_event.is_set():
-            self.run_once()
-            time.sleep(self.poll_interval)
+            self.run_once(timeout=self.poll_interval)
 
     def stop(self) -> None:
         self._stop_event.set()

@@ -691,19 +691,30 @@ class Scheduler(threading.Thread):
             pass
 
     def run_once(self, *, idle_count: int = 0) -> int:
-        """One loop iteration; returns the updated idle counter
-        (reference: NHDScheduler.py:470-489 structure)."""
+        """One loop iteration; returns the updated idle counter.
+
+        Queue priority is FLIPPED from the reference (NHDScheduler.py:
+        470-489): the reference polls the watch queue non-blocking and
+        BLOCKS on the RPC queue, so a pod event landing just after the
+        poll waits out the full Q_BLOCK_TIME window — its daemon-mode
+        create→bind p50 is ~500 ms of queue latency (measured r5,
+        bench[daemon-mode]). Here the blocking wait is on the WATCH
+        queue (binds wake immediately) and the stats RPC queue is
+        drained non-blocking each iteration — a stats call waits at
+        most one loop turn, bind latency drops to solver time."""
         try:
-            item = self.nqueue.get(block=False)
+            rpc = self.rpcq.get(block=False)
+            self._parse_rpc_req(rpc[0], rpc[1])
+            return idle_count
         except queue.Empty:
-            try:
-                rpc = self.rpcq.get(block=True, timeout=Q_BLOCK_TIME_SEC)
-                self._parse_rpc_req(rpc[0], rpc[1])
-            except queue.Empty:
-                idle_count += 1
-                if idle_count >= IDLE_CNT_THRESH:
-                    idle_count = 0
-                    self.check_pending_pods()
+            pass
+        try:
+            item = self.nqueue.get(block=True, timeout=Q_BLOCK_TIME_SEC)
+        except queue.Empty:
+            idle_count += 1
+            if idle_count >= IDLE_CNT_THRESH:
+                idle_count = 0
+                self.check_pending_pods()
             return idle_count
         self.handle_watch_item(item)
         return idle_count
