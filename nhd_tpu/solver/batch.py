@@ -386,10 +386,10 @@ class BatchScheduler:
     def _speculate_dispatch(self, dev, all_buckets, is_pending):
         """Round 0 of the speculative path: ONE device dispatch runs the
         whole greedy claim loop (solver/speculate.py megaround) for every
-        eligible bucket jointly. PCI-map-mode types are excluded (their
-        per-switch GPU projection is a native device-pick, not derivable
-        on device) and take the classic rounds. Returns None when nothing
-        is eligible."""
+        eligible bucket jointly — PCI-map-mode types included (r5: the
+        loop projects their per-switch GPU consumption through the
+        static slot→switch map, solver/speculate.py). Returns None when
+        nothing is eligible."""
         from nhd_tpu.solver.kernel import _pad_pow2
 
         from nhd_tpu.solver.speculate import _T_SHIFT
@@ -416,7 +416,6 @@ class BatchScheduler:
             )
             Tp = _pad_pow2(pods.n_types)
             need = np.bincount(pods.pod_type, minlength=Tp).astype(np.int32)
-            need[: pods.n_types][pods.map_pci] = 0
             U, K = dev.cluster.U, dev.cluster.K
             word_overflow = (
                 (U**pods.G) * (max(K, 1) ** pods.G) * U >= (1 << _T_SHIFT)
@@ -446,7 +445,7 @@ class BatchScheduler:
             or need_total == 0
             or t_total >= (1 << (31 - _T_SHIFT))
         ):
-            # nothing to speculate (e.g. all-PCI batch), or the global
+            # nothing to speculate, or the global
             # type axis would overflow the claim word's type field
             return None
         # returns the IN-FLIGHT device (claims, counts) tensors. The
@@ -959,8 +958,8 @@ class BatchScheduler:
                         )
                         launched = []
                     if spec is None:
-                        # nothing to speculate (e.g. all-PCI batch) or a
-                        # small CPU-routed batch: classic round
+                        # nothing to speculate, or a small CPU-routed
+                        # batch: classic round
                         spec_round = False
                         launched = _dispatch_solves(use_cpu_round)
                 except BaseException:

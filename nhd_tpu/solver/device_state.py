@@ -246,8 +246,7 @@ class DeviceClusterState:
         the resident state with the aggregate claim deltas (donated).
 
         ``bucket_pods``: PodTypeArrays per bucket, in bucket-dict order;
-        ``needs``: per-bucket int32 [Tp] pending-pod counts (map-PCI type
-        rows zeroed by the caller). Returns the DEVICE claims tensor
+        ``needs``: per-bucket int32 [Tp] pending-pod counts. Returns the DEVICE claims tensor
         [iters, N] of packed int32 words, still in flight — the dispatch
         is async, so the caller can overlap host prep (FastCluster join,
         pod grouping) under the relay turnaround before pulling it with

@@ -12,13 +12,24 @@ for what used to be rounds × (dispatch + pull).
 
 Claims are SPECULATIVE: the device applies aggregate resource deltas
 (the same projections the solve itself checks — cpu/gpu per NUMA, NIC
-headroom per slot, hugepages, busy), then the host re-verifies every
-claim through the normal native assignment exactly like a classic round
-(solver/batch.py round apply). A marginal claim the native core rejects
-just retries in the classic rounds that follow; conservation is
-untouched. PCI-map-mode types are excluded (their per-switch GPU
-projection ``gpu_free_sw`` is chosen by the native device-pick, not
-derivable from (combo, pick) alone) and take the classic rounds.
+headroom per slot, hugepages, busy, per-switch GPUs), then the host
+re-verifies every claim through the normal native assignment exactly
+like a classic round (solver/batch.py round apply). A marginal claim
+the native core rejects just retries in the classic rounds that
+follow; conservation is untouched.
+
+PCI-map-mode types speculate too (r5; they were excluded through r4):
+the solve's ``pci_ok`` predicate already certifies the claim, and the
+chosen (combo, pick) determines the NIC slots whose switches supply
+the GPUs — the loop projects ``gpu_free_sw`` deltas through the static
+``nic_sw`` slot→switch map. PCI claims are capped at ONE copy per
+node per iteration: a second copy's native NIC re-pick can land on
+different switches than the first, which the aggregate per-(c, a)
+projection cannot express. The native verify (which re-picks NICs and
+GPUs against live state, PCI-aware) stays the safety net; NUMA-mode
+GPU claims do not decrement ``gpu_free_sw`` mid-loop (which switch
+they draw from is the native picker's choice), an optimism the verify
+also absorbs.
 
 Selection policy per iteration — chosen to approximate the classic
 rounds' pod-index interleave (docs/DESIGN.md "the over-claim is
@@ -187,6 +198,14 @@ def _get_megaround(
                 pod_args=pod_args[9 * b : 9 * b + 9],
                 G=G, C=tb.C, A=tb.A,
                 nic_occ=(occ_slots > 0).astype(f32).sum(-1),  # [Tp,C*A,U]
+                # per-(u, k) GPU demand at (combo, pick), PCI types only:
+                # the chosen slot's switch supplies the GPUs (gpu_free_sw
+                # projection) — zero rows for NUMA-mode types
+                gpu_uk=jnp.einsum(
+                    "tg,caguk->tcauk",
+                    (gpu_dem * map_pci[:, None]).astype(f32), choose,
+                ).reshape(Tp, tb.C * tb.A, U, K),
+                map_pci=map_pci,
                 # [Tp, C, U] per-combo group demand
                 cpu_g_smt=jnp.einsum(
                     "tg,cgu->tcu", cpu_dem_smt[:, :-1].astype(f32), combo_onehot),
@@ -211,6 +230,15 @@ def _get_megaround(
         n_idx = jnp.arange(N, dtype=jnp.int32)
 
         a_mult_dev = jnp.asarray(a_mult)
+
+        # static slot→switch one-hot for the PCI gpu_free_sw projection:
+        # nic_sw never mutates, so the [N, U, K, S] map is loop-invariant
+        # and hoisted like the per-bucket demand projections
+        S = mutable["gpu_free_sw"].shape[1]
+        sw_onehot = (
+            arrays["nic_sw"][:, :, :, None]
+            == jnp.arange(S)[None, None, None, :]
+        ).astype(jnp.float32)  # [N, U, K, S]
 
         def body(state):
             it, need, mutable, claims, counts, progress = state
@@ -307,6 +335,7 @@ def _get_megaround(
             gpu_dem_n = jnp.zeros((N, U), f32)
             nic_occ_n = jnp.zeros((N, U), f32)   # distinct NICs consumed
             #                                      per numa at (c, a)
+            guk_n = jnp.zeros((N, U, K), f32)    # PCI per-slot GPU demand
             hp_n = jnp.zeros(N, f32)
             cap1_n = jnp.zeros(N, bool)          # force single-copy rows
             for b, (G, Tp) in enumerate(bucket_shapes):
@@ -327,8 +356,14 @@ def _get_megaround(
                 ca = cb * pb["A"] + jnp.clip(a_n, 0, pb["A"] - 1)
                 nic_occ_n = jnp.where(
                     sel, pb["nic_occ"][tloc, ca], nic_occ_n)
+                guk_n = jnp.where(
+                    sel[..., None], pb["gpu_uk"][tloc, ca], guk_n)
                 hp_n = jnp.where(in_b, pb["hp"].astype(f32)[tloc], hp_n)
                 one = pb["needs_gpu"][tloc] if respect_busy else False
+                # PCI claims: one copy per iteration — a later copy's
+                # native NIC re-pick can move to other switches than the
+                # (c, a) projection assumes
+                one = one | pb["map_pci"][tloc]
                 if ENABLE_NIC_SHARING:
                     one = one | pb["has_nic"][tloc]
                 cap1_n = jnp.where(in_b, one, cap1_n)
@@ -460,6 +495,15 @@ def _get_megaround(
                     used[..., None], 0.0, mutable["nic_free"]
                 )
             new_mut["hp_free"] = mutable["hp_free"] - hp_delta
+            # PCI claims drain the chosen slots' switches: route the
+            # per-(u, k) GPU demand through the hoisted static
+            # slot→switch map (nic_sw carries dense per-node switch ids)
+            sw_delta = jnp.einsum(
+                "nuk,nuks->ns", k_n[:, None, None] * guk_n, sw_onehot
+            )
+            new_mut["gpu_free_sw"] = (
+                mutable["gpu_free_sw"].astype(f32) - sw_delta
+            ).astype(mutable["gpu_free_sw"].dtype)
             new_mut["busy"] = busy_new
 
             # --- record the iteration's claims (one packed word/node,

@@ -10,6 +10,7 @@ import random
 import numpy as np
 import pytest
 
+from nhd_tpu.core.topology import MapMode
 from nhd_tpu.sim import make_cluster
 from nhd_tpu.solver import BatchItem, BatchScheduler
 from tests.test_batch import items, simple_request
@@ -99,28 +100,75 @@ def test_speculative_end_state_is_valid_and_conserving():
             assert rx >= 0 and tx >= 0
 
 
-def test_pci_pods_fall_through_to_classic_rounds():
-    """PCI-map-mode pods are excluded from the megaround but still place
-    via the classic rounds of the same schedule() call."""
-    from nhd_tpu.core.topology import MapMode
+def test_pci_pods_speculate_with_numa_pods():
+    """PCI-map-mode pods join the megaround (r5): a mixed NUMA+PCI batch
+    places entirely in the speculative round 0 — the loop projects
+    per-switch GPU consumption through the static slot→switch map and
+    the native verify re-picks PCI-aware against live state."""
+    from dataclasses import replace
 
     nodes = make_cluster(4)
     reqs = [simple_request(gpus=1) for _ in range(6)]
-    pci = [r.with_map_mode(MapMode.PCI) if hasattr(r, "with_map_mode")
-           else r for r in reqs]
     # PodRequest is frozen; rebuild with PCI map mode
-    from dataclasses import replace
-
     pci = [replace(r, map_mode=MapMode.PCI) for r in reqs[:3]]
     mixed = reqs[:3] + pci
     results, stats = spec_scheduler().schedule(nodes, items(mixed), now=0.0)
     placed = sum(1 for r in results if r.node)
     assert placed == len(mixed)
-    # the NUMA pods went speculatively (round 0); PCI pods classically
-    numa_rounds = {r.round_no for r in results[:3]}
-    pci_rounds = {r.round_no for r in results[3:]}
-    assert numa_rounds == {0}
-    assert all(rn >= 1 for rn in pci_rounds)
+    assert {r.round_no for r in results if r.node} == {0}, [
+        r.round_no for r in results
+    ]
+    assert stats.counters.get("rejects_r0", 0) == 0, stats.counters
+
+
+def test_pci_speculation_respects_switch_capacity():
+    """A PCI gang bigger than one node's switch-GPU supply must spread:
+    the gpu_free_sw projection inside the loop prevents over-election on
+    one node. Asserted at SWITCH granularity: no PCIe switch ever goes
+    negative, every placed PCI pod's GPU shares a switch with one of its
+    claimed NICs (the PCI-mode contract), and the speculative total
+    matches the classic scheduler's on a copy of the cluster."""
+    import copy
+    from collections import Counter
+    from dataclasses import replace
+
+    nodes = make_cluster(3)
+    nodes_c = copy.deepcopy(nodes)
+    # per-switch capacity before any claim
+    sw_cap = {
+        name: Counter(g.pciesw for g in n.gpus)
+        for name, n in nodes.items()
+    }
+    reqs = [
+        replace(simple_request(gpus=1), map_mode=MapMode.PCI)
+        for _ in range(9)
+    ]
+    results, _ = spec_scheduler().schedule(nodes, items(reqs), now=0.0)
+    rc, _ = BatchScheduler(
+        respect_busy=False, register_pods=False, device_state=False,
+        mesh=None,
+    ).schedule(nodes_c, items(reqs), now=0.0)
+    placed = sum(1 for r in results if r.node)
+    assert placed == sum(1 for r in rc if r.node), (
+        placed, sum(1 for r in rc if r.node)
+    )
+    for name, n in nodes.items():
+        used = Counter(g.pciesw for g in n.gpus if g.used)
+        for sw, k in used.items():
+            assert k <= sw_cap[name][sw], (name, sw, k, sw_cap[name])
+    # PCI contract: each placed pod's GPUs sit on a switch one of its
+    # claimed NICs also sits on
+    for r in results:
+        if not r.node or not r.nic_list:
+            continue
+        n = nodes[r.node]
+        nic_sws = {n.nics[i].pciesw for i, _, _ in r.nic_list}
+        # mapping carries numa-level info; verify via the node's used
+        # GPUs instead: at least one used GPU shares a claimed NIC's
+        # switch (gang-level check on a 1-GPU-per-pod workload)
+        assert any(
+            g.used and g.pciesw in nic_sws for g in n.gpus
+        ), (r.node, nic_sws)
 
 
 def test_speculative_mesh_equals_single_device():
