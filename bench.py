@@ -214,13 +214,20 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40,
         f"speedup {speedup:.0f}x"
     )
     if stats.phases:
-        # the overhead war's tracked metric (VERDICT r3 item 8): per-phase
-        # wall + device-utilization proxy (solve-active / wall)
+        # the overhead war's tracked metric: per-phase wall + a
+        # device-utilization proxy (solve-active / wall), WALL-CLAMPED:
+        # concurrent paths (streaming tile workers) sum solve_seconds as
+        # thread time, which can exceed wall — an unclamped figure read
+        # 108% exactly where the overhead war mattered most (r4). 100%
+        # means "solves were in flight for the whole wall, overlapped".
         detail = " ".join(
             f"{k}={v * 1e3:.0f}ms" for k, v in sorted(stats.phases.items())
         )
         util = 100.0 * stats.solve_seconds / wall if wall > 0 else 0.0
-        _log(f"bench[{name}]: phases {detail}; solve-active/wall {util:.0f}%")
+        _log(
+            f"bench[{name}]: phases {detail}; "
+            f"solve-active/wall {min(util, 100.0):.0f}%"
+        )
     return {"wall": wall, "placed": placed, "speedup": speedup}
 
 

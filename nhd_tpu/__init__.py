@@ -12,7 +12,6 @@ Package layout:
   core/      hardware + workload data model (host-side source of truth)
   config/    libconfig parsing and the Triad config round-trip (plugin seam)
   solver/    the matcher: serial oracle + batched JAX solver + sharding
-  ops/       Pallas/XLA kernels for the hot predicates
   k8s/       cluster backend interface (fake in-memory + real kube client)
   scheduler/ reconciliation event loop, claim/release, bind orchestration
   rpc/       gRPC stats/introspection plane

@@ -765,20 +765,19 @@ class BatchScheduler:
                     cluster.busy[:] = False
 
         fast_future = None
+        # deferred to round 0, right AFTER the first device dispatch: the
+        # build runs on a worker thread, and on a single-core host it
+        # would otherwise steal the GIL from the encode that gates the
+        # dispatch — submitted after it, the build's CPU time hides
+        # entirely under the in-flight relay flush (free), instead of
+        # delaying the flush's start (paid)
+        submit_fast = False
         if context is not None:
             fast = context.fast if apply else None
             dev = context.dev
         else:
             fast = None
-            if self.use_fast and apply:
-                # build the packed assignment state on a worker thread —
-                # it only reads the (quiescent until assign) node mirror,
-                # and the main thread is about to block in round 1's solve
-                # pull, so the build hides under the XLA wait
-                fast_future = _fc_executor().submit(
-                    FastCluster, nodes, cluster.U, cluster.K,
-                    arrays=cluster, static_cache=self._fc_static,
-                )
+            submit_fast = self.use_fast and apply
             # keep node arrays resident on device across rounds; per-round
             # uploads shrink to the claimed rows (solver/device_state.py).
             # A multi-device mesh implies resident state: sharded arrays must
@@ -823,51 +822,40 @@ class BatchScheduler:
                 stats.count_add(f"pending_r{round_no}", len(pending))
 
             t0 = time.perf_counter()
-            try:
-                if all_buckets is None:
-                    # type-level tensors never change across rounds —
-                    # encode the whole pending set once (or reuse the
-                    # caller's chunk-wide encode) and only filter
-                    # membership below
-                    pend_list = pending.tolist()  # np iteration boxes per
-                    #                               element; tolist is C
-                    all_buckets = encoded if encoded is not None else encode_pods(
-                        [items[i].request for i in pend_list],
-                        cluster.interner,
-                        indices=pend_list,
-                    )
-                    stats.phase_add("encode", time.perf_counter() - t0)
-                    # R >= the largest per-type pod count: every ranked
-                    # candidate carries capacity >= 1, so the top-R cut
-                    # can never force an extra round
-                    max_need = max(
-                        (
-                            int(np.bincount(b.pod_type).max())
-                            for b in all_buckets.values()
-                            if len(b.pod_type)
-                        ),
-                        default=1,
-                    )
-                    # backend decides the cap, not device-residency: even
-                    # the non-resident path executes (and pulls) on the
-                    # default backend
-                    R = rank_budget(
-                        max_need, cluster.n_nodes,
-                        accelerator=_accelerator_backend(),
-                    )
-                    is_pending = np.zeros(len(items), bool)
-                is_pending[:] = False
-                is_pending[pending] = True
-            except BaseException:
-                # the off-thread FastCluster build must not outlive
-                # schedule() — it reads the caller's mutable nodes
-                if fast_future is not None:
-                    try:
-                        fast_future.result()
-                    except Exception:
-                        pass
-                    fast_future = None
-                raise
+            if all_buckets is None:
+                # type-level tensors never change across rounds —
+                # encode the whole pending set once (or reuse the
+                # caller's chunk-wide encode) and only filter
+                # membership below
+                pend_list = pending.tolist()  # np iteration boxes per
+                #                               element; tolist is C
+                all_buckets = encoded if encoded is not None else encode_pods(
+                    [items[i].request for i in pend_list],
+                    cluster.interner,
+                    indices=pend_list,
+                )
+                stats.phase_add("encode", time.perf_counter() - t0)
+                # R >= the largest per-type pod count: every ranked
+                # candidate carries capacity >= 1, so the top-R cut
+                # can never force an extra round
+                max_need = max(
+                    (
+                        int(np.bincount(b.pod_type).max())
+                        for b in all_buckets.values()
+                        if len(b.pod_type)
+                    ),
+                    default=1,
+                )
+                # backend decides the cap, not device-residency: even
+                # the non-resident path executes (and pulls) on the
+                # default backend
+                R = rank_budget(
+                    max_need, cluster.n_nodes,
+                    accelerator=_accelerator_backend(),
+                )
+                is_pending = np.zeros(len(items), bool)
+            is_pending[:] = False
+            is_pending[pending] = True
 
             # (pod index, node index, bucket G, type, rank position)
             claims: List[Tuple[int, int, int, int, int]] = []
@@ -947,29 +935,28 @@ class BatchScheduler:
                 launched = prelaunched
                 prelaunched = None
             else:
-                try:
-                    if spec_round:
-                        t_sp = time.perf_counter()
-                        spec = self._speculate_dispatch(
-                            dev, all_buckets, is_pending
-                        )
-                        stats.phase_add(
-                            "spec_dispatch", time.perf_counter() - t_sp
-                        )
-                        launched = []
-                    if spec is None:
-                        # nothing to speculate, or a small CPU-routed
-                        # batch: classic round
-                        spec_round = False
-                        launched = _dispatch_solves(use_cpu_round)
-                except BaseException:
-                    if fast_future is not None:
-                        try:
-                            fast_future.result()
-                        except Exception:
-                            pass
-                        fast_future = None
-                    raise
+                if spec_round:
+                    t_sp = time.perf_counter()
+                    spec = self._speculate_dispatch(
+                        dev, all_buckets, is_pending
+                    )
+                    stats.phase_add(
+                        "spec_dispatch", time.perf_counter() - t_sp
+                    )
+                    launched = []
+                if spec is None:
+                    # nothing to speculate, or a small CPU-routed
+                    # batch: classic round
+                    spec_round = False
+                    launched = _dispatch_solves(use_cpu_round)
+            if submit_fast:
+                # first dispatch is in flight: the build's CPU time now
+                # hides under the relay flush (see submit_fast above)
+                submit_fast = False
+                fast_future = _fc_executor().submit(
+                    FastCluster, nodes, cluster.U, cluster.K,
+                    arrays=cluster, static_cache=self._fc_static,
+                )
             if fast_future is not None:
                 # join here, while the just-dispatched solves (or the
                 # in-flight megaround) compute in the XLA pool: the build
@@ -1445,12 +1432,6 @@ class BatchScheduler:
                 pending = pending[~np.isin(pending, newly_scheduled)]
             if not apply:
                 break  # without claims, later rounds would repeat choices
-
-        if fast_future is not None:
-            # loop never ran (nothing pending): still reap the worker —
-            # it must not outlive schedule() reading the caller's nodes
-            fast = fast_future.result()
-            fast_future = None
 
         # fast path: one final sync of the HostNode mirror + topology fills
         if fast is not None:
