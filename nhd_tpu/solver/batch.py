@@ -1276,34 +1276,38 @@ class BatchScheduler:
                         ok_idx = np.nonzero(ok)[0].tolist()
                         busy_nodes.update(w_node_l[w] for w in ok_idx)
                         winner_iter = [
-                            (w, w_pod_l[w], w_node_l[w], w_type_l[w])
+                            (w, w_pod_l[w], w_node_l[w], w_type_l[w],
+                             w_c_l[w], w_m_l[w], picks_l[w], out_nic_l[w])
                             for w in ok_idx
                         ]
                     else:
                         busy_nodes.update(w_node_l)
+                        # all columns ride the zip: per-iteration list
+                        # indexing (6 subscript ops/winner) was measurable
+                        # at gang scale
                         winner_iter = zip(
-                            range(len(w_pod_l)), w_pod_l, w_node_l, w_type_l
+                            range(len(w_pod_l)), w_pod_l, w_node_l,
+                            w_type_l, w_c_l, w_m_l, picks_l, out_nic_l,
                         )
                         ok_idx = None
                     n_ok = len(w_pod_l) if all_ok else len(ok_idx)
                     BA = BatchAssignment
                     memo_get = memo.get
-                    for w, pod_i, n, t in winner_iter:
+                    for w, pod_i, n, t, c_, m_, pk, row in winner_iter:
                         item = items[pod_i]
                         # the NIC pick is re-selected against live state
                         # in the native call — decode the actual choice
-                        mk = (w_c_l[w], w_m_l[w], picks_l[w])
+                        mk = (c_, m_, pk)
                         mapping = memo_get(mk)
                         if mapping is None:
                             mapping = memo[mk] = decode_mapping(
-                                G, U_, K_, mk[0], mk[1], mk[2],
+                                G, U_, K_, c_, m_, pk,
                             )
                         if want_record or item.topology is not None:
                             rec = fast.record_from_round(pods, w, n, t, buffers)
                             records[pod_i] = rec
                             nic_list = rec.nic_list
                         else:
-                            row = out_nic_l[w]
                             nic_list = [
                                 (row[g], bw, d) for g, bw, d in nic_tmpl[t]
                             ]
