@@ -1,9 +1,18 @@
-"""Pallas NIC kernel parity vs the jnp formulation (interpret mode on CPU)."""
+"""Pallas NIC kernel parity vs the jnp formulation (interpret mode on CPU).
+
+RETIRED with the kernel (2026-07-29, docs/DESIGN.md): attic/ is not a
+package and is outside pytest's testpaths. To revive, restore
+nic_pallas.py under nhd_tpu/ and point this import at it.
+"""
+
+import os
+import sys
 
 import numpy as np
 import pytest
 
-from nhd_tpu.ops.nic_pallas import BN, nic_any_first, nic_any_first_reference
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from nic_pallas import BN, nic_any_first, nic_any_first_reference  # noqa: E402
 
 
 def make_case(rng, T, N, U, K, C, A):

@@ -86,6 +86,14 @@ RankHost = namedtuple(
     "RankHost", "val idx best_c best_m best_a n_picks free_gpu free_cpu free_hp"
 )
 
+# one in-flight speculative dispatch (see _speculate_dispatch): the four
+# device tensors ride ONE batched flush; ``certifiable`` records the
+# saturation-certificate preconditions evaluated at dispatch time
+SpecDispatch = namedtuple(
+    "SpecDispatch",
+    "bucket_keys bucket_pods claims counts need_left iters_used certifiable",
+)
+
 
 @dataclass
 class ScheduleContext:
@@ -448,21 +456,40 @@ class BatchScheduler:
             # nothing to speculate, or the global
             # type axis would overflow the claim word's type field
             return None
-        # returns the IN-FLIGHT device (claims, counts) tensors. The
-        # copy_to_host_async here is load-bearing: on the tunnel relay it
-        # STARTS the ~65 ms flush immediately (measured r5 — asarray
-        # later completes in flush-minus-elapsed), so every millisecond
-        # of host prep between dispatch and pull (FastCluster join,
-        # expand prep) hides under the in-flight flush
-        claims_arr, counts_arr = dev.megaround(
+        # saturation-certificate preconditions (see the spec_round
+        # consumer): with these, the loop's projected state provably
+        # upper-bounds true state, so a no-candidate exit is final
+        from nhd_tpu.core.node import ENABLE_NIC_SHARING
+
+        certifiable = (
+            not ENABLE_NIC_SHARING
+            and dev.cluster.uniform_nic_caps
+            and not any(
+                need[: pods.n_types][pods.map_pci].any()
+                for pods, need in zip(bucket_pods, needs)
+            )
+        )
+        # returns the IN-FLIGHT device tensors (claims, counts, need
+        # left, iterations used). The copy_to_host_async here is
+        # load-bearing: on the tunnel relay it STARTS the ~65 ms flush
+        # immediately (measured r5 — asarray later completes in
+        # flush-minus-elapsed), so every millisecond of host prep
+        # between dispatch and pull (FastCluster join, expand prep)
+        # hides under the in-flight flush
+        claims_arr, counts_arr, need_arr, it_arr = dev.megaround(
             bucket_pods, needs, self.respect_busy
         )
         try:
             claims_arr.copy_to_host_async()
             counts_arr.copy_to_host_async()
+            need_arr.copy_to_host_async()
+            it_arr.copy_to_host_async()
         except Exception:
             pass  # backend without async host copies
-        return bucket_keys, bucket_pods, claims_arr, counts_arr
+        return SpecDispatch(
+            bucket_keys, bucket_pods, claims_arr, counts_arr,
+            need_arr, it_arr, certifiable,
+        )
 
     def _expand_speculative(self, spec, claims_np, counts_np, cluster):
         """Expand the megaround's packed claim tensor into per-bucket
@@ -476,7 +503,7 @@ class BatchScheduler:
         from nhd_tpu.solver.kernel import _pad_pow2
         from nhd_tpu.solver.speculate import decode_claims_grouped
 
-        bucket_keys, bucket_pods = spec[0], spec[1]
+        bucket_keys, bucket_pods = spec.bucket_keys, spec.bucket_pods
         shapes = tuple((p.G, _pad_pow2(p.n_types)) for p in bucket_pods)
         decoded = decode_claims_grouped(
             claims_np, shapes, tuple(bucket_keys), cluster.U, cluster.K,
@@ -805,7 +832,10 @@ class BatchScheduler:
         # runs the whole greedy-round loop in ONE device dispatch and the
         # host re-verifies its claims through the normal native apply;
         # anything the native core rejects retries in classic rounds
-        from nhd_tpu.solver.speculate import speculate_enabled
+        from nhd_tpu.solver.speculate import (
+            spec_iters as _spec_iters,
+            speculate_enabled,
+        )
 
         spec_ok = (
             apply
@@ -976,8 +1006,10 @@ class BatchScheduler:
                 # the async batch each pay a full ~65 ms turnaround —
                 # measured 130 ms vs 65 ms, docs/TPU_STATUS.md r4)
                 t_pull = time.perf_counter()
-                claims_np = np.asarray(spec[2])
-                counts_np = np.asarray(spec[3])
+                claims_np = np.asarray(spec.claims)
+                counts_np = np.asarray(spec.counts)
+                spec_need_left = int(np.asarray(spec.need_left).sum())
+                spec_it = int(np.asarray(spec.iters_used))
                 stats.phase_add("spec_pull", time.perf_counter() - t_pull)
             for G, pods, out in launched:
                 try:
@@ -1178,10 +1210,12 @@ class BatchScheduler:
                 removed: List[np.ndarray] = []
                 first_masks: List[np.ndarray] = []
                 seen_first: set = set()
+                round_rejects = 0
                 for G, pods, w_pod, w_node, w_type, buffers, w_c, w_m in (
                     native_out
                 ):
                     ok = buffers[0] >= 0
+                    round_rejects += int((~ok).sum())
                     if round_no < 8:
                         stats.count_add(f"claims_r{round_no}", len(w_pod))
                         stats.count_add(
@@ -1202,6 +1236,34 @@ class BatchScheduler:
                     pending = pending[
                         ~np.isin(pending, np.concatenate(removed))
                     ]
+
+                # SATURATION CERTIFICATE: the loop exited before its
+                # iteration cap with need left — i.e. its final exact
+                # solve found NO eligible (type, node) pair against the
+                # projected state. When every projection component is
+                # provably optimistic-or-exact w.r.t. true state — zero
+                # native rejects (deltas applied exactly as projected),
+                # no PCI types with need (their NUMA-pool deltas can be
+                # pessimistic), uniform per-node NIC caps + sharing off
+                # (candidacy depends only on free-NIC counts, which the
+                # loop tracks exactly) — infeasible-under-projection
+                # implies infeasible in reality, and the leftover pods
+                # are unschedulable WITHOUT a classic confirmation round
+                # (one whole relay flush on a saturated gang, ~45% of
+                # cfg3's wall). Any failed precondition just falls back
+                # to the confirmation round.
+                if (
+                    spec_round
+                    and len(pending)
+                    and spec.certifiable
+                    and round_rejects == 0
+                    and spec_need_left > 0
+                    and spec_it < _spec_iters()
+                ):
+                    stats.count_add(
+                        "certified_unschedulable", len(pending)
+                    )
+                    pending = pending[:0]
 
                 # dispatch round r+1's solves NOW — the arrays already
                 # carry this round's claims, so the Python result

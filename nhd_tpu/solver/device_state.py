@@ -246,13 +246,16 @@ class DeviceClusterState:
         the resident state with the aggregate claim deltas (donated).
 
         ``bucket_pods``: PodTypeArrays per bucket, in bucket-dict order;
-        ``needs``: per-bucket int32 [Tp] pending-pod counts. Returns the DEVICE claims tensor
-        [iters, N] of packed int32 words, still in flight — the dispatch
-        is async, so the caller can overlap host prep (FastCluster join,
-        pod grouping) under the relay turnaround before pulling it with
-        np.asarray (ONE pull). On a mesh the same program runs SPMD over
-        the node-sharded resident arrays (claims bit-identical to
-        single-device; the megaround docstring has the sharding story)."""
+        ``needs``: per-bucket int32 [Tp] pending-pod counts. Returns the
+        DEVICE tensors (claims [iters, N] packed int32 words, counts
+        [iters, N], need_left [Tt], iters_used scalar), all still in
+        flight — the dispatch is async, so the caller overlaps host prep
+        (FastCluster join, pod grouping) under the relay turnaround, and
+        must copy_to_host_async ALL FOUR before the first np.asarray so
+        they ride one batched flush (batch._speculate_dispatch does). On
+        a mesh the same program runs SPMD over the node-sharded resident
+        arrays (claims bit-identical to single-device; the megaround
+        docstring has the sharding story)."""
         from nhd_tpu.solver.speculate import _get_megaround, spec_iters
 
         self._flush_staged()
@@ -281,7 +284,7 @@ class DeviceClusterState:
         mutable = {name: self._dev[name] for name in _MUTABLE}
         static = {name: self._dev[name] for name in _STATIC}
         try:
-            new_mutable, claims, counts, _need_left = fn(
+            new_mutable, claims, counts, need_left, it = fn(
                 mutable, static, need, *pod_args
             )
         except BaseException:
@@ -290,4 +293,4 @@ class DeviceClusterState:
             self._rebuild_mutable()
             raise
         self._dev.update(new_mutable)
-        return claims, counts
+        return claims, counts, need_left, it

@@ -69,6 +69,11 @@ class ClusterArrays:
     nic_sw: np.ndarray         # [N, U, K] int32 — dense per-node switch id, -1 none
     gpu_free_sw: np.ndarray    # [N, S] int32 — free GPUs per dense switch id
     interner: GroupInterner = field(default_factory=GroupInterner)
+    # every node's NICs share one capacity (speed): with NIC sharing off,
+    # candidacy then depends only on free-NIC COUNTS per NUMA, which the
+    # speculative loop tracks exactly — the precondition for its
+    # saturation certificate (solver/speculate.py)
+    uniform_nic_caps: bool = False
 
     @property
     def n_nodes(self) -> int:
@@ -233,6 +238,9 @@ def encode_cluster(
         nic_sw=np.full((N, U, K), -1, np.int32),
         gpu_free_sw=np.zeros((N, S), np.int32),
         interner=interner,
+    )
+    arr.uniform_nic_caps = all(
+        len({nic.speed_gbps for nic in n.nics}) <= 1 for n in nl
     )
     for node in nl:
         node._ensure_packed()

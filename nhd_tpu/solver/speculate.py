@@ -538,13 +538,18 @@ def _get_megaround(
         it, need, mutable, claims, counts, _ = jax.lax.while_loop(
             cond, body, init
         )
-        return mutable, claims, counts, need
+        # ``it`` distinguishes the exit reason for the host's saturation
+        # certificate: it < iters with need left means the loop ended on
+        # progress=False — the exact solve found NO eligible (type, node)
+        # pair against the projected state
+        return mutable, claims, counts, need, it
 
     kwargs = {"donate_argnums": (0,)} if donate else {}
     if out_shardings_key is not None:
         node_sharding, replicated = out_shardings_key
         kwargs["out_shardings"] = (
             {name: node_sharding for name in _MUTABLE},
+            replicated,
             replicated,
             replicated,
             replicated,

@@ -9,7 +9,7 @@ def test_entry_compiles_and_runs():
     import numpy as np
 
     fn, args = graft.entry()
-    mutable, claims, counts, need_left = jax.jit(fn)(*args)
+    mutable, claims, counts, need_left, it = jax.jit(fn)(*args)
     # the megaround made real claims and consumed real need
     claims = np.asarray(claims)
     counts = np.asarray(counts)
@@ -17,6 +17,9 @@ def test_entry_compiles_and_runs():
     # every claim carries a positive copy count (multi-copy plane)
     assert (counts[claims >= 0] > 0).all()
     assert int(np.asarray(need_left).sum()) < int(np.asarray(args[2]).sum())
+    # the exit-reason iteration counter is in range (saturation
+    # certificate input, solver/speculate.py)
+    assert 0 < int(np.asarray(it)) <= 8
     # the claimed state mutated (GPUs were consumed)
     assert not np.array_equal(
         np.asarray(mutable["gpu_free"]), np.asarray(args[0]["gpu_free"])

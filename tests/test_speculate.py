@@ -212,3 +212,56 @@ def test_respect_busy_one_gpu_pod_per_node():
     per_node = Counter(r.node for r in results if r.node)
     assert all(v == 1 for v in per_node.values()), per_node
     assert sum(per_node.values()) == 3  # one per node, rest deferred
+
+
+def test_saturation_certificate_matches_classic_verdict():
+    """On a saturated all-NUMA cluster with uniform NIC caps, the
+    megaround's no-candidate exit certifies the leftovers unschedulable
+    without a classic confirmation round — and the verdict must match
+    the classic scheduler's placements AND failures exactly (the
+    certificate's soundness claim: projected state upper-bounds true
+    state under its preconditions)."""
+    import copy
+
+    from nhd_tpu.sim.workloads import bench_cluster, workload_mix
+
+    groups = ["default", "edge", "batch"]
+    reqs = workload_mix(300, groups)
+    nodes_s = bench_cluster(16, groups)   # NIC-saturated shape
+    nodes_c = copy.deepcopy(nodes_s)
+
+    rs, ss = spec_scheduler().schedule(nodes_s, items(reqs), now=0.0)
+    rc, sc = BatchScheduler(
+        respect_busy=False, register_pods=False, device_state=False,
+        mesh=None,
+    ).schedule(nodes_c, items(reqs), now=0.0)
+    placed_s = sum(1 for r in rs if r.node)
+    placed_c = sum(1 for r in rc if r.node)
+    certified = ss.counters.get("certified_unschedulable", 0)
+    # the certificate engaged and killed the confirmation round
+    assert certified > 0, ss.counters
+    assert ss.rounds == 1, (ss.rounds, ss.counters)
+    # soundness: nothing the classic rounds can place was certified away
+    assert placed_s == placed_c, (placed_s, placed_c, ss.counters)
+    assert certified == 300 - placed_s
+
+
+def test_saturation_certificate_disabled_on_nonuniform_nic_caps():
+    """A node whose NICs have different speeds voids the certificate's
+    free-NIC-count argument: the dispatch must fall back to the classic
+    confirmation round instead of certifying."""
+    from nhd_tpu.sim import SynthNodeSpec, make_node
+    from nhd_tpu.sim.workloads import workload_mix
+
+    nodes = {}
+    for i in range(4):
+        spec = SynthNodeSpec(name=f"mix{i}", nics_per_numa=2)
+        node = make_node(spec)
+        node.nics[0].speed_gbps = node.nics[0].speed_gbps / 2  # mixed caps
+        nodes[spec.name] = node
+    reqs = workload_mix(120, ["default"])
+    results, stats = spec_scheduler().schedule(nodes, items(reqs), now=0.0)
+    assert "certified_unschedulable" not in stats.counters, stats.counters
+    # the saturated leftovers took (at least) a confirmation round
+    if sum(1 for r in results if r.node) < 120:
+        assert stats.rounds >= 2
