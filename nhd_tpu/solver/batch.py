@@ -33,6 +33,7 @@ back-off, not the solver, becomes the rate limit.
 
 from __future__ import annotations
 
+import threading as _threading
 import time
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -134,34 +135,55 @@ def _fc_executor():
 
 class GcPin:
     """Process-wide heap pin for scheduler sweeps (see
-    BatchScheduler.schedule). Reentrancy is tracked with an explicit
-    flag, NOT gc.get_freeze_count(): interpreter startup can leave a
-    nonzero permanent generation (observed 375 objects on this image),
-    and keying on the count would silently disable pinning forever.
-    The streaming sweep takes the pin once for its whole run; the
-    per-tile BatchScheduler calls inside it see ``active`` and leave gc
-    alone. An embedding app that manages its own freeze should set
-    NHD_TPU_GC_PIN=0 (our unfreeze would return its frozen objects to
-    the normal generations)."""
+    BatchScheduler.schedule): gc.freeze() excludes the pre-existing
+    heap (node mirror, contexts) from collection, AND automatic
+    collection is disabled outright for the pin's duration — even with
+    the old heap frozen, the young generations re-scan the sweep's own
+    accumulating result objects every ~2k allocations, measured at
+    ~50% of the federation sweep's materialize phase. A sweep's
+    garbage is bounded by the batch; the re-enabled collector reclaims
+    it at the next natural collection after release.
+
+    Reentrancy is tracked with an explicit flag, NOT
+    gc.get_freeze_count(): interpreter startup can leave a nonzero
+    permanent generation (observed 375 objects on this image), and
+    keying on the count would silently disable pinning forever. The
+    streaming sweep takes the pin once for its whole run; the per-tile
+    BatchScheduler calls inside it see ``active`` and leave gc alone.
+    An embedding app that manages its own freeze/disable state should
+    set NHD_TPU_GC_PIN=0 (our release would clobber its arrangement)."""
 
     active = False
+    _lock = _threading.Lock()
 
     @classmethod
-    def acquire(cls) -> bool:
+    def acquire(cls):
+        """Take the pin; returns an opaque token for release(), or None
+        when another sweep holds it / NHD_TPU_GC_PIN=0. The token CARRIES
+        the prior gc-enabled state — a shared class attribute would turn
+        the concurrent-acquire race into a permanently disabled
+        collector (both acquirers could record enabled=False)."""
         import gc
         import os
 
-        if cls.active or os.environ.get("NHD_TPU_GC_PIN", "1") == "0":
-            return False
-        cls.active = True
+        if os.environ.get("NHD_TPU_GC_PIN", "1") == "0":
+            return None
+        with cls._lock:
+            if cls.active:
+                return None
+            cls.active = True
+        was_enabled = gc.isenabled()
         gc.freeze()
-        return True
+        gc.disable()
+        return (True, was_enabled)
 
     @classmethod
-    def release(cls, held: bool) -> None:
-        if held:
+    def release(cls, token) -> None:
+        if token:
             import gc
 
+            if token[1]:
+                gc.enable()
             gc.unfreeze()
             cls.active = False
 
