@@ -713,14 +713,13 @@ class BatchScheduler:
     ) -> Tuple[List[BatchAssignment], BatchStats]:
         """Place every item it can; mutates ``nodes`` when ``apply``.
 
-        The pre-existing heap (node mirror, contexts) is gc.freeze-pinned
-        for the duration of gang-scale calls so generational collections
-        scan only batch-allocated objects — a major pass over a large
-        mirror mid-batch is a multi-ms stall the scheduler, not the
-        caller, should prevent. Skipped when the embedding process (e.g.
-        the streaming sweep, which freezes once for its whole run)
-        already holds a freeze. Both freeze() and unfreeze() are O(1)
-        generation-list splices.
+        Gang-scale calls take the GcPin: the pre-existing heap (node
+        mirror, contexts) is gc.freeze-pinned and automatic collection
+        is disabled for the sweep — both the major pass over a large
+        mirror and the young-gen re-scans of the sweep's own result
+        objects are stalls the scheduler, not the caller, should
+        prevent. Skipped when the streaming sweep already holds the pin
+        for its whole run.
 
         Items without a topology get a synthetic one (sim.requests), so
         physical assignment always runs — claims must hit the host mirror

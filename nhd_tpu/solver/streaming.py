@@ -158,17 +158,18 @@ class StreamingScheduler:
             now = time.monotonic()
         t_stream = time.perf_counter()
 
-        # pin the pre-existing heap for the sweep: a federation-scale node
-        # mirror is ~10M objects, and a major gc pass mid-run traverses
-        # all of them (measured as multi-second stalls inside otherwise-
-        # tiny spill sub-calls). freeze() moves the current generations to
-        # the permanent set (cheap, no collection) so in-sweep collections
-        # scan only sweep-allocated objects; unfreeze() at exit returns
-        # them to the normal generations for the next natural collection.
-        # GcPin holds the pin across every per-tile sub-call (their own
-        # acquire sees it active and leaves gc alone). Small sweeps skip
-        # the pin — see batch._gc_pinned for why per-call pinning of
-        # small batches would starve generational collection.
+        # pin the heap for the sweep: a federation-scale node mirror is
+        # ~10M objects, and a major gc pass mid-run traverses all of them
+        # (measured as multi-second stalls inside otherwise-tiny spill
+        # sub-calls). GcPin gc.freeze()s the pre-existing heap AND
+        # disables automatic collection for the sweep (young-gen
+        # re-scans of the sweep's own result objects were ~50% of the
+        # federation materialize phase); the next natural collection
+        # after release reclaims the sweep's bounded garbage. GcPin
+        # holds across every per-tile sub-call (their own acquire sees
+        # it active and leaves gc alone). Small sweeps skip the pin —
+        # see batch._gc_pinned for why per-call pinning of small
+        # batches would starve generational collection.
         from nhd_tpu.solver.batch import _GC_PIN_MIN_ITEMS, GcPin
 
         held = (
@@ -338,11 +339,20 @@ class StreamingScheduler:
                 sub_items, encoded, local_of = chunk_encoded(
                     chunk_id, pending
                 )
+                # the chunk's FIRST full offer has identity locals
+                # (local_of maps the same global_ids in order) — skip the
+                # two 100k-element remap comprehensions for it
+                identity = len(offer) == len(sub_items)
                 sub_results, sub_stats = self.batch.schedule(
                     tiles[ti], sub_items, now=now, context=contexts[ti],
-                    encoded=encoded, offer=[local_of[i] for i in offer],
+                    encoded=encoded,
+                    offer=(
+                        None if identity
+                        else [local_of[i] for i in offer]
+                    ),
                 )
-                sub_results = [sub_results[local_of[i]] for i in offer]
+                if not identity:
+                    sub_results = [sub_results[local_of[i]] for i in offer]
             else:
                 # >48 distinct groups: per-tile interners, per-offer
                 # encode (the pre-sharing behavior)
@@ -396,6 +406,8 @@ class StreamingScheduler:
                     )
                 results[pod_i] = r
                 placed_here.add(pod_i)
+            if len(placed_here) == len(pending):
+                return []  # common case: whole chunk landed in this tile
             return [i for i in pending if i not in placed_here]
 
         def run_tile(ti: int) -> None:
