@@ -56,7 +56,11 @@ def _pick_platform() -> str:
             capture_output=True, text=True, timeout=90,
         )
     except subprocess.TimeoutExpired:
-        _log("bench: TPU probe timed out (tunnel wedged); falling back to CPU")
+        _log("bench: TPU probe timed out (tunnel wedged); falling back to "
+             "CPU. Round-5 TPU evidence is preserved at "
+             "docs/bench/BENCH_TPU_r5_*.log (cfg4 119 ms / 84.3k pods/s "
+             "rounds=1; cfg5 1.86-2.70 s, p99 bind 1.2-1.5 s; daemon p99 "
+             "8.6 ms)")
         return "cpu"
     if probe.returncode == 0:
         plat = probe.stdout.strip().splitlines()[-1]
