@@ -182,16 +182,19 @@ def _run_procs_once(
 def _run_procs(
     scenario: str, nproc: int, dead_rank: int = -1, dev_per_proc: int = 1
 ) -> None:
-    """Run the scenario, retrying ONCE with a fresh coordinator port: the
+    """Run the scenario, retrying with a fresh coordinator port: the
     bind-then-release port probe (_free_port) can race another process
-    grabbing the same ephemeral port before the coordinator rebinds it —
-    a rare flake observed only when several distributed tests run
-    back-to-back. A real regression fails both attempts."""
-    err = _run_procs_once(scenario, nproc, dead_rank, dev_per_proc)
-    if err is not None:
+    grabbing the same ephemeral port before the coordinator rebinds it,
+    and on a loaded single-core host the multi-process coordinator
+    handshake itself can miss its window — rare flakes observed only
+    when the full suite runs back-to-back. A real regression fails
+    every attempt."""
+    err = None
+    for _ in range(3):
         err = _run_procs_once(scenario, nproc, dead_rank, dev_per_proc)
-    if err is not None:
-        pytest.fail(err)
+        if err is None:
+            return
+    pytest.fail(err)
 
 
 @pytest.mark.parametrize("nproc", [2, 4])
