@@ -130,17 +130,21 @@ def run_serial_baseline(nodes, reqs, sample: int):
     return (time.perf_counter() - t0) / max(sample, 1)
 
 
-def run_stream(nodes, reqs, *, tile_nodes=16384, chunk_pods=None,
+def run_stream(nodes, reqs, *, tile_nodes=None, chunk_pods=None,
                placement="routed"):
     """Schedule through the streaming solver (cfg5 federation path).
 
-    tile_nodes is an HBM-budget choice: a 16k-node tile's solve fits a
-    16 GB chip with room to spare, and every extra tile costs a relay
-    flush plus a serialized host tail — the 10k-node federation in ONE
-    tile (one megaround, one flush) measured 2.4 s / p99 1.2 s vs
-    2.9 s / p99 2.3 s for three 4096-node tiles (r5). Smaller tiles
-    remain the right call for federations larger than device memory or
-    per-region multi-host splits (solver/streaming.py docstring).
+    tile_nodes is backend-dependent. On an accelerator it is an
+    HBM-budget choice: a 16k-node tile's solve fits a 16 GB chip with
+    room to spare, and every extra tile costs a relay flush plus a
+    serialized host tail — the 10k-node federation in ONE tile (one
+    megaround, one flush) measured 2.4 s / p99 1.2 s vs 2.9 s /
+    p99 2.3 s for three 4096-node tiles (r5). On the CPU backend the
+    giant tile INVERTS (12.3 s vs ~7 s): the host pays the solve
+    compute directly, so smaller tiles with pipelined workers win.
+    Smaller tiles also remain the right call for federations larger
+    than device memory or per-region multi-host splits
+    (solver/streaming.py docstring).
     chunk_pods is backend-dependent: an accelerator pays per-dispatch
     relay latency, so one big chunk minimizes (tile, chunk) sub-calls
     (measured 5.8 s vs 6.6 s on the tunnel TPU); on CPU a 50k chunk
@@ -160,8 +164,11 @@ def run_stream(nodes, reqs, *, tile_nodes=16384, chunk_pods=None,
     from nhd_tpu.sim.workloads import cap_cluster, workload_mix
     from nhd_tpu.solver import BatchItem, StreamingScheduler
 
+    accel = jax.default_backend() != "cpu"
+    if tile_nodes is None:
+        tile_nodes = 16384 if accel else 4096
     if chunk_pods is None:
-        chunk_pods = 100_000 if jax.default_backend() != "cpu" else 50_000
+        chunk_pods = 100_000 if accel else 50_000
     sched = StreamingScheduler(
         tile_nodes=tile_nodes, chunk_pods=chunk_pods, placement=placement,
         respect_busy=False, register_pods=False,
