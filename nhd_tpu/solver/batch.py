@@ -36,7 +36,7 @@ from __future__ import annotations
 import threading as _threading
 import time
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -68,8 +68,13 @@ class BatchItem:
     topology: Optional[PodTopology] = None
 
 
-@dataclass(slots=True)
-class BatchAssignment:
+class BatchAssignment(NamedTuple):
+    """One pod's placement verdict. A NamedTuple, not a dataclass: a
+    gang sweep materializes one of these per pod (100k at federation
+    scale) and the C-level tuple constructor is ~2× a dataclass
+    __init__; immutability is part of the contract (callers remap via
+    _replace)."""
+
     key: Tuple[str, str]
     node: Optional[str]                  # None → unschedulable
     mapping: Optional[Dict[str, tuple]] = None

@@ -247,7 +247,9 @@ class StreamingScheduler:
             stats.round_end_seconds.append(time.perf_counter() - t_stream)
             for i in oversized:
                 if results[i] is not None and results[i].node is not None:
-                    results[i].round_no = len(stats.round_end_seconds) - 1
+                    results[i] = results[i]._replace(
+                        round_no=len(stats.round_end_seconds) - 1
+                    )
 
         # one interner shared by every tile context so a chunk's pod
         # encode (group_mask bit positions) is valid against all of them

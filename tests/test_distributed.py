@@ -197,8 +197,9 @@ def _run_procs(
         return any(
             p in low
             for p in (
-                "timed out", "coordinator", "barrier", "connect",
-                "unavailable", "deadline",
+                "timed out", "coordinator", "coordination", "barrier",
+                "connect", "unavailable", "deadline", "bind",
+                "already in use",
             )
         )
 
