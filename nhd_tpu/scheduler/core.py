@@ -40,11 +40,31 @@ STREAM_NODE_THRESH = int(os.environ.get("NHD_STREAM_NODES", "4096"))
 # larger chunks amortize encode cost across more pods per offer.
 # Validated here so a misconfigured value fails at startup, not when the
 # node count first crosses STREAM_NODE_THRESH mid-run on the scheduler
-# thread (StreamingScheduler's own constructor check would fire there)
-STREAM_TILE_NODES = int(os.environ.get("NHD_STREAM_TILE_NODES", "2048"))
+# thread (StreamingScheduler's own constructor check would fire there).
+# The tile default is backend-dependent (resolved lazily at first
+# streaming use, _stream_tile_nodes): on an accelerator every tile
+# costs a relay flush plus a serialized host tail, so tiles size up to
+# the device-memory budget; on CPU the host pays the solve compute
+# directly and smaller pipelined tiles win (measured r5; bench.py
+# run_stream's docstring carries the numbers).
+_STREAM_TILE_ENV = os.environ.get("NHD_STREAM_TILE_NODES")
+STREAM_TILE_NODES = int(_STREAM_TILE_ENV) if _STREAM_TILE_ENV else 0
+
+
+def _stream_tile_nodes() -> int:
+    if STREAM_TILE_NODES:
+        return STREAM_TILE_NODES
+    from nhd_tpu.solver.batch import _accelerator_backend
+
+    # both defaults are the r5-measured configurations (bench.py
+    # run_stream: 16384 = one-flush federation tile on the chip, 4096 =
+    # the best pipelined CPU tiling)
+    return 16384 if _accelerator_backend() else 4096
+
+
 STREAM_CHUNK_PODS = int(os.environ.get("NHD_STREAM_CHUNK_PODS", "16384"))
 STREAM_PLACEMENT = os.environ.get("NHD_STREAM_PLACEMENT", "first-fit")
-if STREAM_TILE_NODES < 1 or STREAM_CHUNK_PODS < 1:
+if (_STREAM_TILE_ENV and STREAM_TILE_NODES < 1) or STREAM_CHUNK_PODS < 1:
     raise ValueError(
         "NHD_STREAM_TILE_NODES and NHD_STREAM_CHUNK_PODS must be >= 1, got "
         f"{STREAM_TILE_NODES} / {STREAM_CHUNK_PODS}"
@@ -313,7 +333,7 @@ class Scheduler(threading.Thread):
 
             if self._stream is None:
                 self._stream = StreamingScheduler(
-                    tile_nodes=STREAM_TILE_NODES,
+                    tile_nodes=_stream_tile_nodes(),
                     chunk_pods=STREAM_CHUNK_PODS,
                     placement=STREAM_PLACEMENT,
                     respect_busy=self.batch.respect_busy,
