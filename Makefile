@@ -30,7 +30,9 @@ bench:
 	python bench.py
 
 wheel:
-	python -m pip wheel --no-deps -w dist .
+	# --no-build-isolation: use the interpreter's setuptools instead of
+	# resolving build deps from the network (works on zero-egress hosts)
+	python -m pip wheel --no-deps --no-build-isolation -w dist .
 
 # chaos soak: the reproducible command behind docs/COVERAGE.md's
 # "100+ seeds soaked clean" (CI runs the 4-seed subset in tests/test_chaos.py)
