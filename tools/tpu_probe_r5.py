@@ -22,7 +22,7 @@ def main() -> None:
     print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
 
     from bench import run_batch, run_stream
-    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+    from nhd_tpu.sim.workloads import bench_cluster, cap_cluster, workload_mix
 
     groups = ["default", "edge", "batch"]
     reqs = workload_mix(10_000, groups)
@@ -41,6 +41,22 @@ def main() -> None:
         f"unaccounted={max(0.0, wall - acc) * 1e3:.1f}ms",
         file=sys.stderr,
     )
+
+    if "--cfg3" in sys.argv:
+        # saturated shape: measures the saturation certificate's effect
+        # (expected: rounds=1 + certified_unschedulable≈6000, no classic
+        # confirmation round — wall ~130 ms vs the r5-log 214 ms).
+        # Same deterministic workload as cfg4, different cluster.
+        wall, placed, stats, results = run_batch(
+            bench_cluster(1_000, groups), reqs
+        )
+        print(
+            f"cfg3: wall={wall * 1e3:.0f}ms placed={placed} "
+            f"rounds={stats.rounds}",
+            file=sys.stderr,
+        )
+        print(f"cfg3 phases: {stats.phases}", file=sys.stderr)
+        print(f"cfg3 counters: {stats.counters}", file=sys.stderr)
 
     if "--fed" in sys.argv:
         groups5 = ["default", "edge", "batch", "fed1", "fed2"]
