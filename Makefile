@@ -7,7 +7,7 @@ VERSION   ?= $(shell python -c "import tomllib;print(tomllib.load(open('pyprojec
 SOAK_SEEDS ?= 100
 SOAK_STEPS ?= 120
 
-.PHONY: test proto bench wheel clean native soak docker docker-smoke release
+.PHONY: test lint proto bench wheel clean native soak docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -17,8 +17,24 @@ native:
 test:
 	python -m pytest tests/ -x -q
 
-# full release gate: suite + benchmark smoke on the CPU backend
-check: test
+# static analysis: nhdlint (stdlib, always runs; also gates tier-1 via
+# tests/test_static_analysis.py) + ruff + scoped mypy when installed
+# (configs in pyproject.toml; rule docs in docs/STATIC_ANALYSIS.md)
+lint:
+	python -m nhd_tpu.analysis nhd_tpu
+	@if python -c "import ruff" >/dev/null 2>&1; then \
+		python -m ruff check nhd_tpu; \
+	else \
+		echo "ruff not installed; skipping (pip install ruff)"; \
+	fi
+	@if python -c "import mypy" >/dev/null 2>&1; then \
+		python -m mypy; \
+	else \
+		echo "mypy not installed; skipping (pip install mypy)"; \
+	fi
+
+# full release gate: lint + suite + benchmark smoke on the CPU backend
+check: lint test
 	NHD_BENCH_PLATFORM=cpu python bench.py
 
 # Regenerate protobuf message bindings. Service stubs are hand-written in

@@ -1,0 +1,42 @@
+"""nhdlint — AST-based static analysis for this codebase's failure modes.
+
+The solver's performance story rests on jit-cache reuse over bucketed
+shapes, and the control plane mutates shared state from watch threads.
+The bug classes that hurt most at production scale — silent recompiles,
+host-sync stalls in the hot batch loop, off-lock state mutation,
+nondeterministic placement — are exactly the ones best caught statically.
+Four rule packs, each a visitor over stdlib ``ast`` (no third-party
+dependency, so the gate runs everywhere the tests run):
+
+  tracing      NHD1xx  JAX tracing / recompile / host-sync hazards
+  locks        NHD2xx  lock discipline for classes that own a Lock/RLock
+  excepts      NHD3xx  exception hygiene (silently swallowed errors)
+  determinism  NHD4xx  unseeded randomness / wall-clock in solver paths
+
+Run ``python -m nhd_tpu.analysis nhd_tpu/`` or see docs/STATIC_ANALYSIS.md
+for the rule catalogue, suppression syntax and the baseline workflow.
+"""
+
+from nhd_tpu.analysis.core import (
+    Finding,
+    PACKS,
+    RULES,
+    analyze_file,
+    analyze_paths,
+    iter_py_files,
+    load_baseline,
+    subtract_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "PACKS",
+    "RULES",
+    "analyze_file",
+    "analyze_paths",
+    "iter_py_files",
+    "load_baseline",
+    "subtract_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,3 @@
+from nhd_tpu.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,144 @@
+"""``python -m nhd_tpu.analysis`` — the nhdlint command line.
+
+Exit codes: 0 = clean (or everything baselined/suppressed), 1 = new
+findings, 2 = usage error. Output formats: human (default, one line per
+finding, grep-friendly) and ``--format json`` (stable schema for CI
+annotation tooling).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from nhd_tpu.analysis.core import (
+    Finding,
+    PACKS,
+    RULES,
+    analyze_paths,
+    load_baseline,
+    subtract_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = ".nhdlint-baseline.json"
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nhdlint",
+        description="AST-based static analysis for JAX tracing hazards, "
+                    "lock discipline, exception hygiene and scheduler "
+                    "determinism (see docs/STATIC_ANALYSIS.md).",
+    )
+    p.add_argument("paths", nargs="*", default=["nhd_tpu"],
+                   help="files or directories to analyze (default: nhd_tpu)")
+    p.add_argument("--packs", default=",".join(PACKS),
+                   help=f"comma-separated packs to run (default: all of "
+                        f"{','.join(PACKS)})")
+    p.add_argument("-f", "--format", dest="fmt", choices=("human", "json"),
+                   default="human")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON of grandfathered findings "
+                        f"(default: ./{DEFAULT_BASELINE} if present)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file and "
+                        "exit 0 (grandfather everything now visible)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file (report all findings)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    return p
+
+
+def _resolve_packs(arg: str) -> Optional[List[str]]:
+    packs = [x.strip() for x in arg.split(",") if x.strip()]
+    unknown = [x for x in packs if x not in PACKS]
+    if unknown:
+        print(f"nhdlint: unknown pack(s): {', '.join(unknown)} "
+              f"(have: {', '.join(PACKS)})", file=sys.stderr)
+        return None
+    return packs
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule, (pack, desc) in sorted(RULES.items()):
+            print(f"{rule}  [{pack:<11}] {desc}")
+        return 0
+
+    packs = _resolve_packs(args.packs)
+    if packs is None:
+        return 2
+
+    reports = analyze_paths(args.paths, packs)
+    if not reports:
+        # a path typo must not read as "clean" — that would silently
+        # disable the whole lint tier in make lint / CI
+        print(f"nhdlint: no Python files found under: "
+              f"{', '.join(args.paths)}", file=sys.stderr)
+        return 2
+    findings: List[Finding] = [f for r in reports for f in r.findings]
+    suppressed = sum(r.suppressed for r in reports)
+    unused_ignores = [
+        (r.path, line) for r in reports for line in r.unused_ignores
+    ]
+
+    baseline_path = Path(args.baseline or DEFAULT_BASELINE)
+    if args.write_baseline:
+        if set(packs) != set(PACKS):
+            # a subset write would silently drop every other pack's
+            # grandfathered entries from the file
+            print("nhdlint: --write-baseline requires all packs "
+                  "(drop --packs)", file=sys.stderr)
+            return 2
+        write_baseline(findings, baseline_path)
+        print(f"nhdlint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baselined = 0
+    if not args.no_baseline and (args.baseline or baseline_path.exists()):
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, json.JSONDecodeError) as exc:
+            print(f"nhdlint: bad baseline {baseline_path}: {exc}",
+                  file=sys.stderr)
+            return 2
+        findings, baselined = subtract_baseline(findings, baseline)
+
+    if args.fmt == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "files": len(reports),
+            "suppressed": suppressed,
+            "baselined": baselined,
+            "unused_ignores": [
+                {"path": p, "line": line} for p, line in unused_ignores
+            ],
+            "packs": packs,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}")
+            if f.snippet:
+                print(f"    {f.snippet}")
+        for p, line in unused_ignores:
+            # advisory, not an exit-code failure: a stale directive can
+            # mask a future finding on its line, so keep them visible
+            print(f"{p}:{line}: warning: unused 'nhdlint: ignore' directive")
+        tail = (f"{len(findings)} finding(s) in {len(reports)} file(s)"
+                f" ({suppressed} suppressed, {baselined} baselined, "
+                f"{len(unused_ignores)} unused ignore(s))")
+        print(f"nhdlint: {tail}" if findings else f"nhdlint: clean — {tail}")
+
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,369 @@
+"""nhdlint engine: findings, suppressions, baseline, file walking.
+
+Rule packs live in sibling ``rules_*`` modules; each exposes
+``check_module(tree, src, path) -> List[Finding]``. This module owns
+everything rule-independent so a pack is just one visitor plus a rule
+table entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import re
+import tokenize
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str          # e.g. "NHD201"
+    path: str          # path as given to the analyzer (posix separators)
+    line: int          # 1-based line of the offending node
+    col: int           # 0-based column
+    message: str
+    snippet: str = ""  # stripped source line, for output and fingerprints
+
+    def fingerprint(self) -> str:
+        """Line-number-independent identity used by the baseline: moving
+        a grandfathered finding up or down a file must not resurrect it,
+        while editing the offending line (or renaming/moving the file)
+        must. Keyed on the last two path components rather than the full
+        path so the gate test (absolute paths) and the CLI (relative
+        paths) agree on the same entries, while same-named files in
+        different directories still get distinct slots."""
+        tail = "/".join(self.path.rsplit("/", 2)[-2:])
+        raw = f"{self.rule}:{tail}:{self.snippet}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+
+@dataclass
+class FileReport:
+    """Per-file outcome: surviving findings plus suppression accounting."""
+
+    path: str
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    skipped: bool = False          # whole file opted out via skip-file
+    unused_ignores: List[int] = field(default_factory=list)  # line numbers
+
+
+# ---------------------------------------------------------------------------
+# rule registry (packs register lazily to keep import order trivial)
+# ---------------------------------------------------------------------------
+
+def _pack_tracing(tree, src, path):
+    from nhd_tpu.analysis.rules_tracing import check_module
+    return check_module(tree, src, path)
+
+
+def _pack_locks(tree, src, path):
+    from nhd_tpu.analysis.rules_locks import check_module
+    return check_module(tree, src, path)
+
+
+def _pack_excepts(tree, src, path):
+    from nhd_tpu.analysis.rules_excepts import check_module
+    return check_module(tree, src, path)
+
+
+def _pack_determinism(tree, src, path):
+    from nhd_tpu.analysis.rules_determinism import check_module
+    return check_module(tree, src, path)
+
+
+PACKS: Dict[str, Callable] = {
+    "tracing": _pack_tracing,
+    "locks": _pack_locks,
+    "excepts": _pack_excepts,
+    "determinism": _pack_determinism,
+}
+
+# rule id -> (pack, one-line description); the single source docs and
+# --list-rules render from
+RULES: Dict[str, Tuple[str, str]] = {
+    "NHD101": ("tracing",
+               "int()/float()/bool() coercion of a traced value inside a "
+               "jit-traced function (ConcretizationError or silent host sync)"),
+    "NHD102": ("tracing",
+               "Python control flow (if/while/assert) on a traced value "
+               "inside a jit-traced function (TracerBoolConversionError)"),
+    "NHD103": ("tracing",
+               "numpy host op on a traced value inside a jit-traced "
+               "function (breaks tracing or forces a device sync)"),
+    "NHD104": ("tracing",
+               "jax.jit wrapper constructed per call (not module-scope and "
+               "not under lru_cache): a fresh program cache per wrapper "
+               "defeats bucketed-shape reuse"),
+    "NHD105": ("tracing",
+               "static_argnums/static_argnames parameter with an unhashable "
+               "(mutable) default: first defaulted call raises, and mutable "
+               "statics silently miss the jit cache"),
+    "NHD201": ("locks",
+               "write to lock-guarded attribute outside 'with <lock>:' in a "
+               "class that owns a threading.Lock/RLock"),
+    "NHD202": ("locks",
+               "bare <lock>.acquire() call: an exception before release() "
+               "deadlocks every other thread; use 'with <lock>:'"),
+    "NHD301": ("excepts",
+               "bare 'except:' catches SystemExit/KeyboardInterrupt and "
+               "hides programming errors"),
+    "NHD302": ("excepts",
+               "broad 'except Exception:' that neither logs, re-raises, nor "
+               "returns — watch-loop and RPC errors vanish silently"),
+    "NHD401": ("determinism",
+               "unseeded global RNG (random.*/np.random.*) in a solver/encode "
+               "path: placement must be a pure function of cluster state"),
+    "NHD402": ("determinism",
+               "wall-clock read (time.time/datetime.now) in a solver/encode "
+               "path: use the caller-passed 'now' or time.monotonic"),
+}
+
+
+# ---------------------------------------------------------------------------
+# suppression syntax
+# ---------------------------------------------------------------------------
+
+# directive forms, all comment-only: "nhdlint:" followed by either
+# "ignore[RULE1,RULE2]", a bare "ignore" (all rules), or "skip-file"
+_DIRECTIVE = re.compile(
+    r"#\s*nhdlint:\s*(?P<kind>ignore|skip-file)"
+    r"(?:\[(?P<rules>[A-Z0-9,\s]+)\])?"
+)
+
+
+def _comment_tokens(src: str) -> Dict[int, str]:
+    """line -> comment text, via tokenize so directive-looking text inside
+    string literals and docstrings can never register as a directive."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unterminated construct etc. — fall back to raw lines so a file
+        # the parser also rejects (reported as NHD000) still honors its
+        # directives. Only comment-shaped lines count: a directive inside
+        # a string literal must not survive the fallback either.
+        for lineno, line in enumerate(src.splitlines(), start=1):
+            if line.lstrip().startswith("#"):
+                out[lineno] = line
+    return out
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.numpy.asarray' for a nested Attribute/Name chain, else None.
+    Shared by the rule packs so they can never disagree on what counts
+    as a dotted call."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def parse_suppressions(
+    src: str, tree: Optional[ast.Module] = None
+) -> Tuple[bool, Dict[int, Optional[frozenset]]]:
+    """Scan source *comments* for nhdlint directives.
+
+    Returns (skip_file, {line -> rules-or-None}) where None means "ignore
+    every rule on this line". skip-file is honored only above the first
+    statement (module docstring/comment block), so it cannot hide inside
+    a function body. Pass the already-parsed ``tree`` to avoid a second
+    parse; None means the source failed to parse.
+    """
+    ignores: Dict[int, Optional[frozenset]] = {}
+    skip_file = False
+    first_code_line = None
+    if tree is not None:
+        body = [n for n in tree.body
+                if not (isinstance(n, ast.Expr)
+                        and isinstance(n.value, ast.Constant))]
+        if body:
+            first_code_line = body[0].lineno
+    for lineno, comment in sorted(_comment_tokens(src).items()):
+        m = _DIRECTIVE.search(comment)
+        if not m:
+            continue
+        if m.group("kind") == "skip-file":
+            if first_code_line is None or lineno <= first_code_line:
+                skip_file = True
+            continue
+        rules = m.group("rules")
+        if rules:
+            ignores[lineno] = frozenset(
+                r.strip() for r in rules.split(",") if r.strip()
+            )
+        else:
+            ignores[lineno] = None
+    return skip_file, ignores
+
+
+# ---------------------------------------------------------------------------
+# analysis driver
+# ---------------------------------------------------------------------------
+
+def analyze_file(
+    path: str | Path,
+    packs: Optional[Sequence[str]] = None,
+    *,
+    src: Optional[str] = None,
+) -> FileReport:
+    """Run the selected packs over one file, applying inline suppressions."""
+    p = Path(path)
+    display = p.as_posix()
+    report = FileReport(path=display)
+    if src is None:
+        try:
+            src = p.read_text()
+        except (OSError, UnicodeDecodeError) as exc:
+            report.findings.append(Finding(
+                "NHD000", display, 1, 0, f"unreadable file: {exc}"
+            ))
+            return report
+    try:
+        tree: Optional[ast.Module] = ast.parse(src, filename=display)
+    except SyntaxError as exc:
+        tree = None
+        syntax_error: Optional[SyntaxError] = exc
+    else:
+        syntax_error = None
+    skip_file, ignores = parse_suppressions(src, tree)
+    if skip_file:
+        report.skipped = True
+        return report
+    if tree is None:
+        assert syntax_error is not None
+        report.findings.append(Finding(
+            "NHD000", display, syntax_error.lineno or 1, 0,
+            f"syntax error: {syntax_error.msg}",
+        ))
+        return report
+
+    lines = src.splitlines()
+    raw: List[Finding] = []
+    for name in packs or PACKS:
+        raw.extend(PACKS[name](tree, src, display))
+
+    used_ignore_lines = set()
+    for f in raw:
+        snippet = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        f = Finding(f.rule, f.path, f.line, f.col, f.message, snippet)
+        rules = ignores.get(f.line, "missing")
+        if rules != "missing" and (rules is None or f.rule in rules):
+            report.suppressed += 1
+            used_ignore_lines.add(f.line)
+        else:
+            report.findings.append(f)
+    # a directive is "unused" only when every rule it could suppress was
+    # actually checked this run — a --packs subset must not tell people
+    # to delete suppressions that are load-bearing for the full run
+    ran = set(packs or PACKS)
+    ran_rules = {rid for rid, (pack, _) in RULES.items() if pack in ran}
+    for line, rules in ignores.items():
+        if line in used_ignore_lines:
+            continue
+        judged = ran == set(PACKS) if rules is None else rules <= ran_rules
+        if judged:
+            report.unused_ignores.append(line)
+    report.unused_ignores.sort()
+    report.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return report
+
+
+def iter_py_files(paths: Iterable[str | Path]) -> List[Path]:
+    """Expand files/directories into a sorted, de-duplicated .py list."""
+    out = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    seen, uniq = set(), []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq
+
+
+def analyze_paths(
+    paths: Iterable[str | Path],
+    packs: Optional[Sequence[str]] = None,
+) -> List[FileReport]:
+    return [analyze_file(p, packs) for p in iter_py_files(paths)]
+
+
+# ---------------------------------------------------------------------------
+# baseline: grandfathered findings, matched by fingerprint with multiplicity
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str | Path) -> Dict[str, int]:
+    """fingerprint -> allowed count. Missing file = empty baseline."""
+    p = Path(path)
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {p}: unsupported version {data.get('version')!r}"
+        )
+    counts: Dict[str, int] = {}
+    for entry in data.get("entries", []):
+        counts[entry["fingerprint"]] = (
+            counts.get(entry["fingerprint"], 0) + int(entry.get("count", 1))
+        )
+    return counts
+
+
+def subtract_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], int]:
+    """Drop findings covered by the baseline; returns (new, baselined)."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    baselined = 0
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            baselined += 1
+        else:
+            new.append(f)
+    return new, baselined
+
+
+def write_baseline(findings: Sequence[Finding], path: str | Path) -> None:
+    """Serialize current findings as the new grandfather set (sorted and
+    aggregated so the file diffs cleanly in review)."""
+    agg: Dict[Tuple[str, str, str, str], int] = {}
+    for f in findings:
+        key = (f.rule, f.path, f.snippet, f.fingerprint())
+        agg[key] = agg.get(key, 0) + 1
+    entries = [
+        {"rule": rule, "path": p, "snippet": snip,
+         "fingerprint": fp, "count": n}
+        for (rule, p, snip, fp), n in sorted(agg.items())
+    ]
+    Path(path).write_text(json.dumps(
+        {"version": BASELINE_VERSION, "entries": entries}, indent=2
+    ) + "\n")

@@ -1,0 +1,414 @@
+"""NHD1xx — JAX tracing / recompile / host-sync hazards.
+
+The solver's throughput rests on two properties the interpreter will not
+enforce for us:
+
+* a jitted program must stay traceable — any host coercion of a tracer
+  (``int(x)``, ``if x:``, ``np.asarray(x)``) either raises at trace time
+  or, worse, silently constant-folds a value that should be data;
+* every ``jax.jit`` wrapper owns its own compilation cache — building one
+  per call (instead of per bucket shape, under ``lru_cache``) recompiles
+  the same program forever and erases the bucketing win.
+
+Scope is computed per module with no imports executed: a function is
+*jit-traced* if it is decorated with ``jax.jit`` (directly or through
+``functools.partial``), passed to a ``jax.jit(...)`` call anywhere in the
+module, or reachable from such a function through module-local calls
+(the repo's idiom wraps a closure ``fn`` that forwards to the real
+kernel, so one propagation step is load-bearing, not cosmetic).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from nhd_tpu.analysis.core import Finding, _dotted
+
+_COERCIONS = {"int", "float", "bool", "complex"}
+# attribute reads that yield static (host) values even on a tracer
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "sharding", "weak_type"}
+_CACHE_DECORATORS = {"lru_cache", "cache"}
+
+
+def _is_jit_ref(node: ast.AST, jit_names: Set[str]) -> bool:
+    d = _dotted(node)
+    return d is not None and (d in jit_names or d.endswith(".jit"))
+
+
+def _jit_call(node: ast.AST, jit_names: Set[str]) -> Optional[ast.Call]:
+    """The inner jit Call if *node* is ``jax.jit(...)`` or
+    ``partial(jax.jit, ...)``; else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_ref(node.func, jit_names):
+        return node
+    d = _dotted(node.func)
+    if d in ("partial", "functools.partial") and node.args:
+        if _is_jit_ref(node.args[0], jit_names):
+            return node
+    return None
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """All function defs (nested included), their call edges, and every
+    name passed to a jit call."""
+
+    def __init__(self, jit_names: Set[str]):
+        self.jit_names = jit_names
+        self.functions: Dict[str, List[ast.FunctionDef]] = {}
+        self.calls: Dict[int, Set[str]] = {}    # id(funcdef) -> callee names
+        self.jit_roots: Set[str] = set()        # names passed to jax.jit
+        self._stack: List[ast.FunctionDef] = []
+
+    def _visit_func(self, node) -> None:
+        self.functions.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _is_jit_ref(target, self.jit_names):
+                self.jit_roots.add(node.name)
+            if isinstance(dec, ast.Call) and _jit_call(dec, self.jit_names):
+                self.jit_roots.add(node.name)
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._stack and isinstance(node.func, ast.Name):
+            self.calls.setdefault(
+                id(self._stack[-1]), set()
+            ).add(node.func.id)
+        jc = _jit_call(node, self.jit_names)
+        if jc is not None:
+            for arg in jc.args:
+                if isinstance(arg, ast.Name):
+                    self.jit_roots.add(arg.id)
+        self.generic_visit(node)
+
+
+def _collect_jit_aliases(tree: ast.Module) -> Set[str]:
+    """Local names that mean jax.jit: ``from jax import jit [as j]``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    out.add(alias.asname or "jit")
+    return out
+
+
+class _TracedChecker:
+    """Per-traced-function dataflow: which local names carry tracers."""
+
+    def __init__(self, fn: ast.FunctionDef, findings: List[Finding],
+                 path: str):
+        self.findings = findings
+        self.path = path
+        args = fn.args
+        params = [a.arg for a in (
+            args.posonlyargs + args.args + args.kwonlyargs
+        )]
+        if args.vararg:
+            params.append(args.vararg.arg)
+        if args.kwarg:
+            params.append(args.kwarg.arg)
+        self.traced: Set[str] = set(params) - {"self", "cls"}
+        self.fn = fn
+
+    # -- taint judgement -------------------------------------------------
+
+    def is_traced(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func) or ""
+            if d == "len" or d.split(".")[-1] in _COERCIONS:
+                return False  # result is a concrete host value (the
+                #               coercion itself is judged separately)
+            if isinstance(node.func, ast.Attribute) and self.is_traced(
+                node.func.value
+            ):
+                return True   # method on a traced object (x.astype(...))
+            return any(self.is_traced(a) for a in node.args) or any(
+                self.is_traced(k.value) for k in node.keywords
+            )
+        if isinstance(node, ast.BinOp):
+            return self.is_traced(node.left) or self.is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.is_traced(node.left) or any(
+                self.is_traced(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_traced(v) for v in node.values) or any(
+                k is not None and self.is_traced(k) for k in node.keys
+            )
+        if isinstance(node, ast.IfExp):
+            return self.is_traced(node.body) or self.is_traced(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.is_traced(node.value)
+        return False
+
+    # -- propagation + checks -------------------------------------------
+
+    def run(self) -> None:
+        # two passes so names assigned late but used early (loops) settle
+        for _ in range(2):
+            for node in self._own_nodes():
+                if isinstance(node, ast.Assign) and self.is_traced(node.value):
+                    for tgt in node.targets:
+                        self._taint_target(tgt)
+                elif isinstance(node, ast.AugAssign) and (
+                    self.is_traced(node.value) or self.is_traced(node.target)
+                ):
+                    self._taint_target(node.target)
+        for node in self._own_nodes():
+            self._check(node)
+
+    def _own_nodes(self):
+        """ast.walk minus nested function bodies: a nested def is judged
+        by its own _TracedChecker (it is traced-reachable through the
+        call graph), so descending here would double-report and cross
+        two scopes' taint sets."""
+        stack = list(ast.iter_child_nodes(self.fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _taint_target(self, tgt: ast.AST) -> None:
+        if isinstance(tgt, ast.Name):
+            self.traced.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._taint_target(e)
+
+    def _emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            rule, self.path, node.lineno, node.col_offset, msg
+        ))
+
+    def _check(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if (
+                d in _COERCIONS
+                and node.args
+                and self.is_traced(node.args[0])
+            ):
+                self._emit(
+                    "NHD101", node,
+                    f"{d}() coerces a traced value inside jit-traced "
+                    f"'{self.fn.name}': concretization error or silent "
+                    "host sync — keep it a jnp array or hoist to the host",
+                )
+            elif d and (d.startswith("np.") or d.startswith("numpy.")) and (
+                any(self.is_traced(a) for a in node.args)
+            ):
+                self._emit(
+                    "NHD103", node,
+                    f"{d}() applies host numpy to a traced value inside "
+                    f"jit-traced '{self.fn.name}': use jnp / lax so the op "
+                    "stays in the program",
+                )
+        elif isinstance(node, (ast.If, ast.While)) and self.is_traced(
+            node.test
+        ):
+            kw = "if" if isinstance(node, ast.If) else "while"
+            self._emit(
+                "NHD102", node,
+                f"Python '{kw}' on a traced value inside jit-traced "
+                f"'{self.fn.name}': use jnp.where/lax.cond (branch decides "
+                "at trace time, not per element)",
+            )
+        elif isinstance(node, ast.Assert) and self.is_traced(node.test):
+            self._emit(
+                "NHD102", node,
+                f"assert on a traced value inside jit-traced "
+                f"'{self.fn.name}': asserts run at trace time only — use "
+                "checkify or validate on the host",
+            )
+
+
+def _check_jit_construction(
+    tree: ast.Module, jit_names: Set[str], path: str,
+    functions: Dict[str, List[ast.FunctionDef]],
+) -> List[Finding]:
+    """NHD104 (uncached per-call jit wrappers) + NHD105 (unhashable
+    static-arg defaults)."""
+    findings: List[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.fn_stack: List[ast.FunctionDef] = []
+            self.loop_depth = 0
+
+        def _cached(self, fn: ast.FunctionDef) -> bool:
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                d = _dotted(target) or ""
+                if d.split(".")[-1] in _CACHE_DECORATORS:
+                    return True
+            return False
+
+        def _visit_func(self, node) -> None:
+            # decorators evaluate once at def time, in the ENCLOSING
+            # scope — '@partial(jax.jit, ...)' on a module-level def is
+            # fine, while the same decorator on a def nested in an
+            # uncached factory is a per-call construction and flags
+            decorators = set(map(id, node.decorator_list))
+            for dec in node.decorator_list:
+                self.visit(dec)
+            self.fn_stack.append(node)
+            outer_loops, self.loop_depth = self.loop_depth, 0
+            for child in ast.iter_child_nodes(node):
+                if id(child) not in decorators:
+                    self.visit(child)
+            self.loop_depth = outer_loops
+            self.fn_stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+        def visit_For(self, node) -> None:
+            self._visit_loop(node)
+
+        def visit_While(self, node) -> None:
+            self._visit_loop(node)
+
+        def _visit_loop(self, node) -> None:
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        def visit_Call(self, node: ast.Call) -> None:
+            jc = _jit_call(node, jit_names)
+            if jc is not None:
+                self._check_104(node)
+                self._check_105(node)
+            self.generic_visit(node)
+
+        def _check_104(self, node: ast.Call) -> None:
+            if self.loop_depth > 0:
+                findings.append(Finding(
+                    "NHD104", path, node.lineno, node.col_offset,
+                    "jax.jit constructed inside a loop: every iteration "
+                    "gets a fresh wrapper with an empty compile cache — "
+                    "hoist it out (one wrapper per bucket shape)",
+                ))
+            elif self.fn_stack and not any(
+                self._cached(f) for f in self.fn_stack
+            ):
+                findings.append(Finding(
+                    "NHD104", path, node.lineno, node.col_offset,
+                    f"jax.jit constructed per call of "
+                    f"'{self.fn_stack[-1].name}': recompiles on every "
+                    "invocation — cache the wrapper (functools.lru_cache "
+                    "keyed on the bucket shape) or hoist to module scope",
+                ))
+
+        def _check_105(self, node: ast.Call) -> None:
+            static_nums: List[int] = []
+            static_names: List[str] = []
+            for kw in node.keywords:
+                if kw.arg == "static_argnums":
+                    static_nums = _int_list(kw.value)
+                elif kw.arg == "static_argnames":
+                    static_names = _str_list(kw.value)
+            if not static_nums and not static_names:
+                return
+            target = node.args[0] if node.args else None
+            # partial(jax.jit, ...) has the fn elsewhere; only direct
+            # jax.jit(fn, static_...) resolves
+            if not isinstance(target, ast.Name):
+                return
+            for fn in functions.get(target.id, []):
+                args = fn.args.posonlyargs + fn.args.args
+                n_nodefault = len(args) - len(fn.args.defaults)
+                for i, a in enumerate(args):
+                    if i in static_nums or a.arg in static_names:
+                        j = i - n_nodefault
+                        if j >= 0 and _is_mutable_literal(
+                            fn.args.defaults[j]
+                        ):
+                            findings.append(Finding(
+                                "NHD105", path, node.lineno,
+                                node.col_offset,
+                                f"static arg '{a.arg}' of '{fn.name}' "
+                                "defaults to an unhashable value: the jit "
+                                "cache keys statics by hash — use a tuple "
+                                "/ frozenset / hashable config object",
+                            ))
+
+    V().visit(tree)
+    return findings
+
+
+def _int_list(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _str_list(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func) or ""
+        return d.split(".")[-1] in ("list", "dict", "set", "bytearray")
+    return False
+
+
+def check_module(tree: ast.Module, src: str, path: str) -> List[Finding]:
+    jit_names = _collect_jit_aliases(tree)
+    index = _FunctionIndex(jit_names)
+    index.visit(tree)
+
+    # propagate tracedness through module-local calls to a fixed point
+    traced: Set[str] = set(index.jit_roots)
+    changed = True
+    while changed:
+        changed = False
+        for name in list(traced):
+            for fn in index.functions.get(name, []):
+                for callee in index.calls.get(id(fn), ()):
+                    if callee in index.functions and callee not in traced:
+                        traced.add(callee)
+                        changed = True
+
+    findings: List[Finding] = []
+    for name in sorted(traced):
+        for fn in index.functions.get(name, []):
+            _TracedChecker(fn, findings, path).run()
+    findings.extend(
+        _check_jit_construction(tree, jit_names, path, index.functions)
+    )
+    return findings

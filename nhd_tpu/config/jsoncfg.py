@@ -31,7 +31,7 @@ gymnastics); the solved overlay is regenerated from the topology objects.
 from __future__ import annotations
 
 import json
-from typing import Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from nhd_tpu.config.parser import CfgParser, register_cfg_parser
 from nhd_tpu.core.topology import (
@@ -55,7 +55,7 @@ def _smt(block: Optional[dict]) -> SmtMode:
     return SmtMode.ON if block.get("smt", True) else SmtMode.OFF
 
 
-def _handout_order(pg: ProcGroup):
+def _handout_order(pg: ProcGroup) -> List[Core]:
     """Canonical serialization order for a group's cores: NIC rx/tx pair,
     GPU feeders, then plain workers. This is this FORMAT's positional
     contract — to_config writes and to_topology(parse_net=True) reloads
@@ -184,11 +184,12 @@ class JsonCfgParser(CfgParser):
         """Regenerate the document with the solved ``assigned`` overlay."""
         doc = dict(self.doc or {})
         top = self.top
+        assert top is not None, "to_config before a successful to_topology"
         groups_out = []
         for gi, (g, pg) in enumerate(zip(doc.get("groups", []),
                                          top.proc_groups)):
             g = dict(g)
-            asg = {
+            asg: Dict[str, Any] = {
                 "proc_core_ids": [c.core for c in _handout_order(pg)],
                 "helper_core_ids": [c.core for c in pg.misc_cores],
                 "gpu_device_ids": [gpu.device_id for gpu in pg.gpus],
@@ -220,9 +221,11 @@ class JsonCfgParser(CfgParser):
         """nvidia<i> → physical device id, indexed across groups (the
         reference restarts per group and overwrites, TriadCfgParser.py:403;
         kept fixed here like the Triad rebuild)."""
+        top = self.top
+        assert top is not None, "to_gpu_map before a successful to_topology"
         out: Dict[str, int] = {}
         i = 0
-        for pg in self.top.proc_groups:
+        for pg in top.proc_groups:
             for gpu in pg.gpus:
                 out[f"nvidia{i}"] = gpu.device_id
                 i += 1

@@ -23,7 +23,7 @@ reads back: the config→topology→solved-config round trip
 from __future__ import annotations
 
 import re
-from typing import Any, Iterator, List, Tuple
+from typing import Any, IO, Iterator, List, Tuple
 
 
 class ConfigError(ValueError):
@@ -114,7 +114,7 @@ def _tokenize(text: str) -> Iterator[Tuple[str, str]]:
             line = text.count("\n", 0, pos) + 1
             raise ConfigError(f"unexpected character {text[pos]!r} at line {line}")
         pos = m.end()
-        kind = m.lastgroup
+        kind = m.lastgroup or ""
         if kind in ("ws", "comment"):
             continue
         yield kind, m.group()
@@ -232,7 +232,7 @@ def loads(text: str) -> ConfigDict:
     return _Parser(text).parse()
 
 
-def load(fh) -> ConfigDict:
+def load(fh: IO[str]) -> ConfigDict:
     """Parse libconfig text from a file-like object."""
     return loads(fh.read())
 

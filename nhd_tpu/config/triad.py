@@ -33,7 +33,6 @@ Expected config shape (all reference-format compatible):
 
 from __future__ import annotations
 
-import io
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
@@ -338,7 +337,10 @@ class TriadCfgParser(CfgParser):
         for c in self.top.misc_cores:
             path_set(self.cfg, c.name, c.core)
 
-        path_set(self.cfg, self.top.ctrl_vlan.name, self.top.ctrl_vlan.vlan)
+        if self.top.ctrl_vlan is not None:
+            path_set(
+                self.cfg, self.top.ctrl_vlan.name, self.top.ctrl_vlan.vlan
+            )
 
         for pg in self.top.proc_groups:
             if pg.vlan is not None:

@@ -14,9 +14,9 @@ Node.py:327-454) so the same NFD extras feed both systems.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from itertools import chain, count
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from nhd_tpu.core.topology import (
     GpuKind,
@@ -53,7 +53,7 @@ def parse_range_list(text: str) -> List[int]:
     """Parse Linux cpuset-style range lists: ``0-3,8,10-11`` → sorted ints
     (reference: Node.py:298-306)."""
 
-    def one(part: str):
+    def one(part: str) -> range:
         ends = part.split("-")
         return range(int(ends[0]), int(ends[-1]) + 1)
 
@@ -63,7 +63,9 @@ def parse_range_list(text: str) -> List[int]:
 _PACK_GEN_COUNTER = count(1)
 
 
-def pack_generation_key(node_objs, *extra) -> tuple:
+def pack_generation_key(
+    node_objs: "Iterable[HostNode]", *extra: object
+) -> tuple:
     """Cache key identifying a node list's packed-topology generation.
 
     _pack_state stamps a process-monotonic generation number on the node
@@ -103,7 +105,8 @@ class NodeCpuCore:
         self.socket = socket
         self.sibling = sibling  # logical id of the SMT sibling, -1 when SMT off
         self._used = used
-        self._arr = None  # owning node's packed used[] (indexed by .core)
+        # owning node's packed used[] (indexed by .core)
+        self._arr: Any = None
 
     @property
     def used(self) -> bool:
@@ -149,10 +152,10 @@ class NodeNic:
         self.port = port
         self.idx = -1   # per-NUMA-node ordinal, set after all NICs are read
         self.slot = -1  # position in HostNode.nics, set by _pack_state
-        self._speed_used = [0.0, 0.0]  # rx, tx (pre-pack fallback)
+        self._speed_used: List[float] = [0.0, 0.0]  # rx, tx (pre-pack fallback)
         self._pods_used = 0
-        self._bw = None    # owning node's packed [n_nics, 2] bandwidth
-        self._pods = None  # owning node's packed [n_nics] pods_used
+        self._bw: Any = None    # owning node's packed [n_nics, 2] bandwidth
+        self._pods: Any = None  # owning node's packed [n_nics] pods_used
 
     @property
     def speed_used(self):
@@ -160,7 +163,7 @@ class NodeNic:
         return self._speed_used if b is None else b[self.slot]
 
     @speed_used.setter
-    def speed_used(self, v) -> None:
+    def speed_used(self, v: Any) -> None:
         b = self._bw
         if b is None:
             self._speed_used = list(v)
@@ -223,7 +226,7 @@ class NodeGpu:
         self.pciesw = pciesw
         self.slot = -1  # position in HostNode.gpus, set by _pack_state
         self._used = used
-        self._arr = None
+        self._arr: Any = None
 
     @property
     def used(self) -> bool:
@@ -274,22 +277,22 @@ class HostNode:
         # the NodeCpuCore/NodeGpu/NodeNic properties, so batch projection
         # (solver/encode.py) and write-back (FastCluster.sync_to_nodes)
         # are vector ops
-        self._core_used = None   # [L] bool
-        self._core_socket = None  # [L] int8
-        self._gpu_used = None    # [n_gpus] bool
-        self._gpu_numa = None    # [n_gpus] int32
-        self._gpu_sw = None      # [n_gpus] int64 (raw pciesw)
-        self._gpu_devid = None   # [n_gpus] int32
-        self._nic_bw = None      # [n_nics, 2] float64 (rx, tx used)
-        self._nic_pods = None    # [n_nics] int32
-        self._nic_u = None       # [n_nics] int32 (numa_node)
-        self._nic_k = None       # [n_nics] int32 (per-NUMA ordinal)
-        self._nic_cap = None     # [n_nics] float64 (schedulable Gbps)
-        self._nic_sw = None      # [n_nics] int64 (raw pciesw)
+        self._core_used: Any = None   # [L] bool
+        self._core_socket: Any = None  # [L] int8
+        self._gpu_used: Any = None    # [n_gpus] bool
+        self._gpu_numa: Any = None    # [n_gpus] int32
+        self._gpu_sw: Any = None      # [n_gpus] int64 (raw pciesw)
+        self._gpu_devid: Any = None   # [n_gpus] int32
+        self._nic_bw: Any = None      # [n_nics, 2] float64 (rx, tx used)
+        self._nic_pods: Any = None    # [n_nics] int32
+        self._nic_u: Any = None       # [n_nics] int32 (numa_node)
+        self._nic_k: Any = None       # [n_nics] int32 (per-NUMA ordinal)
+        self._nic_cap: Any = None     # [n_nics] float64 (schedulable Gbps)
+        self._nic_sw: Any = None      # [n_nics] int64 (raw pciesw)
         self._n_switches = 0     # distinct PCIe switches on this node
-        self._gpu_sw_dense = None  # [n_gpus] int64 dense switch ids
-        self._nic_sw_dense = None  # [n_nics] int64 dense switch ids
-        self._nic_cnt = None     # [max_numa+1] int32 NICs per NUMA
+        self._gpu_sw_dense: Any = None  # [n_gpus] int64 dense switch ids
+        self._nic_sw_dense: Any = None  # [n_nics] int64 dense switch ids
+        self._nic_cnt: Any = None     # [max_numa+1] int32 NICs per NUMA
 
     # packed-topology generation (see pack_generation_key); 0 = never packed
     _pack_gen = 0
@@ -740,9 +743,9 @@ class HostNode:
         )
         self.pod_info.clear()
 
-    def _topology_core_ids(self, top: PodTopology):
+    def _topology_core_ids(self, top: PodTopology) -> List[int]:
         """Every physical core id a solved topology names."""
-        ids = []
+        ids: List[int] = []
         for pg in top.proc_groups:
             ids.extend(c.core for c in pg.misc_cores)
             ids.extend(c.core for c in pg.proc_cores)
@@ -835,7 +838,9 @@ class HostNode:
     # physical assignment
     # ------------------------------------------------------------------
 
-    def assign_physical_ids(self, mapping: Dict[str, tuple], top: PodTopology):
+    def assign_physical_ids(
+        self, mapping: Dict[str, tuple], top: PodTopology
+    ) -> List[Tuple[int, float, NicDir]]:
         """Turn a NUMA/NIC mapping from the matcher into concrete core, GPU,
         and NIC assignments, mutating both this node's state and ``top``
         (reference: Node.py:663-841).

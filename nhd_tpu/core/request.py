@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, fields
-from typing import FrozenSet, List, Tuple
+from typing import Any, FrozenSet, List, Tuple
 
 from nhd_tpu.core.topology import MapMode, PodTopology, SmtMode
 
@@ -20,7 +20,7 @@ from nhd_tpu.core.topology import MapMode, PodTopology, SmtMode
 _INTERN: dict = {}
 
 
-def _field_key(self) -> tuple:
+def _field_key(self: Any) -> tuple:
     """All dataclass fields, in declaration order — mechanically derived
     so hash and eq can never drift from the field set. Nested request
     dataclasses are replaced by their own (primitive) keys, so the result
@@ -46,7 +46,7 @@ def _field_key(self) -> tuple:
     return key
 
 
-def _cached_hash(self) -> int:
+def _cached_hash(self: Any) -> int:
     """Shared lazy hash-cache for the request dataclasses: the
     dataclass-generated __hash__ rebuilds the field tuple on every call,
     and the pod-dedupe dict (encode_pods) probes it for every pod of a
@@ -126,7 +126,7 @@ class PodRequest:
     _key = _field_key
     __hash__ = _cached_hash
 
-    def __eq__(self, other) -> bool:
+    def __eq__(self, other: object) -> bool:
         if self is other:
             return True
         if not isinstance(other, PodRequest):

@@ -18,11 +18,9 @@ from nhd_tpu.k8s.interface import (
     CFG_TYPE_ANNOTATION,
     GPU_MAP_ANNOTATION_PREFIX,
     GROUPS_ANNOTATION,
-    MAINTENANCE_LABEL,
     NAD_ANNOTATION,
     SCHEDULER_TAINT,
     ClusterBackend,
-    EventType,
     PodEvent,
     WatchEvent,
 )

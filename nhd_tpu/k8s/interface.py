@@ -23,7 +23,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 # Reference annotation/label/taint vocabulary (K8SMgr.py:139,160,182,496;
 # Node.py:108; TriadController.py:19-23)
-from nhd_tpu.core.node import MAINTENANCE_LABEL  # single source of truth
+from nhd_tpu.core.node import MAINTENANCE_LABEL  # noqa: F401 — re-export seam
 
 DOMAIN = "sigproc.viasat.io"
 CFG_ANNOTATION = f"{DOMAIN}/nhd_config"

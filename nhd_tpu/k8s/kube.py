@@ -26,7 +26,6 @@ from nhd_tpu.k8s.interface import (
     NAD_ANNOTATION,
     SCHEDULER_TAINT,
     ClusterBackend,
-    EventType,
     WatchEvent,
 )
 from nhd_tpu.utils import get_logger
@@ -72,7 +71,11 @@ class KubeClusterBackend(ClusterBackend):
         # routinely; an immediate retry loop would hammer it)
         self._watch_backoff = 1.0
         self._watch_stop = threading.Event()
-        self._watchers: List[object] = []  # live Watch objects, for stop
+        # registered Watch objects, for stop; appended by the watch
+        # threads while stop_watches may iterate from another thread, so
+        # all access goes through _watch_lock (nhdlint NHD201)
+        self._watch_lock = threading.Lock()
+        self._watchers: List[object] = []
         if start_watches:
             self._start_watches()
 
@@ -281,9 +284,19 @@ class KubeClusterBackend(ClusterBackend):
         threading.Thread(target=self._watch_pods, daemon=True).start()
         threading.Thread(target=self._watch_nodes, daemon=True).start()
 
+    def _register_watcher(self, w: object) -> None:
+        with self._watch_lock:
+            self._watchers.append(w)
+            stopping = self._watch_stop.is_set()
+        if stopping:
+            # stop_watches already swept the list; a watcher registering
+            # after its snapshot would never be stopped (leaked stream) —
+            # stop it here instead of racing the sweep
+            self._stop_watcher(w)
+
     def _watch_pods(self) -> None:
         w = self._watch_mod.Watch()
-        self._watchers.append(w)
+        self._register_watcher(w)
         while not self._watch_stop.is_set():
             try:
                 for ev in w.stream(self.v1.list_pod_for_all_namespaces):
@@ -312,7 +325,7 @@ class KubeClusterBackend(ClusterBackend):
     def _watch_nodes(self) -> None:
         last: Dict[str, tuple] = {}
         w = self._watch_mod.Watch()
-        self._watchers.append(w)
+        self._register_watcher(w)
         while not self._watch_stop.is_set():
             try:
                 for ev in w.stream(self.v1.list_node):
@@ -341,13 +354,20 @@ class KubeClusterBackend(ClusterBackend):
         """Stop watch threads: interrupt in-flight streams (Watch.stop
         closes the response to unblock the read) and prevent reconnects."""
         self._watch_stop.set()
-        for w in self._watchers:
-            stop = getattr(w, "stop", None)
-            if stop is not None:
-                try:
-                    stop()
-                except Exception:
-                    pass
+        with self._watch_lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            self._stop_watcher(w)
+
+    def _stop_watcher(self, w: object) -> None:
+        stop = getattr(w, "stop", None)
+        if stop is not None:
+            try:
+                stop()
+            except Exception as exc:
+                # keep stopping the rest; a watcher that fails to close
+                # is at worst a leaked connection on exit
+                self.logger.warning(f"watch stop failed: {exc}")
 
     def poll_watch_events(self, timeout: float = 0.0) -> Iterable[WatchEvent]:
         out = []

@@ -43,7 +43,7 @@ import ssl
 import types
 import urllib.error
 import urllib.request
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, Optional
 
 
 # ---------------------------------------------------------------------------
@@ -547,8 +547,8 @@ class Watch:
         finally:
             try:
                 resp.close()
-            except Exception:
-                pass
+            except Exception:  # nhdlint: ignore[NHD302]
+                pass  # best-effort close of an already-broken stream
             self._resp = None
 
     def stop(self) -> None:
@@ -556,8 +556,8 @@ class Watch:
         if self._resp is not None:
             try:
                 self._resp.close()
-            except Exception:
-                pass
+            except Exception:  # nhdlint: ignore[NHD302]
+                pass  # racing the reader's own close; either one wins
 
 
 # ---------------------------------------------------------------------------

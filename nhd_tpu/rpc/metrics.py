@@ -116,6 +116,12 @@ class MetricsServer(threading.Thread):
 
         self.server = ThreadingHTTPServer(("", port), Handler)
         self.port = self.server.server_address[1]
+        # _started gates stop(): HTTPServer.shutdown() blocks forever if
+        # serve_forever never entered its loop, and the old plain-bool
+        # handshake raced a stop() issued right after start()
+        self._started = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._stopped = False
 
     def _collect(self) -> str:
         nodes = ask_scheduler(self.mainq, RpcMsgType.NODE_INFO)
@@ -124,16 +130,22 @@ class MetricsServer(threading.Thread):
         return render_metrics(nodes, failed, perf)
 
     def run(self) -> None:
-        self._serving = True
+        self._started.set()
         self.logger.warning(f"metrics endpoint on :{self.port}/metrics")
         self.server.serve_forever()
 
     def stop(self) -> None:
         """Idempotent, and safe on a never-started server (shutdown() would
         otherwise block forever waiting for the serve loop)."""
-        if getattr(self, "_stopped", False):
-            return
-        self._stopped = True
-        if getattr(self, "_serving", False):
-            self.server.shutdown()
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        if self.is_alive() or self._started.is_set():
+            # the thread exists: wait for run() to reach serve_forever so
+            # shutdown() has a loop to stop (a stop() racing start() used
+            # to skip shutdown and leave the serve loop running forever)
+            self._started.wait(timeout=2.0)
+            if self._started.is_set():
+                self.server.shutdown()
         self.server.server_close()  # release the listening socket

@@ -18,7 +18,6 @@ from typing import Dict, Optional
 from nhd_tpu.k8s.interface import (
     CFG_ANNOTATION,
     CFG_TYPE_ANNOTATION,
-    MAINTENANCE_LABEL,
     SCHEDULER_TAINT,
     ClusterBackend,
     WatchEvent,

@@ -190,7 +190,12 @@ class GcPin:
             if token[1]:
                 gc.enable()
             gc.unfreeze()
-            cls.active = False
+            # under the same lock as acquire: the unlocked write published
+            # `active = False` with no happens-before edge to the gc calls
+            # above, so a racing acquire() could freeze/disable gc while
+            # this thread was still unfreezing (nhdlint NHD201 catch)
+            with cls._lock:
+                cls.active = False
 
 
 _GC_PIN_MIN_ITEMS = 4096
@@ -511,8 +516,9 @@ class BatchScheduler:
             counts_arr.copy_to_host_async()
             need_arr.copy_to_host_async()
             it_arr.copy_to_host_async()
-        except Exception:
-            pass  # backend without async host copies
+        except Exception:  # nhdlint: ignore[NHD302]
+            pass  # best-effort prefetch hint; backend without async host
+            #      copies just pays the full flush at the sync pull
         return SpecDispatch(
             bucket_keys, bucket_pods, claims_arr, counts_arr,
             need_arr, it_arr, certifiable,
@@ -1040,8 +1046,8 @@ class BatchScheduler:
             for G, pods, out in launched:
                 try:
                     out.copy_to_host_async()  # batch all bucket pulls
-                except Exception:
-                    pass
+                except Exception:  # nhdlint: ignore[NHD302]
+                    pass  # prefetch hint only; sync pull below still works
             for G, pods, out in launched:
                 # pull results to host in ONE transfer — the rank output
                 # is a single packed [9, Tp, R] tensor because each

@@ -30,7 +30,6 @@ import numpy as np
 
 from nhd_tpu.solver.encode import ClusterArrays
 from nhd_tpu.solver.kernel import (
-    RankOut,
     SolveOut,
     _get_ranker,
     _rank_body,

@@ -1,0 +1,13 @@
+# nhdlint fixture: same calls as solver/det_pos.py but OUTSIDE the
+# solver path — the determinism pack must stay silent here (sim/ seeds
+# its own generators and is allowed to roll dice).
+import random
+import time
+
+
+def pick(nodes):
+    return random.choice(nodes)
+
+
+def stamp():
+    return time.time()

@@ -1,0 +1,52 @@
+# nhdlint fixture: exception handling that must NOT be flagged.
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def risky():
+    raise ValueError("x")
+
+
+def narrow():
+    try:
+        risky()
+    except ValueError:
+        pass              # narrow type: caller chose what to ignore
+
+
+def logs():
+    try:
+        risky()
+    except Exception as exc:
+        logger.error(f"risky failed: {exc}")
+
+
+def reraises():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def returns_sentinel():
+    try:
+        risky()
+    except Exception:
+        return False      # the caller observes the failure
+    return True
+
+
+def records_state(out):
+    try:
+        risky()
+    except Exception as exc:
+        out["error"] = str(exc)
+
+
+def breaks_out():
+    while True:
+        try:
+            risky()
+        except Exception:
+            break

@@ -1,0 +1,41 @@
+# nhdlint fixture: exception-hygiene violations.
+
+
+def risky():
+    raise ValueError("x")
+
+
+def bare():
+    try:
+        risky()
+    except:  # EXPECT[NHD301]
+        pass
+
+
+def swallow_pass():
+    try:
+        risky()
+    except Exception:  # EXPECT[NHD302]
+        pass
+
+
+def swallow_continue(items):
+    for _ in items:
+        try:
+            risky()
+        except Exception:  # EXPECT[NHD302]
+            continue
+
+
+def swallow_tuple():
+    try:
+        risky()
+    except (ValueError, Exception):  # EXPECT[NHD302]
+        pass
+
+
+def swallow_baseexception():
+    try:
+        risky()
+    except BaseException:  # EXPECT[NHD302]
+        pass

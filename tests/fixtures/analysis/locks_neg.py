@@ -1,0 +1,62 @@
+# nhdlint fixture: lock patterns that must NOT be flagged.
+import threading
+
+
+class SingleWriter:
+    """Owns no lock: the single-writer pattern is out of the pack's
+    scope by design (scheduler/core.py)."""
+
+    def __init__(self):
+        self.state = {}
+
+    def mutate(self):
+        self.state["k"] = 1
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []   # __init__ runs before publication: fine
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def swap(self):
+        with self._lock:
+            self.items = []
+
+    def read(self):
+        return len(self.items)   # reads are never flagged
+
+
+class UnguardedAttrs:
+    """Owns a lock but never mutates 'hits' under it — 'hits' is not
+    inferred as guarded, so plain writes stay legal."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.guarded = 0
+        self.hits = 0
+
+    def inc(self):
+        with self._lock:
+            self.guarded += 1
+
+    def bump(self):
+        self.hits += 1
+
+
+class NestedDefNotHeld:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def work(self):
+        with self._lock:
+            self.n += 1
+
+            def cb():
+                return None
+
+            return cb

@@ -1,0 +1,60 @@
+# nhdlint fixture: lock-discipline violations.
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.count = 0
+        self.table = {}
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+            self.count += 1
+            self.table["n"] = self.count
+
+    def sneaky_assign(self):
+        self.count = 0  # EXPECT[NHD201]
+
+    def sneaky_mutate(self, x):
+        self.items.append(x)  # EXPECT[NHD201]
+
+    def sneaky_subscript(self):
+        self.table["n"] = -1  # EXPECT[NHD201]
+
+    def manual_acquire(self):
+        self._lock.acquire()  # EXPECT[NHD202]
+        try:
+            self.count += 1  # EXPECT[NHD201] — acquire() isn't modeled
+        finally:
+            self._lock.release()
+
+
+class ClassLevelLock:
+    _lock = threading.Lock()
+    active = False
+
+    @classmethod
+    def set_on(cls):
+        with cls._lock:
+            cls.active = True
+
+    @classmethod
+    def set_off(cls):
+        cls.active = False  # EXPECT[NHD201]
+
+
+class ConditionAlias:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._queue = []
+
+    def put(self, x):
+        with self._cv:
+            self._queue.append(x)
+
+    def bad_put(self, x):
+        self._queue.append(x)  # EXPECT[NHD201]

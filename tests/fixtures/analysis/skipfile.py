@@ -1,0 +1,8 @@
+# nhdlint: skip-file — generated-style file, opted out wholesale.
+
+
+def swallow():
+    try:
+        raise ValueError("x")
+    except Exception:
+        pass

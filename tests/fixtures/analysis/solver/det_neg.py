@@ -1,0 +1,26 @@
+# nhdlint fixture: determinism patterns that must NOT be flagged, inside
+# the solver scope.
+import time
+
+import numpy as np
+from random import Random
+from numpy.random import default_rng
+
+
+def seeded_constructors(seed):
+    # the rule's own recommended remedy: explicit seeded generators
+    return Random(seed).random() + default_rng(seed).random()
+
+
+def durations():
+    # monotonic clocks measure, they don't decide
+    return time.monotonic() + time.perf_counter()
+
+
+def seeded():
+    rng = np.random.default_rng(42)   # explicit seeded generator
+    return rng.random()
+
+
+def caller_passed(now):
+    return now + 1.0

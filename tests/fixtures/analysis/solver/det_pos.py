@@ -1,0 +1,28 @@
+# nhdlint fixture: determinism violations. Lives under a 'solver/'
+# directory because the pack is path-scoped to solver/encode code.
+import datetime
+import random
+import time
+
+import numpy as np
+from random import shuffle
+
+
+def pick(nodes):
+    return random.choice(nodes)  # EXPECT[NHD401]
+
+
+def jitter():
+    return np.random.rand()  # EXPECT[NHD401]
+
+
+def mix(items):
+    shuffle(items)  # EXPECT[NHD401]
+
+
+def stamp():
+    return time.time()  # EXPECT[NHD402]
+
+
+def stamp_dt():
+    return datetime.datetime.now()  # EXPECT[NHD402]

@@ -1,0 +1,27 @@
+# nhdlint fixture: every violation here carries an inline suppression —
+# the analyzer must report zero findings and count the suppressions.
+
+
+def risky():
+    raise ValueError("x")
+
+
+def swallow_suppressed():
+    try:
+        risky()
+    except Exception:  # nhdlint: ignore[NHD302]
+        pass
+
+
+def bare_suppressed_all_rules():
+    try:
+        risky()
+    except:  # nhdlint: ignore
+        pass
+
+
+def swallow_wrong_rule_listed():
+    try:
+        risky()
+    except Exception:  # nhdlint: ignore[NHD301]
+        pass  # suppresses the WRONG rule: NHD302 must still fire here

@@ -1,0 +1,40 @@
+# nhdlint fixture: tracing-pack patterns that must NOT be flagged.
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import lru_cache
+
+
+def plain_host_function(x):
+    # not jit-reachable: host coercion and branching are fine here
+    if x > 0:
+        return int(x)
+    return np.asarray(x)
+
+
+@jax.jit
+def good(x, y):
+    n = x.shape[0]         # shapes are static under trace
+    if n > 4:
+        y = y + 1
+    m = int(x.shape[1])    # coercing a static shape is fine
+    k = len(y.shape)
+    z = jnp.asarray(y)     # jnp stays in the program
+    return z * m * k
+
+
+@lru_cache(maxsize=None)
+def get_solver(shape):
+    # the repo idiom: one cached wrapper per bucket shape
+    def fn(v):
+        return jnp.sum(v)
+
+    return jax.jit(fn)
+
+
+def hashable_statics(data, cfg=(1, 2)):
+    return data
+
+
+jitted = jax.jit(hashable_statics, static_argnames="cfg")
+

@@ -1,0 +1,56 @@
+# nhdlint fixture: every tracing-pack hazard, one per line.
+# Flagged lines carry EXPECT markers the fixture tests parse; this file
+# is analyzed as text only, never imported.
+import jax
+import numpy as np
+from functools import partial
+
+
+def kernel(x, y):
+    if x > 0:  # EXPECT[NHD102]
+        y = y + 1
+    n = int(x)  # EXPECT[NHD101]
+    z = np.asarray(y)  # EXPECT[NHD103]
+    while y:  # EXPECT[NHD102]
+        break
+    assert x  # EXPECT[NHD102]
+    return z + n
+
+
+solver = jax.jit(kernel)  # marks kernel as jit-traced
+
+
+@jax.jit
+def decorated(a):
+    b = a * 2
+    return float(b)  # EXPECT[NHD101]
+
+
+def helper(c):
+    return bool(c)  # EXPECT[NHD101] — traced via the chained() call graph
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def chained(c):
+    return helper(c)
+
+
+def make_solver(shape):
+    def fn(v):
+        return v * 2
+
+    return jax.jit(fn)  # EXPECT[NHD104] — fresh wrapper per call
+
+
+def looper(fns):
+    out = []
+    for f in fns:
+        out.append(jax.jit(f))  # EXPECT[NHD104] — jit inside a loop
+    return out
+
+
+def statics(data, cfg=[1, 2]):
+    return data
+
+
+jitted = jax.jit(statics, static_argnames="cfg")  # EXPECT[NHD105]

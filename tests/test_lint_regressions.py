@@ -1,0 +1,174 @@
+"""Regression tests for the real defects the nhdlint rule packs surfaced
+(docs/STATIC_ANALYSIS.md "findings fixed in this PR"):
+
+* GcPin.release published ``active = False`` outside the acquire lock
+  (solver/batch.py, NHD201) — a racing acquire could freeze/disable gc
+  while the releasing thread was still unfreezing;
+* KubeClusterBackend registered Watch objects from watch threads with no
+  lock, and a watcher registering after stop_watches() swept the list was
+  never stopped (leaked stream); a watcher whose stop() raised aborted
+  the sweep for every later watcher (k8s/kube.py, NHD201/NHD302);
+* MetricsServer.stop() raced start(): the plain-bool handshake could skip
+  shutdown() and leave the serve loop running forever (rpc/metrics.py).
+"""
+
+from __future__ import annotations
+
+import gc
+import queue
+import threading
+
+import pytest
+
+from nhd_tpu.solver.batch import GcPin
+
+
+# ---------------------------------------------------------------------------
+# GcPin
+# ---------------------------------------------------------------------------
+
+def test_gcpin_concurrent_acquire_release_leaves_gc_consistent():
+    """Hammer acquire/release from many threads: afterwards the pin must
+    be free, gc enabled, and a fresh acquire must succeed."""
+    assert gc.isenabled(), "test precondition"
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(200):
+                token = GcPin.acquire()
+                GcPin.release(token)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert not GcPin.active
+    assert gc.isenabled()
+    token = GcPin.acquire()
+    try:
+        assert token is not None
+    finally:
+        GcPin.release(token)
+    assert gc.isenabled()
+
+
+# ---------------------------------------------------------------------------
+# KubeClusterBackend watcher registration
+# ---------------------------------------------------------------------------
+
+class _FakeWatcher:
+    def __init__(self, raise_on_stop: bool = False):
+        self.stopped = False
+        self.raise_on_stop = raise_on_stop
+
+    def stop(self):
+        self.stopped = True
+        if self.raise_on_stop:
+            raise RuntimeError("boom")
+
+
+def _bare_backend():
+    """A KubeClusterBackend with only the watch-plane attributes — the
+    constructor needs a live API server, which these tests don't."""
+    from nhd_tpu.k8s.kube import KubeClusterBackend
+    from nhd_tpu.utils import get_logger
+
+    be = KubeClusterBackend.__new__(KubeClusterBackend)
+    be.logger = get_logger("test-kube-watch")
+    be._watch_lock = threading.Lock()
+    be._watchers = []
+    be._watch_stop = threading.Event()
+    return be
+
+
+def test_watcher_registered_after_stop_is_stopped_immediately():
+    be = _bare_backend()
+    be.stop_watches()
+    late = _FakeWatcher()
+    be._register_watcher(late)
+    assert late.stopped, (
+        "a watcher registering after stop_watches' sweep must be stopped "
+        "at registration, not leaked"
+    )
+
+
+def test_stop_watches_survives_a_raising_watcher():
+    be = _bare_backend()
+    first = _FakeWatcher(raise_on_stop=True)
+    second = _FakeWatcher()
+    be._register_watcher(first)
+    be._register_watcher(second)
+    be.stop_watches()
+    assert first.stopped and second.stopped, (
+        "one watcher's stop() raising must not abort the sweep"
+    )
+
+
+def test_concurrent_registration_and_stop_is_safe():
+    be = _bare_backend()
+    watchers = [_FakeWatcher() for _ in range(64)]
+    start = threading.Barrier(3)
+
+    def register(chunk):
+        start.wait()
+        for w in chunk:
+            be._register_watcher(w)
+
+    t1 = threading.Thread(target=register, args=(watchers[:32],))
+    t2 = threading.Thread(target=register, args=(watchers[32:],))
+    t1.start()
+    t2.start()
+    start.wait()
+    be.stop_watches()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    # every watcher is stopped regardless of which side of the sweep's
+    # snapshot it registered on
+    assert all(w.stopped for w in watchers)
+
+
+# ---------------------------------------------------------------------------
+# MetricsServer stop/start race
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("attempt", range(5))
+def test_metrics_stop_immediately_after_start(attempt):
+    from nhd_tpu.rpc.metrics import MetricsServer
+
+    server = MetricsServer(queue.Queue(), port=0)
+    server.start()
+    server.stop()   # may land before run() reaches serve_forever
+    server.join(timeout=5)
+    assert not server.is_alive(), (
+        "stop() racing start() must still shut the serve loop down"
+    )
+
+
+def test_metrics_stop_without_start_releases_port():
+    from nhd_tpu.rpc.metrics import MetricsServer
+
+    server = MetricsServer(queue.Queue(), port=0)
+    port = server.port
+    server.stop()   # never started: must not hang in shutdown()
+    # port is free again: a new server can bind it
+    server2 = MetricsServer(queue.Queue(), port=port)
+    server2.stop()
+
+
+def test_metrics_stop_idempotent_under_concurrency():
+    from nhd_tpu.rpc.metrics import MetricsServer
+
+    server = MetricsServer(queue.Queue(), port=0)
+    server.start()
+    threads = [threading.Thread(target=server.stop) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    server.join(timeout=5)
+    assert not server.is_alive()

@@ -1,0 +1,456 @@
+"""nhdlint: fixture tests per rule pack + the tier-1 gate.
+
+The gate test at the bottom runs all four packs over ``nhd_tpu/`` and
+fails on any unsuppressed, unbaselined finding — a recompile hazard or
+off-lock mutation introduced by a future PR fails ``pytest`` the same as
+a broken unit test.
+
+Fixture files under tests/fixtures/analysis/ carry ``# EXPECT[RULE]``
+markers on each line that must be flagged; the tests compare the exact
+(rule, line) sets so a rule that drifts off its line, double-reports, or
+goes silent is caught here.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from nhd_tpu.analysis import (
+    PACKS,
+    RULES,
+    analyze_file,
+    analyze_paths,
+    load_baseline,
+    subtract_baseline,
+    write_baseline,
+)
+from nhd_tpu.analysis.cli import main as cli_main
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
+
+_EXPECT = re.compile(r"#\s*EXPECT\[([A-Z0-9,\s]+)\]")
+
+
+def expected_of(path: Path) -> set:
+    out = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        m = _EXPECT.search(line)
+        if m:
+            for rule in m.group(1).split(","):
+                out.add((rule.strip(), lineno))
+    return out
+
+
+def found_of(path: Path, packs=None) -> set:
+    report = analyze_file(path, packs)
+    return {(f.rule, f.line) for f in report.findings}
+
+
+# ---------------------------------------------------------------------------
+# per-pack fixtures: exact rule ids at exact lines
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,packs", [
+    ("tracing_pos.py", ["tracing"]),
+    ("tracing_neg.py", ["tracing"]),
+    ("locks_pos.py", ["locks"]),
+    ("locks_neg.py", ["locks"]),
+    ("excepts_pos.py", ["excepts"]),
+    ("excepts_neg.py", ["excepts"]),
+    ("solver/det_pos.py", ["determinism"]),
+    ("solver/det_neg.py", ["determinism"]),
+    ("det_out_of_scope.py", ["determinism"]),
+])
+def test_fixture_exact_findings(name, packs):
+    path = FIXTURES / name
+    assert found_of(path, packs) == expected_of(path)
+
+
+def test_fixtures_have_positive_coverage_for_every_pack():
+    """Every rule pack has at least one deliberately injected violation
+    that its fixture catches (the acceptance-criteria clause)."""
+    seen_packs = set()
+    for name in ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
+                 "solver/det_pos.py"):
+        for rule, _ in expected_of(FIXTURES / name):
+            seen_packs.add(RULES[rule][0])
+    assert seen_packs == set(PACKS)
+
+
+def test_all_rule_ids_in_fixtures_are_registered():
+    for name in ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
+                 "solver/det_pos.py"):
+        for rule, _ in expected_of(FIXTURES / name):
+            assert rule in RULES
+
+
+# ---------------------------------------------------------------------------
+# suppression + skip-file behavior
+# ---------------------------------------------------------------------------
+
+def test_inline_suppressions():
+    report = analyze_file(FIXTURES / "suppress.py", ["excepts"])
+    # the file holds three violations: two properly suppressed (one by
+    # rule id, one blanket), one whose directive lists the WRONG rule
+    assert report.suppressed == 2
+    assert [(f.rule) for f in report.findings] == ["NHD302"]
+
+
+def test_wrong_rule_suppression_is_reported_unused():
+    report = analyze_file(FIXTURES / "suppress.py", ["excepts"])
+    # the ignore[NHD301] on the NHD302 line suppressed nothing
+    assert len(report.unused_ignores) == 1
+
+
+def test_unused_ignores_not_reported_for_packs_that_did_not_run(tmp_path):
+    """A --packs subset must not tell people to delete suppressions that
+    are load-bearing for the full run."""
+    p = tmp_path / "cross_pack.py"
+    p.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:  # nhdlint: ignore[NHD302]\n"
+        "        pass\n"
+    )
+    # excepts did not run: the NHD302 directive is unjudgeable, not unused
+    assert analyze_file(p, ["locks"]).unused_ignores == []
+    # excepts ran and the directive suppressed its finding: used
+    assert analyze_file(p, ["excepts"]).unused_ignores == []
+    # bare 'ignore' is judgeable only by a full-pack run
+    q = tmp_path / "bare.py"
+    q.write_text("x = 1  # nhdlint: ignore\n")
+    assert analyze_file(q, ["locks"]).unused_ignores == []
+    assert analyze_file(q).unused_ignores == [1]
+
+
+def test_skip_file():
+    report = analyze_file(FIXTURES / "skipfile.py")
+    assert report.skipped
+    assert report.findings == []
+
+
+def test_skip_file_not_honored_mid_file(tmp_path):
+    p = tmp_path / "late_skip.py"
+    p.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "# nhdlint: skip-file\n"
+    )
+    report = analyze_file(p, ["excepts"])
+    assert not report.skipped
+    assert [f.rule for f in report.findings] == ["NHD302"]
+
+
+def test_directive_inside_docstring_is_not_honored(tmp_path):
+    """Only real comments carry directives: documenting the syntax in a
+    docstring must not opt the file (or a line) out of analysis."""
+    p = tmp_path / "doc.py"
+    p.write_text(
+        '"""Usage: put \'# nhdlint: skip-file\' at the top.\n'
+        "\n"
+        "Or suppress one line:  # nhdlint: ignore[NHD302]\n"
+        '"""\n'
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    report = analyze_file(p, ["excepts"])
+    assert not report.skipped
+    assert [f.rule for f in report.findings] == ["NHD302"]
+
+
+def test_fingerprint_distinguishes_same_basename(tmp_path):
+    """Baseline slots must not be shared between same-named files in
+    different directories."""
+    body = (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "util.py").write_text(body)
+    (tmp_path / "b" / "util.py").write_text(body)
+    fa = analyze_file(tmp_path / "a" / "util.py", ["excepts"]).findings
+    fb = analyze_file(tmp_path / "b" / "util.py", ["excepts"]).findings
+    assert fa[0].fingerprint() != fb[0].fingerprint()
+    # baselining a/util.py must not cover b/util.py
+    bl = tmp_path / "bl.json"
+    write_baseline(fa, bl)
+    new, baselined = subtract_baseline(fb, load_baseline(bl))
+    assert baselined == 0 and len(new) == 1
+
+
+def test_fingerprint_agrees_between_relative_and_absolute_paths(tmp_path):
+    p = tmp_path / "pkg" / "mod.py"
+    p.parent.mkdir()
+    p.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    import os
+    cwd = os.getcwd()
+    try:
+        os.chdir(tmp_path)
+        rel = analyze_file(Path("pkg") / "mod.py", ["excepts"]).findings
+    finally:
+        os.chdir(cwd)
+    abs_ = analyze_file(p, ["excepts"]).findings
+    assert rel[0].fingerprint() == abs_[0].fingerprint()
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    report = analyze_file(p)
+    assert [f.rule for f in report.findings] == ["NHD000"]
+
+
+def test_skip_file_in_string_does_not_hide_syntax_error(tmp_path):
+    """Even in the tokenize-fallback path (unterminated construct), a
+    directive inside a string literal must not suppress NHD000."""
+    p = tmp_path / "broken_with_string.py"
+    p.write_text(
+        'HELP = "use nhdlint: skip-file to opt out"\n'
+        "def f(:\n"
+    )
+    report = analyze_file(p)
+    assert not report.skipped
+    assert [f.rule for f in report.findings] == ["NHD000"]
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    findings = [
+        f for r in [analyze_file(FIXTURES / "excepts_pos.py", ["excepts"])]
+        for f in r.findings
+    ]
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(findings, bl_path)
+    baseline = load_baseline(bl_path)
+    new, baselined = subtract_baseline(findings, baseline)
+    assert new == [] and baselined == len(findings)
+
+
+def test_baseline_survives_line_shift(tmp_path):
+    src = (FIXTURES / "excepts_pos.py").read_text()
+    p = tmp_path / "shifted.py"
+    p.write_text(src)
+    findings = analyze_file(p, ["excepts"]).findings
+    bl = tmp_path / "baseline.json"
+    write_baseline(findings, bl)
+    # shift every finding down two lines: fingerprints must still match
+    p.write_text("# pad\n# pad\n" + src)
+    shifted = analyze_file(p, ["excepts"]).findings
+    new, baselined = subtract_baseline(shifted, load_baseline(bl))
+    assert new == [] and baselined == len(findings)
+
+
+def test_baseline_does_not_cover_edited_lines(tmp_path):
+    p = tmp_path / "edited.py"
+    p.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    findings = analyze_file(p, ["excepts"]).findings
+    bl = tmp_path / "baseline.json"
+    write_baseline(findings, bl)
+    # a *different* offending line is a new finding, not grandfathered
+    p.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except (ValueError, Exception):\n"
+        "        pass\n"
+    )
+    new, baselined = subtract_baseline(
+        analyze_file(p, ["excepts"]).findings, load_baseline(bl)
+    )
+    assert baselined == 0 and len(new) == 1
+
+
+def test_baseline_multiplicity(tmp_path):
+    """Two identical offending lines consume two baseline slots; a third
+    identical new one is NOT covered."""
+    body = (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    p = tmp_path / "multi.py"
+    p.write_text(body * 2)
+    bl = tmp_path / "baseline.json"
+    write_baseline(analyze_file(p, ["excepts"]).findings, bl)
+    p.write_text(body * 3)
+    new, baselined = subtract_baseline(
+        analyze_file(p, ["excepts"]).findings, load_baseline(bl)
+    )
+    assert baselined == 2 and len(new) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_json_output_and_exit_code(tmp_path, capsys):
+    rc = cli_main([str(FIXTURES / "excepts_pos.py"), "--format", "json",
+                   "--no-baseline", "--packs", "excepts"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["files"] == 1
+    rules = {f["rule"] for f in out["findings"]}
+    assert rules == {"NHD301", "NHD302"}
+    for f in out["findings"]:
+        assert set(f) >= {"rule", "path", "line", "col", "message",
+                          "snippet", "fingerprint"}
+
+
+def test_cli_clean_exit_zero(capsys):
+    rc = cli_main([str(FIXTURES / "excepts_neg.py"), "--no-baseline",
+                   "--packs", "excepts"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_write_then_use_baseline(tmp_path, capsys):
+    target = str(FIXTURES / "excepts_pos.py")
+    bl = str(tmp_path / "bl.json")
+    assert cli_main([target, "--baseline", bl, "--write-baseline"]) == 0
+    capsys.readouterr()
+    rc = cli_main([target, "--baseline", bl])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "baselined" in out
+
+
+def test_cli_write_baseline_refuses_pack_subset(tmp_path, capsys):
+    """A subset write would drop every other pack's grandfathered
+    entries from the baseline file."""
+    rc = cli_main([str(FIXTURES / "excepts_pos.py"), "--packs", "excepts",
+                   "--baseline", str(tmp_path / "bl.json"),
+                   "--write-baseline"])
+    assert rc == 2
+    assert "requires all packs" in capsys.readouterr().err
+    assert not (tmp_path / "bl.json").exists()
+
+
+def test_cli_unknown_pack_is_usage_error(capsys):
+    assert cli_main(["--packs", "nope"]) == 2
+
+
+def test_cli_no_matching_files_is_usage_error(tmp_path, capsys):
+    """A path typo must not read as 'clean' — that would silently turn
+    the lint tier off in make lint / CI."""
+    assert cli_main([str(tmp_path / "no_such_pkg")]) == 2
+    assert "no Python files" in capsys.readouterr().err
+
+
+def test_cli_reports_unused_ignores(capsys):
+    rc = cli_main([str(FIXTURES / "suppress.py"), "--no-baseline",
+                   "--packs", "excepts"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unused 'nhdlint: ignore' directive" in out
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+def test_module_entrypoint_runs_without_jax():
+    """`python -m nhd_tpu.analysis` must stay stdlib-only so the gate can
+    run in environments without the jax stack installed."""
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None  # poison: import jax -> TypeError\n"
+        "sys.modules['numpy'] = None\n"
+        "from nhd_tpu.analysis.cli import main\n"
+        "raise SystemExit(main(['--list-rules']))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_gate_nhd_tpu_is_clean():
+    """All four packs over the whole package: any new unsuppressed,
+    unbaselined finding fails tier-1. To grandfather an existing finding
+    deliberately, run:  python -m nhd_tpu.analysis nhd_tpu --write-baseline
+    (see docs/STATIC_ANALYSIS.md for when that is acceptable)."""
+    reports = analyze_paths([REPO / "nhd_tpu"])
+    # a refactor that points the gate at an empty/renamed dir must not
+    # pass vacuously
+    assert len(reports) > 40
+    findings = [f for r in reports for f in r.findings]
+    baseline = load_baseline(REPO / ".nhdlint-baseline.json")
+    new, _ = subtract_baseline(findings, baseline)
+    assert not new, (
+        "nhdlint found new unsuppressed issues:\n" + "\n".join(
+            f"  {f.path}:{f.line}: {f.rule} {f.message}" for f in new
+        )
+    )
+
+
+def _tool_available(mod: str) -> bool:
+    import importlib.util
+    return importlib.util.find_spec(mod) is not None
+
+
+@pytest.mark.skipif(not _tool_available("ruff"), reason="ruff not installed")
+def test_ruff_clean():
+    """Second-tier lint (pycodestyle/pyflakes/bugbear subset, configured
+    in pyproject.toml) — enforced wherever ruff is installed."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ruff", "check", "nhd_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.skipif(not _tool_available("mypy"), reason="mypy not installed")
+def test_mypy_clean():
+    """Scoped mypy (nhd_tpu/core + nhd_tpu/config, configured in
+    pyproject.toml) — enforced wherever mypy is installed."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
