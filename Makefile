@@ -6,8 +6,10 @@ IMAGE     ?= nhd-tpu
 VERSION   ?= $(shell python -c "import tomllib;print(tomllib.load(open('pyproject.toml','rb'))['project']['version'])")
 SOAK_SEEDS ?= 100
 SOAK_STEPS ?= 120
+CHAOS_SEEDS ?= 6
+CHAOS_STEPS ?= 60
 
-.PHONY: test lint proto bench wheel clean native soak docker docker-smoke release
+.PHONY: test lint proto bench wheel clean native soak chaos docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -54,6 +56,11 @@ wheel:
 # "100+ seeds soaked clean" (CI runs the 4-seed subset in tests/test_chaos.py)
 soak:
 	python tools/soak.py --seeds $(SOAK_SEEDS) --steps $(SOAK_STEPS)
+
+# fault-storm matrix: chaos WITH API-layer fault injection, seeds x
+# profiles (docs/RESILIENCE.md; CI runs the fast cell in tests/test_faults.py)
+chaos:
+	python tools/chaos_storm.py --seeds $(CHAOS_SEEDS) --steps $(CHAOS_STEPS)
 
 # container image + in-container smoke test (reference: Makefile:244-252;
 # no registry push here — zero-egress environment, tag locally instead)

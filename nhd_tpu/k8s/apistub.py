@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlparse
@@ -163,6 +164,14 @@ class _Handler(BaseHTTPRequestHandler):
         if "watch=true" in (url.query or ""):
             return self._stream_watch(url.path)
         with srv.lock:
+            if srv.fail_gets > 0:
+                # transient-fault injection: the next N non-watch GETs
+                # answer 5xx (exercises the retry policy over the wire).
+                # Checked AFTER the watch dispatch so a concurrent watch
+                # reconnect can't silently eat the injected budget
+                srv.fail_gets -= 1
+                return self._send_json(503, _status(503, "ServiceUnavailable"))
+        with srv.lock:
             # /api/v1/nodes[/name]
             if parts[:3] == ["api", "v1", "nodes"]:
                 if len(parts) == 3:
@@ -216,15 +225,31 @@ class _Handler(BaseHTTPRequestHandler):
             srv.watch_connects[path] = srv.watch_connects.get(path, 0) + 1
             pending = srv.watch_events.get(path, [])
             srv.watch_events[path] = []
+            hang = srv.watch_hang
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
-        for ev in pending:
-            line = json.dumps(ev).encode() + b"\n"
-            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
-        # terminal chunk: server closes the stream, client must reconnect
-        self.wfile.write(b"0\r\n\r\n")
+        try:
+            for ev in pending:
+                # raw entries (queue_watch_raw) go on the wire verbatim —
+                # malformed-line fault injection
+                line = (
+                    ev if isinstance(ev, bytes)
+                    else json.dumps(ev).encode() + b"\n"
+                )
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            if hang:
+                # dead-socket simulation: stream stays open, silent — the
+                # client's finite read timeout must end it (in slices so
+                # stop() doesn't wait the full hang out)
+                deadline = time.monotonic() + hang
+                while time.monotonic() < deadline and not srv.closing:
+                    time.sleep(0.05)
+            # terminal chunk: server closes the stream, client reconnects
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass  # client gave up mid-stream (e.g. its read timed out)
         self.close_connection = True
 
     def do_PATCH(self) -> None:  # noqa: N802
@@ -329,14 +354,20 @@ class StubApiServer:
         self.watch_connects: Dict[str, int] = {}
         self.fail_patches = False
         self.fail_bindings = False
+        self.fail_gets = 0      # next N GETs answer 503 (retry testing)
+        self.watch_hang = 0.0   # seconds a watch stream stays open, silent
+        self.closing = False
         self.token = token
         self.lock = threading.RLock()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
         # the handler reads ALL state through this one reference, so
         # post-construction mutation of any stub attribute just works
         self._httpd.stub = self
+        # short poll so stop() returns promptly (the default 0.5 s poll
+        # costs every stub-based test its teardown)
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True,
         )
 
     @property
@@ -348,6 +379,7 @@ class StubApiServer:
         return self
 
     def stop(self) -> None:
+        self.closing = True  # unblocks any hanging watch handler
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
@@ -404,3 +436,9 @@ class StubApiServer:
             self.watch_events.setdefault(path, []).append(
                 {"type": ev_type, "object": obj}
             )
+
+    def queue_watch_raw(self, path: str, raw: bytes) -> None:
+        """Queue raw bytes as one watch line — malformed-line injection
+        (a garbled chunk as the client would see it after a mid-cut)."""
+        with self.lock:
+            self.watch_events.setdefault(path, []).append(raw)

@@ -39,6 +39,18 @@ class EventType(Enum):
     WARNING = "Warning"
 
 
+class TransientBackendError(Exception):
+    """A backend write failed for a *retryable* reason (429/5xx/network)
+    after the in-call retry budget was spent.
+
+    Distinct from a ``False`` return (terminal failure: the request is
+    wrong, e.g. 409 on a bind) so the scheduler can requeue the pod for
+    another pass instead of marking it failed (scheduler/core.py commit
+    path; docs/RESILIENCE.md). Raised by KubeClusterBackend when the
+    retry policy gives up on a retryable error, and by the fault-injection
+    shim (sim/faults.py) to simulate exactly that."""
+
+
 @dataclass
 class PodEvent:
     """A recorded scheduling event (reference: K8SMgr.py:518-559)."""

@@ -14,6 +14,7 @@ the controller — same information, no framework dependency.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -26,15 +27,35 @@ from nhd_tpu.k8s.interface import (
     NAD_ANNOTATION,
     SCHEDULER_TAINT,
     ClusterBackend,
+    TransientBackendError,
     WatchEvent,
 )
+from nhd_tpu.k8s.retry import API_COUNTERS, RetryPolicy, RetryingApi, retryable
 from nhd_tpu.utils import get_logger
+
+# Periodic full-relist resync cadence (seconds; 0 disables). A dropped
+# watch event — queue overflow, proxy hiccup, the etcd compaction window —
+# would otherwise leave the backend stale FOREVER; the resync diffs a full
+# list against watch-derived state and emits synthetic events for anything
+# missed (docs/RESILIENCE.md).
+_RESYNC_DEFAULT_SEC = float(os.environ.get("NHD_RESYNC_SEC", "300"))
+
+# last-seen pod snapshot: (uid, annotations, scheduler_name, node) — what a
+# synthetic delete event must carry after the object is gone
+_PodSnap = Tuple[str, Dict[str, str], str, str]
 
 
 class KubeClusterBackend(ClusterBackend):
     """kubernetes-client implementation (reference: K8SMgr.py)."""
 
-    def __init__(self, start_watches: bool = True):
+    def __init__(
+        self,
+        start_watches: bool = True,
+        *,
+        retry_policy: Optional[RetryPolicy] = None,
+        resync_interval: Optional[float] = None,
+    ):
+        using_restclient = False
         try:
             import kubernetes  # noqa: F401
             from kubernetes import client, config, watch
@@ -47,6 +68,7 @@ class KubeClusterBackend(ClusterBackend):
             client = restclient.client
             config = restclient.config
             watch = restclient.watch
+            using_restclient = True
 
         self.logger = get_logger(__name__)
         self._client = client
@@ -64,8 +86,14 @@ class KubeClusterBackend(ClusterBackend):
                     "API server to talk to — use FakeClusterBackend for "
                     f"hermetic runs ({exc})"
                 ) from exc
-        self.v1 = client.CoreV1Api()
-        self.crd = client.CustomObjectsApi()
+        # every non-watch call runs under the retry policy (transient
+        # 429/5xx/network faults never surface to the scheduler); watch
+        # establishment passes through — the reconnect loop below owns it
+        self._retry = retry_policy or RetryPolicy(
+            exc_class=client.exceptions.ApiException
+        )
+        self.v1 = RetryingApi(client.CoreV1Api(), self._retry)
+        self.crd = RetryingApi(client.CustomObjectsApi(), self._retry)
         self._events: "queue.Queue[WatchEvent]" = queue.Queue()
         # pause between watch reconnects (the API server ends streams
         # routinely; an immediate retry loop would hammer it)
@@ -76,6 +104,48 @@ class KubeClusterBackend(ClusterBackend):
         # all access goes through _watch_lock (nhdlint NHD201)
         self._watch_lock = threading.Lock()
         self._watchers: List[object] = []
+        # watch-derived state, diffed by resync(); written by the watch
+        # threads and read by the resync thread → _state_lock. The touch
+        # sequence orders watch updates against resync's relist: anything
+        # the watch touched AFTER the relist began is fresher than the
+        # listing, and resync must not "repair" it with stale data
+        self._state_lock = threading.Lock()
+        self._known_pods: Dict[Tuple[str, str], _PodSnap] = {}
+        self._node_last: Dict[str, tuple] = {}
+        self._watch_seq = 0
+        self._pod_touched: Dict[Tuple[str, str], int] = {}
+        self._node_touched: Dict[str, int] = {}
+        # sequence point of the relist currently in flight (None when
+        # none is): delete tombstones older than this are prunable
+        self._relist_floor: Optional[int] = None
+        self._resync_interval = (
+            _RESYNC_DEFAULT_SEC if resync_interval is None else resync_interval
+        )
+        # dead-socket defense on the watch plane: the restclient bakes a
+        # finite read timeout into stream requests itself; the real
+        # kubernetes client needs it passed per stream() call. Gated on
+        # the Watch.stream signature accepting **kwargs so stub Watch
+        # implementations (tests) keep working unchanged.
+        self._watch_kwargs: Dict[str, object] = {}
+        if not using_restclient:
+            import inspect
+
+            try:
+                params = inspect.signature(watch.Watch.stream).parameters
+                accepts_kw = any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()
+                )
+            except (TypeError, ValueError):
+                accepts_kw = False
+            if accepts_kw:
+                # one parse site for the timeout (restclient owns it) so
+                # the two client paths can never drift apart
+                from nhd_tpu.k8s.restclient import _WATCH_READ_TIMEOUT
+
+                self._watch_kwargs = {
+                    "_request_timeout": (30.0, _WATCH_READ_TIMEOUT)
+                }
         if start_watches:
             self._start_watches()
 
@@ -130,7 +200,15 @@ class KubeClusterBackend(ClusterBackend):
     def _read_pod(self, pod: str, ns: str):
         try:
             return self.v1.read_namespaced_pod(pod, ns)
-        except self._client.exceptions.ApiException:
+        except self._client.exceptions.ApiException as exc:
+            if retryable(exc):
+                # retry budget spent / circuit open: 'unavailable' must
+                # not masquerade as 'pod does not exist' — that would
+                # mass-fail healthy pods with FailedCfgParse during an
+                # outage. Callers' loop isolation owns the recovery.
+                raise TransientBackendError(
+                    f"read of {ns}/{pod} failed transiently: {exc}"
+                ) from exc
             return None
 
     def pod_exists(self, pod: str, ns: str) -> bool:
@@ -196,6 +274,11 @@ class KubeClusterBackend(ClusterBackend):
             try:
                 cm = self.v1.read_namespaced_config_map(vol.config_map.name, ns)
             except self._client.exceptions.ApiException as exc:
+                if retryable(exc):
+                    raise TransientBackendError(
+                        f"configmap {ns}/{vol.config_map.name} read failed "
+                        f"transiently: {exc}"
+                    ) from exc
                 # a pod can reference a ConfigMap that doesn't exist (yet);
                 # that fails the pod (FailedCfgParse), never the scheduler
                 self.logger.error(
@@ -217,6 +300,13 @@ class KubeClusterBackend(ClusterBackend):
             )
             return True
         except self._client.exceptions.ApiException as exc:
+            if retryable(exc):
+                # retry budget already spent inside the policy: surface as
+                # transient so the scheduler requeues instead of failing
+                # the pod (scheduler/core.py commit path)
+                raise TransientBackendError(
+                    f"annotation patch for {ns}/{pod} failed transiently: {exc}"
+                ) from exc
             self.logger.error(f"annotation patch failed for {ns}/{pod}: {exc}")
             return False
 
@@ -247,6 +337,13 @@ class KubeClusterBackend(ClusterBackend):
         except ValueError:
             pass  # client chokes on the empty 201 body; bind succeeded
         except client.exceptions.ApiException as exc:
+            if retryable(exc):
+                # the policy's retries are exhausted but the failure is a
+                # server-health problem, not a verdict on this bind —
+                # requeue the pod rather than failing it (docs/RESILIENCE.md)
+                raise TransientBackendError(
+                    f"bind for {ns}/{pod} -> {node} failed transiently: {exc}"
+                ) from exc
             self.logger.error(f"bind failed for {ns}/{pod} -> {node}: {exc}")
             return False
         return True
@@ -281,8 +378,32 @@ class KubeClusterBackend(ClusterBackend):
     # ------------------------------------------------------------------
 
     def _start_watches(self) -> None:
+        self._seed_known_state()
         threading.Thread(target=self._watch_pods, daemon=True).start()
         threading.Thread(target=self._watch_nodes, daemon=True).start()
+        if self._resync_interval > 0:
+            threading.Thread(target=self._resync_loop, daemon=True).start()
+
+    def _seed_known_state(self) -> None:
+        """Baseline _known_pods/_node_last from a relist before the
+        watches start. A watch established without a resourceVersion does
+        NOT replay existing objects, so without this every pre-existing
+        pod's first MODIFIED would look like a missed create (one
+        synthetic pod_create + warning per pod, cluster-wide, on every
+        process start). Consumers don't need those events at startup —
+        the scheduler replays deployed state from the cluster itself
+        (load_deployed_configs / check_pending_pods)."""
+        try:
+            with self._state_lock:
+                for p in self.v1.list_pod_for_all_namespaces().items:
+                    key = (p.metadata.namespace, p.metadata.name)
+                    self._known_pods[key] = self._pod_snap(p)
+                for n in self.v1.list_node().items:
+                    self._node_last[n.metadata.name] = self._node_snap(n)
+        except Exception as exc:
+            # seeding is an optimization, not a correctness requirement:
+            # the watch threads and resync cope with an empty baseline
+            self.logger.warning(f"initial state seed failed: {exc}")
 
     def _register_watcher(self, w: object) -> None:
         with self._watch_lock:
@@ -294,61 +415,297 @@ class KubeClusterBackend(ClusterBackend):
             # stop it here instead of racing the sweep
             self._stop_watcher(w)
 
+    @staticmethod
+    def _pod_snap(obj) -> _PodSnap:
+        return (
+            obj.metadata.uid,
+            dict(obj.metadata.annotations or {}),
+            obj.spec.scheduler_name or "",
+            obj.spec.node_name or "",
+        )
+
+    def _note_pod(self, ev_type: str, obj) -> Optional[WatchEvent]:
+        """Update watch-derived pod state; return the event to emit (or
+        None when the event is state-only).
+
+        After a 410 Gone the fresh full-replay watch re-delivers ADDED for
+        every live object — an already-known (ns, name, uid) upserts the
+        snapshot quietly instead of double-emitting pod_create (the
+        regression test pins this, tests/test_kube_faults.py). MODIFIED
+        events for a *known* pod are state-only: the snapshot stays fresh
+        so a later delete event carries current annotations/node, but
+        nothing is emitted (same information policy as before). A MODIFIED
+        for an UNKNOWN pod means its create event was lost — emit the
+        pod_create now; recording it silently would mark the pod 'known'
+        and stop resync from ever repairing the miss."""
+        if ev_type not in ("ADDED", "MODIFIED", "DELETED"):
+            # BOOKMARK/ERROR/unknown: the object isn't a Pod (an in-band
+            # ERROR carries a Status) — never reach into it
+            return None
+        key = (obj.metadata.namespace, obj.metadata.name)
+        snap = self._pod_snap(obj)
+        with self._state_lock:
+            self._watch_seq += 1
+            if ev_type == "DELETED":
+                self._known_pods.pop(key, None)
+                self._pod_touched[key] = self._watch_seq
+                # opportunistic tombstone prune: delete entries only guard
+                # in-flight relists, so anything older than the active
+                # relist floor (or everything, when no relist runs — e.g.
+                # resync disabled) is dead weight on a churny cluster
+                if len(self._pod_touched) > 2 * len(self._known_pods) + 256:
+                    floor = self._relist_floor
+                    for k in list(self._pod_touched):
+                        if k not in self._known_pods and (
+                            floor is None or self._pod_touched[k] < floor
+                        ):
+                            del self._pod_touched[k]
+            else:
+                prior = self._known_pods.get(key)
+                self._known_pods[key] = snap
+                self._pod_touched[key] = self._watch_seq
+                if ev_type == "ADDED" and prior is not None and prior[0] == snap[0]:
+                    API_COUNTERS.inc("watch_dedup_replays_total")
+                    return None
+        if ev_type == "DELETED":
+            kind = "pod_delete"
+        elif ev_type == "ADDED":
+            kind = "pod_create"
+        elif ev_type == "MODIFIED" and prior is None:
+            # first sight of this pod: the ADDED was missed upstream
+            self.logger.warning(
+                f"MODIFIED for unknown pod {key[0]}/{key[1]}; emitting "
+                "the missed pod_create"
+            )
+            kind = "pod_create"
+        else:
+            return None
+        return WatchEvent(
+            kind=kind, name=key[1], namespace=key[0],
+            annotations=dict(snap[1]), uid=snap[0],
+            scheduler_name=snap[2], node=snap[3],
+        )
+
+    def _note_watch_exc(self, plane: str, exc: Exception) -> None:
+        """Log a watch-stream failure at the right volume. On the real
+        kubernetes client the finite read timeout surfaces HERE as an
+        exception every quiet 60s (the restclient translates it to a
+        silent stream end internally) — that expected recycling must not
+        produce an ERROR line per minute on a healthy idle cluster."""
+        name = type(exc).__name__
+        if isinstance(exc, OSError) or "Timeout" in name:
+            API_COUNTERS.inc("watch_read_timeouts_total")
+            self.logger.info(f"{plane} watch stream ended ({name}); reconnecting")
+        else:
+            self.logger.error(f"{plane} watch restarted: {exc}")
+
+    def _watch_error(self, w: object, ev: dict) -> bool:
+        """Handle an in-band ERROR watch event (expired resourceVersion
+        delivered as a Status object instead of an HTTP 410). Clears the
+        tracked resourceVersion so the reconnect starts a fresh watch —
+        without this, every reconnect replays the same stale RV and the
+        watch degenerates into a permanent error loop."""
+        if ev.get("type") != "ERROR":
+            return False
+        if getattr(w, "resource_version", None) is not None:
+            w.resource_version = None
+        self.logger.warning(
+            "in-band watch ERROR (expired resourceVersion?); "
+            "reconnecting with a fresh watch"
+        )
+        return True
+
     def _watch_pods(self) -> None:
         w = self._watch_mod.Watch()
         self._register_watcher(w)
+        first = True
         while not self._watch_stop.is_set():
+            if not first:
+                API_COUNTERS.inc("watch_reconnects_total")
+            first = False
             try:
-                for ev in w.stream(self.v1.list_pod_for_all_namespaces):
-                    obj = ev["object"]
-                    kind = {"ADDED": "pod_create", "DELETED": "pod_delete"}.get(
-                        ev["type"]
-                    )
-                    if kind is None:
-                        continue
-                    self._events.put(
-                        WatchEvent(
-                            kind=kind, name=obj.metadata.name,
-                            namespace=obj.metadata.namespace,
-                            annotations=dict(obj.metadata.annotations or {}),
-                            uid=obj.metadata.uid,
-                            scheduler_name=obj.spec.scheduler_name or "",
-                            node=obj.spec.node_name or "",
-                        )
-                    )
+                for ev in w.stream(
+                    self.v1.list_pod_for_all_namespaces, **self._watch_kwargs
+                ):
+                    if self._watch_error(w, ev):
+                        break  # in-band expiry: reconnect fresh
+                    out = self._note_pod(ev["type"], ev["object"])
+                    if out is not None:
+                        self._events.put(out)
             except Exception as exc:
-                self.logger.error(f"pod watch restarted: {exc}")
+                self._note_watch_exc("pod", exc)
             # the server ends watch streams routinely; reconnect after a
             # pause rather than spinning
             self._watch_stop.wait(self._watch_backoff)
 
+    @staticmethod
+    def _node_snap(obj) -> tuple:
+        return (
+            dict(obj.metadata.labels or {}),
+            bool(obj.spec.unschedulable),
+            [t.key for t in (obj.spec.taints or [])],
+        )
+
+    def _note_node(
+        self, obj, *, emit_unchanged: bool = True,
+        if_untouched_since: Optional[int] = None,
+    ) -> Optional[WatchEvent]:
+        """Update watch-derived node state; return the node_update event.
+
+        With ``emit_unchanged=False`` (resync path) an unchanged node
+        produces no event — the controller's handlers are diff-driven, so
+        replaying identical state would only churn the queue.
+        ``if_untouched_since`` makes the freshness check and the state
+        write one atomic step: a node the watch touched after that
+        sequence point is left alone entirely (writing the stale relist
+        snapshot would revert a cordon the watch just delivered)."""
+        name = obj.metadata.name
+        cur = self._node_snap(obj)
+        with self._state_lock:
+            if (if_untouched_since is not None
+                    and self._node_touched.get(name, 0) > if_untouched_since):
+                return None  # the watch already knows better
+            old = self._node_last.get(name)
+            self._node_last[name] = cur
+            if emit_unchanged:  # watch path: mark fresher than any relist
+                self._watch_seq += 1
+                self._node_touched[name] = self._watch_seq
+        if old is None:
+            old = cur
+        if not emit_unchanged and old == cur:
+            return None
+        return WatchEvent(
+            kind="node_update", name=name, labels=dict(cur[0]),
+            old_labels=dict(old[0]), unschedulable=cur[1],
+            was_unschedulable=old[1], taints=list(cur[2]),
+            old_taints=list(old[2]),
+        )
+
     def _watch_nodes(self) -> None:
-        last: Dict[str, tuple] = {}
         w = self._watch_mod.Watch()
         self._register_watcher(w)
+        first = True
         while not self._watch_stop.is_set():
+            if not first:
+                API_COUNTERS.inc("watch_reconnects_total")
+            first = False
             try:
-                for ev in w.stream(self.v1.list_node):
-                    obj = ev["object"]
-                    name = obj.metadata.name
-                    labels = dict(obj.metadata.labels or {})
-                    unsched = bool(obj.spec.unschedulable)
-                    taints = [t.key for t in (obj.spec.taints or [])]
-                    old_labels, old_unsched, old_taints = last.get(
-                        name, (labels, unsched, taints)
-                    )
-                    self._events.put(
-                        WatchEvent(
-                            kind="node_update", name=name, labels=labels,
-                            old_labels=old_labels, unschedulable=unsched,
-                            was_unschedulable=old_unsched, taints=taints,
-                            old_taints=old_taints,
-                        )
-                    )
-                    last[name] = (labels, unsched, taints)
+                for ev in w.stream(self.v1.list_node, **self._watch_kwargs):
+                    if self._watch_error(w, ev):
+                        break  # in-band expiry: reconnect fresh
+                    if ev["type"] not in ("ADDED", "MODIFIED", "DELETED"):
+                        continue  # BOOKMARK etc.: not a Node object
+                    out = self._note_node(ev["object"])
+                    if out is not None:
+                        self._events.put(out)
             except Exception as exc:
-                self.logger.error(f"node watch restarted: {exc}")
+                self._note_watch_exc("node", exc)
             self._watch_stop.wait(self._watch_backoff)
+
+    # ------------------------------------------------------------------
+    # resync: the safety net under the watch plane
+    # ------------------------------------------------------------------
+
+    def _resync_loop(self) -> None:
+        while not self._watch_stop.wait(self._resync_interval):
+            try:
+                self.resync()
+            except Exception as exc:
+                # a transient API failure here costs one cadence, nothing
+                # else — the next tick relists from scratch
+                self.logger.error(f"resync failed: {exc}")
+
+    def resync(self) -> None:
+        """Full relist, diffed against watch-derived state; emits synthetic
+        events for anything the watch plane missed.
+
+        Covers the gaps no reconnect can: events dropped while a stream
+        was down, a resourceVersion that fell out of the compaction window
+        mid-gap, a watch thread wedged long enough for deletes+recreates
+        to alias. Synthetic events are indistinguishable from real ones
+        downstream (same WatchEvent contract), so the controller and
+        scheduler need no resync-awareness at all."""
+        API_COUNTERS.inc("resyncs_total")
+        with self._state_lock:
+            # everything the watch threads touch after this point is
+            # FRESHER than the listing below — resync must not "repair"
+            # those keys with stale relist data (spurious deletes for
+            # pods created mid-list, reverted node states)
+            seq0 = self._watch_seq
+            self._relist_floor = seq0  # tombstones >= seq0 must survive
+        try:
+            self._resync_diff(seq0)
+        finally:
+            with self._state_lock:
+                self._relist_floor = None
+
+    def _resync_diff(self, seq0: int) -> None:
+        live: Dict[Tuple[str, str], _PodSnap] = {}
+        for p in self.v1.list_pod_for_all_namespaces().items:
+            live[(p.metadata.namespace, p.metadata.name)] = self._pod_snap(p)
+        synthetic: List[WatchEvent] = []
+        with self._state_lock:
+            for key, snap in live.items():
+                if self._pod_touched.get(key, 0) > seq0:
+                    continue  # the watch already knows better
+                prior = self._known_pods.get(key)
+                if prior is not None and prior[0] == snap[0]:
+                    self._known_pods[key] = snap  # refresh annotations/node
+                    continue
+                if prior is not None:
+                    # same name, new uid: the delete was missed too
+                    synthetic.append(self._synth_pod_event(
+                        "pod_delete", key, prior
+                    ))
+                synthetic.append(self._synth_pod_event("pod_create", key, snap))
+                self._known_pods[key] = snap
+            for key in list(self._known_pods):
+                if key not in live and self._pod_touched.get(key, 0) <= seq0:
+                    synthetic.append(self._synth_pod_event(
+                        "pod_delete", key, self._known_pods.pop(key)
+                    ))
+            # prune touch records for long-gone pods (delete events leave
+            # them behind as tombstones guarding in-flight relists)
+            for key in list(self._pod_touched):
+                if (key not in self._known_pods and key not in live
+                        and self._pod_touched[key] <= seq0):
+                    del self._pod_touched[key]
+        for ev in synthetic:
+            key = (ev.namespace, ev.name)
+            with self._state_lock:
+                if self._pod_touched.get(key, 0) > seq0:
+                    # the watch delivered fresher truth for this key while
+                    # we were diffing — enqueueing the stale synthetic
+                    # AFTER its event would make stale state win downstream
+                    continue
+                API_COUNTERS.inc("resync_synthetic_events_total")
+                self._events.put(ev)
+            self.logger.warning(
+                f"resync: watch missed {ev.kind} for "
+                f"{ev.namespace}/{ev.name}; emitting synthetic event"
+            )
+        # nodes: emit only real diffs (cordon/label/taint changes missed)
+        for n in self.v1.list_node().items:
+            out = self._note_node(
+                n, emit_unchanged=False, if_untouched_since=seq0
+            )
+            if out is not None:
+                API_COUNTERS.inc("resync_synthetic_events_total")
+                self.logger.warning(
+                    f"resync: watch missed node_update for {out.name}; "
+                    "emitting synthetic event"
+                )
+                self._events.put(out)
+
+    @staticmethod
+    def _synth_pod_event(
+        kind: str, key: Tuple[str, str], snap: _PodSnap
+    ) -> WatchEvent:
+        return WatchEvent(
+            kind=kind, name=key[1], namespace=key[0],
+            annotations=dict(snap[1]), uid=snap[0],
+            scheduler_name=snap[2], node=snap[3],
+        )
 
     def stop_watches(self) -> None:
         """Stop watch threads: interrupt in-flight streams (Watch.stop
@@ -392,8 +749,13 @@ class KubeClusterBackend(ClusterBackend):
             objs = self.crd.list_cluster_custom_object(
                 self._CRD_GROUP, self._CRD_VERSION, self._CRD_PLURAL
             )
-        except self._client.exceptions.ApiException:
-            return []
+        except self._client.exceptions.ApiException as exc:
+            if retryable(exc):
+                # the controller's reconcile isolation retries next period
+                raise TransientBackendError(
+                    f"TriadSet list failed transiently: {exc}"
+                ) from exc
+            return []  # CRD not installed: a fact, not an outage
         out = []
         for item in objs.get("items", []):
             spec = item.get("spec", {})

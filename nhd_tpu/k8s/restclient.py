@@ -36,7 +36,9 @@ Wire-format notes (all mirroring the real client):
 from __future__ import annotations
 
 import datetime as _dt
+import http.client as _httplib
 import json as _json
+import logging as _logging
 import os
 import re
 import ssl
@@ -44,6 +46,10 @@ import types
 import urllib.error
 import urllib.request
 from typing import Any, Dict, Iterator, Optional
+
+from nhd_tpu.k8s.retry import API_COUNTERS
+
+_logger = _logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -54,11 +60,15 @@ from typing import Any, Dict, Iterator, Optional
 class ApiException(Exception):
     """Mirror of kubernetes.client.exceptions.ApiException."""
 
-    def __init__(self, status: int = 0, reason: str = "", body: str = ""):
+    def __init__(self, status: int = 0, reason: str = "", body: str = "",
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(f"({status}) Reason: {reason}")
         self.status = status
         self.reason = reason
         self.body = body
+        # response headers (Retry-After drives the retry policy's backoff
+        # floor, k8s/retry.py)
+        self.headers = dict(headers) if headers else {}
 
 
 class ConfigException(Exception):
@@ -318,6 +328,15 @@ def load_kube_config(config_file: Optional[str] = None) -> None:
 
 _DEFAULT_TIMEOUT = 30.0
 
+# Finite socket timeout for watch streams. The old behavior (timeout=None)
+# meant a silently dead socket — NAT reset with no FIN, crashed LB — blocked
+# the watch thread FOREVER with no events and no error. A quiet-but-alive
+# watch simply times out too: Watch.stream translates the read timeout into
+# a normal stream end, and the reconnect loop in k8s/kube.py resumes from
+# the tracked resourceVersion (no replay). 60s matches the order of the API
+# server's own --min-request-timeout stream recycling.
+_WATCH_READ_TIMEOUT = float(os.environ.get("NHD_WATCH_READ_TIMEOUT", "60"))
+
 
 class _HttpClient:
     def __init__(self, cfg: Configuration):
@@ -360,13 +379,14 @@ class _HttpClient:
         )
         try:
             resp = urllib.request.urlopen(
-                req, timeout=None if stream else timeout,
+                req, timeout=_WATCH_READ_TIMEOUT if stream else timeout,
                 context=self._context(),
             )
         except urllib.error.HTTPError as exc:
             raise ApiException(
                 status=exc.code, reason=exc.reason,
                 body=exc.read().decode(errors="replace"),
+                headers=dict(exc.headers or {}),
             ) from None
         except urllib.error.URLError as exc:
             raise ApiException(status=0, reason=str(exc.reason)) from None
@@ -538,12 +558,35 @@ class Watch:
                 line = line.strip()
                 if not line:
                     continue
-                ev = _json.loads(line)
+                try:
+                    ev = _json.loads(line)
+                except ValueError:
+                    # one garbled chunk (routine on a mid-stream cut) must
+                    # not raise JSONDecodeError out of the generator and
+                    # kill the watch thread: drop the line, end the stream,
+                    # let the caller's reconnect loop start a fresh watch
+                    API_COUNTERS.inc("watch_malformed_lines_total")
+                    _logger.warning(
+                        "malformed watch line (%d bytes); dropping and "
+                        "ending stream for reconnect", len(line)
+                    )
+                    break
                 obj = ev.get("object", {})
                 rv = (obj.get("metadata") or {}).get("resourceVersion")
                 if rv:
                     self.resource_version = rv
                 yield {"type": ev.get("type"), "object": _wrap(obj)}
+        except (OSError, _httplib.HTTPException) as exc:
+            # the finite socket timeout (silently dead peer) or a torn
+            # chunked read surfaces here mid-iteration — translate into a
+            # normal stream end so the reconnect loop takes over instead
+            # of the error escaping the generator. A plain timeout is
+            # routine stream recycling on a quiet cluster: INFO, not a
+            # warning per idle minute
+            API_COUNTERS.inc("watch_read_timeouts_total")
+            log = (_logger.info if isinstance(exc, TimeoutError)
+                   else _logger.warning)
+            log(f"watch stream read ended ({exc!r}); reconnecting")
         finally:
             try:
                 resp.close()

@@ -14,13 +14,15 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List
 
+from nhd_tpu.k8s.retry import API_COUNTERS, ApiCounters
 from nhd_tpu.rpc import ask_scheduler
 from nhd_tpu.scheduler.core import RpcMsgType
 from nhd_tpu.utils import get_logger
 
 
 def render_metrics(
-    nodes: List[dict], failed_count: int, perf: dict | None = None
+    nodes: List[dict], failed_count: int, perf: dict | None = None,
+    api_stats: dict | None = None,
 ) -> str:
     """Scheduler stats → Prometheus text exposition format."""
     lines = [
@@ -28,6 +30,20 @@ def render_metrics(
         "# TYPE nhd_failed_schedule_total counter",
         f"nhd_failed_schedule_total {failed_count}",
     ]
+    if api_stats is None:
+        api_stats = API_COUNTERS.snapshot()
+    # fault-tolerance layer: ApiCounters.KNOWN is the single name → (kind,
+    # help) table, so a counter added there surfaces here with no edit
+    for name, (kind, help_text) in ApiCounters.KNOWN.items():
+        if name not in api_stats:
+            continue
+        # exact rendering (no :g): large monotonic counters must not lose
+        # precision or rate() reads zero-then-spike past ~1e6
+        lines += [
+            f"# HELP nhd_{name} {help_text}",
+            f"# TYPE nhd_{name} {kind}",
+            f"nhd_{name} {api_stats[name]}",
+        ]
     for name, kind, help_text in (
         ("batches_total", "counter", "Scheduling batches run"),
         ("scheduled_total", "counter", "Pods scheduled"),
@@ -132,7 +148,9 @@ class MetricsServer(threading.Thread):
     def run(self) -> None:
         self._started.set()
         self.logger.warning(f"metrics endpoint on :{self.port}/metrics")
-        self.server.serve_forever()
+        # short poll: shutdown() waits out one poll interval, and the
+        # 0.5 s default is pure teardown latency for every embedder
+        self.server.serve_forever(poll_interval=0.05)
 
     def stop(self) -> None:
         """Idempotent, and safe on a never-started server (shutdown() would

@@ -4,9 +4,12 @@ The reference builds this on kopf (TriadController.py): node watches become
 cordon/maintenance/group events, pod watches become create/delete events,
 and a 3-second timer recreates missing TriadSet pods. This implementation
 consumes the backend's WatchEvent stream directly — no operator framework —
-and keeps the same translation rules and the crash-only stance (a
-controller exception stops the harness, which exits; reference
-TriadController.py:147-152).
+and keeps the same translation rules. Unlike the reference's pure
+crash-only stance (any controller exception kills the process,
+TriadController.py:147-152), events are exception-isolated by default: one
+poisoned event is logged and counted (nhd_controller_event_errors_total)
+while the loop keeps draining — the resync and reconcile nets repair
+whatever that event would have told us (docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
@@ -23,6 +26,7 @@ from nhd_tpu.k8s.interface import (
     WatchEvent,
 )
 from nhd_tpu.core.node import HostNode
+from nhd_tpu.k8s.retry import API_COUNTERS
 from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
 from nhd_tpu.utils import get_logger
 
@@ -41,6 +45,7 @@ class Controller(threading.Thread):
         *,
         sched_name: str = "nhd-scheduler",
         poll_interval: float = 0.1,
+        isolate_events: bool = True,
     ):
         super().__init__(name="nhd-controller", daemon=True)
         self.logger = get_logger(__name__)
@@ -48,6 +53,13 @@ class Controller(threading.Thread):
         self.queue = watch_queue
         self.sched_name = sched_name
         self.poll_interval = poll_interval
+        # per-event exception isolation: one poisoned event (truncated
+        # object off a cut stream, a shape the translators never met) is
+        # logged and counted instead of killing the run loop. False
+        # restores the reference's pure crash-only stance — kept only so
+        # the chaos harness can demonstrate the failure mode
+        # (tests/test_faults.py).
+        self.isolate_events = isolate_events
         self._stop_event = threading.Event()
         self._last_triadset = 0.0
         self._last_status: Dict[tuple, int] = {}
@@ -150,18 +162,43 @@ class Controller(threading.Thread):
 
     # ------------------------------------------------------------------
 
+    def _dispatch(self, ev: WatchEvent) -> None:
+        if ev.kind == "node_update":
+            self.handle_node_update(ev)
+        elif ev.kind in ("pod_create", "pod_delete"):
+            self.handle_pod_event(ev)
+
     def run_once(
         self, now: Optional[float] = None, timeout: float = 0.0
     ) -> None:
         for ev in self.backend.poll_watch_events(timeout):
-            if ev.kind == "node_update":
-                self.handle_node_update(ev)
-            elif ev.kind in ("pod_create", "pod_delete"):
-                self.handle_pod_event(ev)
+            try:
+                self._dispatch(ev)
+            except Exception:
+                if not self.isolate_events:
+                    raise
+                # broad on purpose: the event is cluster-supplied data; a
+                # translator crash on one poisoned event must cost that
+                # event, not the control loop (the resync/reconcile nets
+                # repair whatever information it carried)
+                API_COUNTERS.inc("controller_event_errors_total")
+                self.logger.exception(
+                    f"poisoned watch event dropped ({ev.kind} {ev.name!r})"
+                )
         t = time.monotonic() if now is None else now
         if t - self._last_triadset >= TRIADSET_PERIOD_SEC:
             self._last_triadset = t
-            self.reconcile_triadsets()
+            try:
+                self.reconcile_triadsets()
+            except Exception:
+                if not self.isolate_events:
+                    raise
+                # a failed reconcile pass retries next period; killing the
+                # loop would also take the watch translation down with it.
+                # Own counter: routine transient reconcile failures must
+                # not pollute the poisoned-event alarm
+                API_COUNTERS.inc("controller_reconcile_errors_total")
+                self.logger.exception("TriadSet reconcile pass failed")
 
     def run(self) -> None:
         # BLOCKING poll with poll_interval as the timeout, not a sleep:

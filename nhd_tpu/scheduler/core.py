@@ -22,7 +22,8 @@ from nhd_tpu import NHD_SCHED_NAME
 from nhd_tpu.config.parser import CfgParser, get_cfg_parser
 from nhd_tpu.core.node import HostNode
 from nhd_tpu.core.request import PodRequest
-from nhd_tpu.k8s.interface import ClusterBackend, EventType
+from nhd_tpu.k8s.interface import ClusterBackend, EventType, TransientBackendError
+from nhd_tpu.k8s.retry import API_COUNTERS
 from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
 from nhd_tpu.solver.batch import BatchItem, BatchScheduler
 from nhd_tpu.utils import get_logger
@@ -80,6 +81,21 @@ if STREAM_PLACEMENT not in ("first-fit", "routed"):
 # (API-server round trips dominate gang bind latency on real clusters)
 COMMIT_WORKERS = int(os.environ.get("NHD_COMMIT_WORKERS", "1"))
 
+# a transiently-failing commit (TransientBackendError: the backend's retry
+# budget spent on a 429/5xx/network fault) requeues the pod instead of
+# marking it failed — but only this many times in a row, so a persistent
+# outage degrades to the periodic-reconcile cadence instead of a hot
+# requeue loop against a down API server
+REQUEUE_MAX = int(os.environ.get("NHD_BIND_REQUEUE_MAX", "8"))
+
+
+class CommitOutcome(Enum):
+    """Result of one pod's annotate→bind commit sequence."""
+
+    OK = 0
+    FAILED = 1      # terminal: the request is wrong; fail the pod
+    RETRY = 2       # transient: server health; requeue the pod
+
 
 class PodStatus(Enum):
     """Reference: NHDScheduler.py:29-34."""
@@ -127,6 +143,13 @@ class Scheduler(threading.Thread):
         # vanished-pod suspects from the previous reconcile scan
         # (reconcile_deleted_pods two-scan release rule)
         self._missing_once: set = set()
+        # consecutive transient-commit requeues per pod (capped by
+        # REQUEUE_MAX; cleared on success, terminal failure, or delete)
+        self._requeue_attempts: Dict[Tuple[str, str], int] = {}
+        # set when a run-loop pass died mid-mutation (API outage past the
+        # retry deadline); the next successful pass rebuilds the mirror
+        # from the cluster before trusting it (_guarded)
+        self._mirror_dirty = False
         # cumulative solver-phase accounting (exported via PERF_INFO /
         # the Prometheus plane; the north-star metric is p99 bind latency,
         # SURVEY §5.1/§5.5)
@@ -388,15 +411,21 @@ class Scheduler(threading.Thread):
             outcomes = [self._commit_pod_calls(*w) for w in winners]
 
         scheduled = 0
-        for (parser, item, result), ok in zip(winners, outcomes):
+        for (parser, item, result), outcome in zip(winners, outcomes):
             ns, pod = item.key
-            if ok:
+            if outcome is CommitOutcome.OK:
                 scheduled += 1
+                self._requeue_attempts.pop((ns, pod), None)
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.SCHEDULED, "time": time.time(),
                     "uid": uids.get((ns, pod), "0"),
                 }
+            elif outcome is CommitOutcome.RETRY and self._requeue_pod(
+                pod, ns, uids.get((ns, pod), "0"), self.nodes[result.node], item
+            ):
+                pass  # claim unwound, pod back on the queue
             else:
+                self._requeue_attempts.pop((ns, pod), None)
                 self._unwind(pod, ns, self.nodes[result.node], item)
                 self.failed_schedule_count += 1
                 self.pod_state[(ns, pod)] = {
@@ -408,7 +437,38 @@ class Scheduler(threading.Thread):
         self.perf["scheduled_total"] += scheduled
         return scheduled
 
-    def _commit_pod_calls(self, parser: CfgParser, item: BatchItem, result) -> bool:
+    def _requeue_pod(
+        self, pod: str, ns: str, uid: str, node: HostNode, item: BatchItem
+    ) -> bool:
+        """Requeue a pod whose commit failed transiently (API-server
+        health, not a verdict on the pod). Returns False once the per-pod
+        budget is spent — the caller then takes the terminal-failure path,
+        and the periodic reconcile scan still retries at its own cadence."""
+        key = (ns, pod)
+        attempts = self._requeue_attempts.get(key, 0) + 1
+        if attempts > REQUEUE_MAX:
+            self.logger.error(
+                f"{ns}/{pod}: transient commit failures exceeded "
+                f"{REQUEUE_MAX} requeues; marking failed until reconcile"
+            )
+            return False
+        self._requeue_attempts[key] = attempts
+        self._unwind(pod, ns, node, item)
+        self.pod_state.pop(key, None)
+        API_COUNTERS.inc("bind_requeues_total")
+        self.logger.warning(
+            f"{ns}/{pod}: transient commit failure; requeued "
+            f"(attempt {attempts}/{REQUEUE_MAX})"
+        )
+        self.nqueue.put(WatchItem(
+            WatchType.TRIAD_POD_CREATE,
+            pod={"ns": ns, "name": pod, "uid": uid, "cfg": "", "node": ""},
+        ))
+        return True
+
+    def _commit_pod_calls(
+        self, parser: CfgParser, item: BatchItem, result
+    ) -> CommitOutcome:
         """The backend-only commit sequence: NAD → GPU map → solved config
         → bind (reference: NHDScheduler.py:286-353). Touches no scheduler
         state (node reads only), so commits for different pods may run on
@@ -420,15 +480,23 @@ class Scheduler(threading.Thread):
         not skip the outcome loop — on the serial path it would kill the
         scheduler thread with the mirror mutated and no unwind recorded;
         on the pool path it would abort ``pool.map`` before any other
-        winner's outcome ran. Either way: log, treat as a failed commit.
+        winner's outcome ran. TransientBackendError maps to RETRY (the
+        backend's own retry budget is spent but the failure is server
+        health, docs/RESILIENCE.md); anything else to FAILED.
         """
         try:
-            return self._commit_pod_calls_inner(parser, item, result)
+            ok = self._commit_pod_calls_inner(parser, item, result)
+            return CommitOutcome.OK if ok else CommitOutcome.FAILED
+        except TransientBackendError as exc:
+            self.logger.warning(
+                f"transient commit failure for {item.key}: {exc}"
+            )
+            return CommitOutcome.RETRY
         except Exception:
             self.logger.exception(
                 f"commit raised for {item.key}; treating as failed"
             )
-            return False
+            return CommitOutcome.FAILED
 
     def _commit_pod_calls_inner(self, parser: CfgParser, item: BatchItem, result) -> bool:
         ns, pod = item.key
@@ -662,6 +730,7 @@ class Scheduler(threading.Thread):
                 node_name=item.pod.get("node") or None,
             )
             self.pod_state.pop((ns, pod), None)
+            self._requeue_attempts.pop((ns, pod), None)
 
         elif item.type == WatchType.TRIAD_POD_CREATE:
             ns, pod, uid = item.pod["ns"], item.pod["name"], item.pod["uid"]
@@ -734,10 +803,37 @@ class Scheduler(threading.Thread):
             idle_count += 1
             if idle_count >= IDLE_CNT_THRESH:
                 idle_count = 0
-                self.check_pending_pods()
+                self._guarded("periodic scan", self.check_pending_pods)
             return idle_count
-        self.handle_watch_item(item)
+        self._guarded(f"watch item {item.type.name}", self.handle_watch_item, item)
         return idle_count
+
+    def _guarded(self, what: str, fn, *args) -> None:
+        """Backend-fault isolation for the run loop.
+
+        An ApiException that survives the retry layer — outage past the
+        per-call deadline, open circuit — escaping ``service_pods`` or a
+        release path would kill the single-writer thread permanently for
+        what is a *transient* server-health problem. Isolate it: log,
+        count, and mark the mirror dirty, because the failed pass may
+        have mutated claims it never finished reconciling. The next pass
+        that gets through rebuilds the mirror from the cluster first
+        (``reset_resources``, the reference's own drift repair), so
+        nothing is trusted after a half-completed pass. Startup stays
+        crash-only — without initial state a process restart is right.
+        """
+        try:
+            if self._mirror_dirty:
+                self.reset_resources()
+                self._mirror_dirty = False
+            fn(*args)
+        except Exception:
+            API_COUNTERS.inc("scheduler_loop_errors_total")
+            self._mirror_dirty = True
+            self.logger.exception(
+                f"{what} failed (backend unavailable?); mirror will be "
+                "rebuilt from the cluster on the next successful pass"
+            )
 
     def run(self) -> None:
         self.startup()

@@ -6,6 +6,12 @@ production. This module drives the controller+scheduler stack on the fake
 backend through randomized event storms — pod creates/deletes, cordons,
 maintenance flips, group moves, bind failures, scheduler restarts — while
 checking conservation invariants after every step.
+
+With ``api_faults`` set, the same storm also hits the API layer
+(sim/faults.py): dropped and poisoned watch events, transient bind and
+annotate failures. ``quiesce()`` then proves crash-only recovery: faults
+stop, the control loops drain, and the run must end with zero invariant
+violations and no pod stranded by an API fault (``stuck_pods()``).
 """
 
 from __future__ import annotations
@@ -14,12 +20,13 @@ import json
 import queue
 import random
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional, Tuple
 
 from nhd_tpu.k8s.fake import FakeClusterBackend
 from nhd_tpu.scheduler.controller import Controller
 from nhd_tpu.scheduler.core import Scheduler
 from nhd_tpu.scheduler.events import WatchQueue
+from nhd_tpu.sim.faults import FaultProfile, FaultyBackend
 from nhd_tpu.sim.synth import SynthNodeSpec, make_node_labels, make_triad_config
 
 
@@ -38,11 +45,33 @@ class ChaosStats:
 
 
 class ChaosSim:
-    """One reproducible chaos run (seeded)."""
+    """One reproducible chaos run (seeded).
 
-    def __init__(self, seed: int = 0, n_nodes: int = 4):
+    ``api_faults`` layers API-level fault injection (sim/faults.py) over
+    the cluster churn; ``hardened=False`` strips the controller's
+    per-event isolation, restoring the reference's crash-only stance so
+    tests can demonstrate that the same storm kills an unhardened stack.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        n_nodes: int = 4,
+        *,
+        api_faults: Optional[FaultProfile] = None,
+        hardened: bool = True,
+    ):
         self.rng = random.Random(seed)
-        self.backend = FakeClusterBackend()
+        self.hardened = hardened
+        base = FakeClusterBackend()
+        if api_faults is not None:
+            # the fault RNG is its own seeded stream: fault timing stays
+            # reproducible without perturbing the churn sequence
+            self.backend = FaultyBackend(
+                base, api_faults, random.Random(seed + 7919)
+            )
+        else:
+            self.backend = base
         for i in range(n_nodes):
             spec = SynthNodeSpec(name=f"node{i}")
             self.backend.add_node(
@@ -56,7 +85,9 @@ class ChaosSim:
         self.sched = Scheduler(
             self.backend, WatchQueue(), queue.Queue(), respect_busy=False
         )
-        self.controller = Controller(self.backend, self.sched.nqueue)
+        self.controller = Controller(
+            self.backend, self.sched.nqueue, isolate_events=self.hardened
+        )
         self.sched.build_initial_node_list()
         self.sched.load_deployed_configs()
 
@@ -224,3 +255,47 @@ class ChaosSim:
         for _ in range(steps):
             self.step()
         return self.stats
+
+    # ------------------------------------------------------------------
+    # post-storm convergence
+    # ------------------------------------------------------------------
+
+    def quiesce(self, rounds: int = 12) -> List[Tuple[str, str]]:
+        """Stop injecting faults and drive the control loops until the
+        cluster settles; returns the still-unplaced pods.
+
+        This is the crash-only recovery claim made testable: after the
+        fault storm ends, the retry/requeue/reconcile nets must converge
+        the cluster — every invariant holds and nothing stays stranded
+        because of an API fault (``stuck_pods()`` empty)."""
+        if isinstance(self.backend, FaultyBackend):
+            self.backend.enabled = False
+        for i in range(rounds):
+            self.controller.run_once(
+                now=float((self.stats.steps + i + 1) * 10)
+            )
+            while not self.sched.nqueue.empty():
+                self.sched.run_once()
+            self.sched.check_pending_pods()
+            # drain requeues raised by the reconcile pass itself
+            while not self.sched.nqueue.empty():
+                self.sched.run_once()
+            self.check_invariants()
+        return self.unplaced_pods()
+
+    def unplaced_pods(self) -> List[Tuple[str, str]]:
+        return [
+            (p.namespace, p.name)
+            for p in self.backend.pods.values() if p.node is None
+        ]
+
+    def stuck_pods(self) -> List[Tuple[str, str]]:
+        """Unplaced pods with no 'no valid candidate' verdict — i.e. pods
+        the fault storm lost rather than pods the cluster can't fit."""
+        no_candidate = {
+            (e.namespace, e.pod)
+            for e in self.backend.events
+            if e.reason == "FailedScheduling"
+            and "No valid candidate" in e.message
+        }
+        return [k for k in self.unplaced_pods() if k not in no_candidate]

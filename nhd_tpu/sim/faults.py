@@ -1,0 +1,362 @@
+"""API-layer fault injection: the vocabulary chaos runs use to storm the
+control plane.
+
+sim/chaos.py injects *cluster* churn (creates, deletes, cordons); nothing
+in the repo injected *API* faults — the exact failure class the retry/
+resync/requeue layer (k8s/retry.py, k8s/kube.py, scheduler/core.py) exists
+to absorb. This module provides that vocabulary at both seams, seeded and
+scriptable:
+
+* :class:`FaultyHttpClient` — wraps the restclient ``_HttpClient``:
+  injected 5xx/429, status-0 connection resets, slow responses, 410 Gone
+  on watch establishment, mid-stream watch cuts and malformed watch lines.
+  Installed into a KubeClusterBackend with :func:`install_http_faults`
+  (tests/test_kube_faults.py drives it against the stub API server).
+* :class:`FaultyBackend` — decorates any ClusterBackend (in practice the
+  fake): dropped watch events, poisoned (malformed) watch events, and
+  transient bind/annotate failures. ChaosSim wires it in via its
+  ``api_faults`` parameter so full chaos storms now hit the API layer too.
+
+Every fault draws from one seeded RNG, so a failing storm replays exactly.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from nhd_tpu.k8s.interface import (
+    ClusterBackend,
+    EventType,
+    TransientBackendError,
+    WatchEvent,
+)
+from nhd_tpu.utils import get_logger
+
+
+@dataclass
+class FaultProfile:
+    """Per-call fault probabilities. All default to 0 (no faults); the
+    named presets in :data:`PROFILES` are what ``make chaos`` sweeps."""
+
+    name: str = "custom"
+    # backend-level (FaultyBackend)
+    drop_watch_event: float = 0.0      # pod watch event silently lost
+    poison_watch_event: float = 0.0    # inject a malformed event per poll
+    transient_bind: float = 0.0        # bind raises TransientBackendError
+    transient_annotate: float = 0.0    # annotate raises TransientBackendError
+    # HTTP-level (FaultyHttpClient)
+    http_error: float = 0.0            # injected HTTP error status
+    http_statuses: Tuple[int, ...] = (500, 503, 429)
+    http_conn_reset: float = 0.0       # status-0 connection reset
+    http_slow: float = 0.0             # response delayed by slow_seconds
+    slow_seconds: float = 0.02
+    watch_gone: float = 0.0            # 410 Gone on watch establishment
+    watch_cut: float = 0.0             # stream dies mid-line-sequence
+    watch_malformed: float = 0.0       # garbage line injected, then cut
+
+
+#: the fault-storm matrix swept by `make chaos` (tools/chaos_storm.py)
+PROFILES: Dict[str, FaultProfile] = {
+    "none": FaultProfile(name="none"),
+    "light": FaultProfile(
+        name="light", drop_watch_event=0.05, transient_bind=0.05,
+        transient_annotate=0.05, poison_watch_event=0.02,
+    ),
+    "storm": FaultProfile(
+        name="storm", drop_watch_event=0.15, transient_bind=0.20,
+        transient_annotate=0.15, poison_watch_event=0.10,
+    ),
+    "heavy": FaultProfile(
+        name="heavy", drop_watch_event=0.30, transient_bind=0.40,
+        transient_annotate=0.30, poison_watch_event=0.25,
+    ),
+}
+
+
+def http_storm_profile() -> FaultProfile:
+    """HTTP-seam preset for wire-level tests (kept out of PROFILES: the
+    fake-backend chaos matrix has no HTTP layer to storm)."""
+    return FaultProfile(
+        name="http-storm", http_error=0.25, http_conn_reset=0.05,
+        http_slow=0.10, slow_seconds=0.01, watch_gone=0.10,
+        watch_cut=0.20, watch_malformed=0.10,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP seam
+# ---------------------------------------------------------------------------
+
+
+class _FaultyStream:
+    """Wraps a streamed HTTP response: may cut the stream mid-sequence or
+    inject a garbled line (what a torn chunk looks like to the reader).
+    Faults roll through the owning shim, so flipping its ``enabled`` off
+    also quiets streams that were opened during the storm."""
+
+    def __init__(self, resp, shim: "FaultyHttpClient"):
+        self._resp = resp
+        self._shim = shim
+
+    def __iter__(self):
+        for line in self._resp:
+            if self._shim._roll(self._shim.profile.watch_malformed):
+                self._shim.stats["watch_malformed"] += 1
+                # half a JSON object then EOF: the classic mid-cut shape
+                yield b'{"type": "ADDED", "object": {"metadata": {"na\n'
+                return
+            if self._shim._roll(self._shim.profile.watch_cut):
+                self._shim.stats["watch_cuts"] += 1
+                return
+            yield line
+
+    def close(self) -> None:
+        self._resp.close()
+
+
+class FaultyHttpClient:
+    """Drop-in for restclient._HttpClient with fault injection in front."""
+
+    def __init__(self, inner, profile: FaultProfile,
+                 rng: Optional[random.Random] = None, sleep=time.sleep):
+        self._inner = inner
+        self.profile = profile
+        self.rng = rng or random.Random(0)
+        self._sleep = sleep
+        # mutable holder so for_inner() clones SHARE the switch: flipping
+        # enabled on any shim quiets all of them (and their open streams)
+        self._flags = {"enabled": True}
+        self.stats: Dict[str, int] = {
+            "http_errors": 0, "conn_resets": 0, "slow": 0,
+            "watch_gone": 0, "watch_cuts": 0, "watch_malformed": 0,
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return self._flags["enabled"]
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        self._flags["enabled"] = bool(value)
+
+    def _roll(self, p: float) -> bool:
+        return self.enabled and p > 0 and self.rng.random() < p
+
+    def request(self, method: str, path: str, *, stream: bool = False,
+                **kwargs):
+        from nhd_tpu.k8s.restclient import ApiException
+
+        if stream and "watch=true" in path and self._roll(
+            self.profile.watch_gone
+        ):
+            self.stats["watch_gone"] += 1
+            raise ApiException(status=410, reason="Gone (injected)")
+        if self._roll(self.profile.http_conn_reset):
+            self.stats["conn_resets"] += 1
+            raise ApiException(
+                status=0, reason="Connection reset by peer (injected)"
+            )
+        if self._roll(self.profile.http_error):
+            self.stats["http_errors"] += 1
+            status = self.rng.choice(self.profile.http_statuses)
+            headers = {"Retry-After": "0"} if status == 429 else None
+            raise ApiException(
+                status=status, reason=f"Injected {status}", headers=headers
+            )
+        if self._roll(self.profile.http_slow):
+            self.stats["slow"] += 1
+            self._sleep(self.profile.slow_seconds)
+        resp = self._inner.request(method, path, stream=stream, **kwargs)
+        if stream:
+            return _FaultyStream(resp, self)
+        return resp
+
+    def for_inner(self, inner) -> "FaultyHttpClient":
+        """A sibling shim around another transport, sharing this shim's
+        RNG stream, stats dict, profile and enabled switch."""
+        clone = FaultyHttpClient.__new__(FaultyHttpClient)
+        clone.__dict__.update(self.__dict__)  # _flags shared by reference
+        clone._inner = inner
+        return clone
+
+
+def install_http_faults(
+    backend, profile: FaultProfile, rng: Optional[random.Random] = None
+) -> FaultyHttpClient:
+    """Wrap the restclient HTTP core of a KubeClusterBackend (fallback
+    path only) with fault injection; returns the lead shim so tests can
+    read ``stats``. One seeded RNG + one stats dict span both API objects."""
+    lead = FaultyHttpClient(
+        backend.v1._api._http, profile, rng or random.Random(0)
+    )
+    backend.v1._api._http = lead
+    backend.crd._api._http = lead.for_inner(backend.crd._api._http)
+    return lead
+
+
+# ---------------------------------------------------------------------------
+# backend seam
+# ---------------------------------------------------------------------------
+
+
+class FaultyBackend(ClusterBackend):
+    """ClusterBackend decorator injecting API-level faults.
+
+    Reads delegate untouched; the fault surface is exactly what the
+    recovery machinery claims to absorb: lost watch events (caught by the
+    resync/reconcile nets), poisoned events (caught by the controller's
+    per-event isolation), transient binds (requeued by the scheduler) and
+    transient annotates (retried by the periodic scan). Transient write
+    faults fire at most once per pod so a converged end state stays
+    provable. Unknown attributes delegate to the inner backend, so the
+    fake's simulation controls (create_pod, nodes, pods, fail_bind_for…)
+    stay usable through the wrapper.
+    """
+
+    def __init__(self, inner: ClusterBackend, profile: FaultProfile,
+                 rng: Optional[random.Random] = None):
+        self.inner = inner
+        self.profile = profile
+        self.rng = rng or random.Random(0)
+        self.logger = get_logger(__name__)
+        self.enabled = True
+        self.fault_stats: Dict[str, int] = {
+            "dropped_events": 0, "poisoned_events": 0,
+            "transient_binds": 0, "transient_annotates": 0,
+        }
+        self._bind_faulted: set = set()
+        self._annotate_faulted: set = set()
+
+    def _roll(self, p: float) -> bool:
+        return self.enabled and p > 0 and self.rng.random() < p
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+    # ---- node reads (pass-through) ----
+
+    def get_nodes(self) -> List[str]:
+        return self.inner.get_nodes()
+
+    def is_node_active(self, node: str) -> bool:
+        return self.inner.is_node_active(node)
+
+    def get_node_labels(self, node: str) -> Dict[str, str]:
+        return self.inner.get_node_labels(node)
+
+    def get_node_addr(self, node: str) -> str:
+        return self.inner.get_node_addr(node)
+
+    def get_node_hugepage_resources(self, node: str) -> Tuple[int, int]:
+        return self.inner.get_node_hugepage_resources(node)
+
+    # ---- pod reads (pass-through) ----
+
+    def pod_exists(self, pod: str, ns: str) -> bool:
+        return self.inner.pod_exists(pod, ns)
+
+    def get_pod_node(self, pod: str, ns: str) -> Optional[str]:
+        return self.inner.get_pod_node(pod, ns)
+
+    def get_pod_annotations(self, pod: str, ns: str) -> Optional[Dict[str, str]]:
+        return self.inner.get_pod_annotations(pod, ns)
+
+    def get_cfg_annotations(self, pod: str, ns: str) -> Optional[str]:
+        return self.inner.get_cfg_annotations(pod, ns)
+
+    def get_cfg_type(self, pod: str, ns: str) -> Optional[str]:
+        return self.inner.get_cfg_type(pod, ns)
+
+    def get_pod_node_groups(self, pod: str, ns: str) -> List[str]:
+        return self.inner.get_pod_node_groups(pod, ns)
+
+    def get_requested_pod_resources(self, pod: str, ns: str) -> Dict[str, str]:
+        return self.inner.get_requested_pod_resources(pod, ns)
+
+    def get_scheduled_pods(self, scheduler: str):
+        return self.inner.get_scheduled_pods(scheduler)
+
+    def service_pods(self, scheduler: str):
+        return self.inner.service_pods(scheduler)
+
+    def get_cfg_map(self, pod: str, ns: str):
+        return self.inner.get_cfg_map(pod, ns)
+
+    # ---- writes (fault points) ----
+
+    def add_nad_to_pod(self, pod: str, ns: str, nad: str) -> bool:
+        return self.inner.add_nad_to_pod(pod, ns, nad)
+
+    def annotate_pod_config(self, ns: str, pod: str, cfg: str) -> bool:
+        key = (ns, pod)
+        if key not in self._annotate_faulted and self._roll(
+            self.profile.transient_annotate
+        ):
+            self._annotate_faulted.add(key)
+            self.fault_stats["transient_annotates"] += 1
+            raise TransientBackendError(
+                f"injected transient annotate failure for {ns}/{pod}"
+            )
+        return self.inner.annotate_pod_config(ns, pod, cfg)
+
+    def annotate_pod_gpu_map(self, ns: str, pod: str, gpu_map: Dict[str, int]) -> bool:
+        return self.inner.annotate_pod_gpu_map(ns, pod, gpu_map)
+
+    def bind_pod_to_node(self, pod: str, node: str, ns: str) -> bool:
+        key = (ns, pod)
+        if key not in self._bind_faulted and self._roll(
+            self.profile.transient_bind
+        ):
+            self._bind_faulted.add(key)
+            self.fault_stats["transient_binds"] += 1
+            raise TransientBackendError(
+                f"injected transient bind failure for {ns}/{pod}"
+            )
+        return self.inner.bind_pod_to_node(pod, node, ns)
+
+    def generate_pod_event(
+        self, pod: str, ns: str, reason: str, event_type: EventType,
+        message: str,
+    ) -> None:
+        self.inner.generate_pod_event(pod, ns, reason, event_type, message)
+
+    # ---- watch plane (fault points) ----
+
+    def poll_watch_events(self, timeout: float = 0.0) -> Iterable[WatchEvent]:
+        out: List[WatchEvent] = []
+        for ev in self.inner.poll_watch_events(timeout):
+            if ev.kind in ("pod_create", "pod_delete") and self._roll(
+                self.profile.drop_watch_event
+            ):
+                # silently lost: only the resync/reconcile nets can repair
+                self.fault_stats["dropped_events"] += 1
+                continue
+            out.append(ev)
+        if self._roll(self.profile.poison_watch_event):
+            # an additive malformed event (labels=None trips the node
+            # translator) — never replaces real information, so recovery
+            # is purely the controller's per-event isolation
+            self.fault_stats["poisoned_events"] += 1
+            out.insert(0, WatchEvent(
+                kind="node_update", name="<poisoned>",
+                labels=None, old_labels=None,          # type: ignore[arg-type]
+                taints=None, old_taints=None,          # type: ignore[arg-type]
+            ))
+        return out
+
+    # ---- TriadSets (pass-through) ----
+
+    def list_triadsets(self) -> List[dict]:
+        return self.inner.list_triadsets()
+
+    def list_pods_of_triadset(self, ts: dict) -> List[str]:
+        return self.inner.list_pods_of_triadset(ts)
+
+    def create_pod_for_triadset(self, ts: dict, ordinal: int) -> bool:
+        return self.inner.create_pod_for_triadset(ts, ordinal)
+
+    def update_triadset_status(self, ts: dict, replicas: int) -> bool:
+        return self.inner.update_triadset_status(ts, replicas)

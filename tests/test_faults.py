@@ -1,0 +1,239 @@
+"""API-fault chaos (sim/faults.py + the recovery machinery it exercises).
+
+The acceptance story for the fault-tolerance layer: a seeded ChaosSim run
+with API-fault injection (dropped/poisoned watch events, transient
+bind/annotate failures) must end with zero conservation-invariant
+violations and a converged cluster once the faults stop — while the same
+storm demonstrably kills an unhardened (reference-stance) stack. The
+layer's own counters must be visible through the Prometheus plane.
+"""
+
+import queue
+
+import pytest
+
+from nhd_tpu.k8s.fake import FakeClusterBackend
+from nhd_tpu.k8s.interface import TransientBackendError
+from nhd_tpu.k8s.retry import API_COUNTERS
+from nhd_tpu.rpc.metrics import render_metrics
+from nhd_tpu.scheduler.controller import Controller
+from nhd_tpu.scheduler.core import REQUEUE_MAX, PodStatus, Scheduler
+from nhd_tpu.scheduler.events import WatchQueue
+from nhd_tpu.sim.chaos import ChaosSim
+from nhd_tpu.sim.faults import PROFILES, FaultProfile, FaultyBackend
+from nhd_tpu.sim.synth import SynthNodeSpec, make_node_labels, make_triad_config
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 fault-storm case (fast: one seed, short storm; the full
+# seeds × profiles matrix runs via `make chaos`, tools/chaos_storm.py)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_api_fault_storm_converges():
+    sim = ChaosSim(seed=1, n_nodes=4, api_faults=PROFILES["storm"])
+    stats = sim.run(steps=30)
+    assert stats.violations == []
+    # the storm actually stormed the API layer
+    fs = sim.backend.fault_stats
+    assert fs["dropped_events"] > 0
+    assert fs["poisoned_events"] > 0
+    assert fs["transient_binds"] > 0
+    # faults off → the cluster must converge: invariants still clean and
+    # no pod stranded by an API fault
+    sim.quiesce()
+    assert stats.violations == []
+    assert sim.stuck_pods() == []
+    # backend state == scheduler view
+    bound = {
+        (p.namespace, p.name): p.node
+        for p in sim.backend.pods.values() if p.node
+    }
+    mirrored = {
+        (ns, pod): name
+        for name, node in sim.sched.nodes.items()
+        for (pod, ns) in node.pod_info
+    }
+    assert bound == mirrored
+
+
+def test_chaos_heavy_profile_still_conserves():
+    sim = ChaosSim(seed=5, n_nodes=4, api_faults=PROFILES["heavy"])
+    stats = sim.run(steps=25)
+    sim.quiesce()
+    assert stats.violations == []
+    assert sim.stuck_pods() == []
+
+
+def test_unhardened_stack_dies_in_the_same_storm():
+    """The reference's crash-only stance (no per-event isolation) cannot
+    survive a poisoned watch event: the identical seeded storm that the
+    hardened stack absorbs kills the controller loop."""
+    profile = FaultProfile(name="poison", poison_watch_event=1.0)
+    sim = ChaosSim(seed=1, n_nodes=4, api_faults=profile, hardened=False)
+    with pytest.raises(TypeError):
+        sim.run(steps=10)
+    # sanity: hardened, the same storm is survivable
+    sim2 = ChaosSim(seed=1, n_nodes=4, api_faults=profile, hardened=True)
+    stats = sim2.run(steps=10)
+    assert stats.violations == []
+    assert sim2.backend.fault_stats["poisoned_events"] >= 10
+
+
+def test_fault_counters_visible_via_metrics_plane():
+    API_COUNTERS.reset()
+    sim = ChaosSim(seed=1, n_nodes=4, api_faults=PROFILES["storm"])
+    sim.run(steps=30)
+    sim.quiesce()
+    out = render_metrics([], failed_count=0)
+    # the layer's own observability rides the same exposition format
+    assert "# TYPE nhd_bind_requeues_total counter" in out
+    assert "# TYPE nhd_controller_event_errors_total counter" in out
+    assert "# TYPE nhd_api_circuit_state gauge" in out
+    snap = API_COUNTERS.snapshot()
+    assert snap["bind_requeues_total"] > 0
+    assert snap["controller_event_errors_total"] > 0
+    assert f"nhd_bind_requeues_total {snap['bind_requeues_total']}" in out
+
+
+# ---------------------------------------------------------------------------
+# transient-commit requeue semantics (scheduler/core.py)
+# ---------------------------------------------------------------------------
+
+
+def _stack(n_nodes=2):
+    backend = FakeClusterBackend()
+    for i in range(n_nodes):
+        spec = SynthNodeSpec(name=f"node{i}")
+        backend.add_node(
+            spec.name, make_node_labels(spec), hugepages_gb=spec.hugepages_gb
+        )
+    sched = Scheduler(backend, WatchQueue(), queue.Queue(), respect_busy=False)
+    ctrl = Controller(backend, sched.nqueue)
+    sched.build_initial_node_list()
+    return backend, sched, ctrl
+
+
+def _drive(sched, ctrl, rounds=8):
+    for _ in range(rounds):
+        ctrl.run_once(now=0.0)
+        while not sched.nqueue.empty():
+            sched.run_once()
+
+
+def test_transient_bind_requeues_and_lands():
+    backend, sched, ctrl = _stack()
+    faulty = FaultyBackend(
+        backend, FaultProfile(name="t", transient_bind=1.0)
+    )
+    sched.backend = faulty  # scheduler commits through the fault shim
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    _drive(sched, ctrl)
+    pod = backend.pods[("default", "p1")]
+    assert pod.node is not None              # second attempt bound it
+    assert faulty.fault_stats["transient_binds"] == 1
+    assert sched.failed_schedule_count == 0  # never marked failed
+    assert sched.pod_state[("default", "p1")]["state"] is PodStatus.SCHEDULED
+    assert sched._requeue_attempts == {}     # budget cleared on success
+
+
+def test_requeue_budget_exhaustion_fails_the_pod():
+    """A backend that NEVER stops failing transiently must not spin the
+    scheduler forever: after REQUEUE_MAX requeues the pod takes the
+    terminal-failure path (and the periodic reconcile still owns later
+    retries at its own cadence)."""
+    backend, sched, ctrl = _stack()
+
+    class AlwaysTransient(FaultyBackend):
+        def bind_pod_to_node(self, pod, node, ns):
+            self.fault_stats["transient_binds"] += 1
+            raise TransientBackendError("injected: permanently flaky")
+
+    faulty = AlwaysTransient(backend, FaultProfile(name="t"))
+    sched.backend = faulty
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    _drive(sched, ctrl, rounds=REQUEUE_MAX + 4)
+    assert backend.pods[("default", "p1")].node is None
+    assert sched.pod_state[("default", "p1")]["state"] is PodStatus.FAILED
+    assert sched.failed_schedule_count >= 1
+    # attempts: 1 initial + REQUEUE_MAX requeues, then the budget tripped
+    assert faulty.fault_stats["transient_binds"] == REQUEUE_MAX + 1
+
+
+def test_transient_annotate_also_requeues():
+    backend, sched, ctrl = _stack()
+    faulty = FaultyBackend(
+        backend, FaultProfile(name="t", transient_annotate=1.0)
+    )
+    sched.backend = faulty
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    _drive(sched, ctrl)
+    assert backend.pods[("default", "p1")].node is not None
+    assert faulty.fault_stats["transient_annotates"] == 1
+    assert sched.failed_schedule_count == 0
+
+
+def test_scheduler_loop_survives_backend_outage():
+    """An ApiException that survives the retry layer (outage past the
+    deadline / open circuit) escaping the periodic scan must not kill the
+    scheduler loop; the mirror is rebuilt once the backend recovers."""
+    from nhd_tpu.k8s.restclient import ApiException
+    import nhd_tpu.scheduler.core as core_mod
+
+    API_COUNTERS.reset()
+    backend, sched, ctrl = _stack()
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    _drive(sched, ctrl)
+    assert backend.pods[("default", "p1")].node is not None
+
+    def down(scheduler):
+        raise ApiException(status=0, reason="circuit breaker open")
+
+    backend.service_pods = down  # total outage on the list path
+    # idle path reaches the periodic scan with the backend down — the
+    # pass is isolated instead of propagating out of run_once
+    idle = sched.run_once(idle_count=core_mod.IDLE_CNT_THRESH - 1)
+    assert idle == 0
+    assert API_COUNTERS.get("scheduler_loop_errors_total") == 1
+    assert sched._mirror_dirty is True
+
+    del backend.service_pods  # the API server comes back
+    backend.create_pod("p2", cfg_text=make_triad_config())
+    _drive(sched, ctrl)
+    # the loop kept running, rebuilt the mirror, and scheduling resumed
+    assert backend.pods[("default", "p2")].node is not None
+    assert sched._mirror_dirty is False
+    assert sched.nodes[backend.pods[("default", "p1")].node].pod_present(
+        "p1", "default"
+    )
+
+
+# ---------------------------------------------------------------------------
+# controller event isolation
+# ---------------------------------------------------------------------------
+
+
+def test_poisoned_event_is_isolated_and_counted():
+    API_COUNTERS.reset()
+    backend, sched, ctrl = _stack()
+    faulty = FaultyBackend(
+        backend, FaultProfile(name="p", poison_watch_event=1.0)
+    )
+    ctrl.backend = faulty
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    _drive(sched, ctrl, rounds=2)
+    # the poisoned event was dropped, the real create event still landed
+    assert backend.pods[("default", "p1")].node is not None
+    assert API_COUNTERS.get("controller_event_errors_total") >= 1
+
+
+def test_unisolated_controller_raises():
+    backend, sched, _ = _stack()
+    ctrl = Controller(backend, sched.nqueue, isolate_events=False)
+    faulty = FaultyBackend(
+        backend, FaultProfile(name="p", poison_watch_event=1.0)
+    )
+    ctrl.backend = faulty
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    with pytest.raises(TypeError):
+        ctrl.run_once(now=0.0)

@@ -190,8 +190,11 @@ def backend():
     sys.modules["kubernetes.watch"] = watch_mod
     try:
         from nhd_tpu.k8s.kube import KubeClusterBackend
+        from nhd_tpu.k8s.retry import RetryPolicy
 
-        b = KubeClusterBackend(start_watches=False)
+        b = KubeClusterBackend(start_watches=False, retry_policy=RetryPolicy(
+            base_delay=0.002, max_delay=0.01, exc_class=ApiException
+        ))
         b._test_state = state
         yield b
     finally:
@@ -313,11 +316,21 @@ def test_annotation_round_trip(backend):
 
 
 def test_annotation_failure_injection(backend):
+    """A persistent 500 on the patch path is a *transient* (server-health)
+    failure: once the retry policy gives up it surfaces as
+    TransientBackendError so the scheduler requeues the pod instead of
+    failing it. A missing pod (404) stays a plain False."""
+    from nhd_tpu.k8s.interface import TransientBackendError
+
     s = backend._test_state
     s["pods"][("default", "p1")] = _pod("p1")
     s["fail_patch"].add(("default", "p1"))
-    assert not backend.annotate_pod_config("default", "p1", "cfg")
-    assert not backend.add_nad_to_pod("p1", "default", "x@x")
+    with pytest.raises(TransientBackendError):
+        backend.annotate_pod_config("default", "p1", "cfg")
+    with pytest.raises(TransientBackendError):
+        backend.add_nad_to_pod("p1", "default", "x@x")
+    # terminal: patching a pod that doesn't exist returns False
+    assert not backend.annotate_pod_config("default", "ghost", "cfg")
 
 
 def test_bind_swallows_client_valueerror(backend):

@@ -1,0 +1,370 @@
+"""Fault-tolerance of the real HTTP path (restclient + kube over the stub
+API server): retry absorption of injected 5xx, watch hung-socket and
+malformed-line recovery, 410-replay dedupe, the full-relist resync net,
+and the FaultyHttpClient storm shim end-to-end."""
+
+import random
+import sys
+import time
+
+import pytest
+
+from nhd_tpu.k8s.apistub import StubApiServer, make_node, make_pod
+from nhd_tpu.k8s.retry import API_COUNTERS
+from nhd_tpu.sim.faults import FaultProfile, install_http_faults
+
+
+class _BlockKubernetesImport:
+    def find_spec(self, name, path=None, target=None):
+        if name == "kubernetes" or name.startswith("kubernetes."):
+            raise ImportError("kubernetes blocked: restclient contract test")
+        return None
+
+
+@pytest.fixture()
+def stub(monkeypatch):
+    monkeypatch.delitem(sys.modules, "kubernetes", raising=False)
+    blocker = _BlockKubernetesImport()
+    sys.meta_path.insert(0, blocker)
+    srv = StubApiServer().start()
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "127.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", str(srv.port))
+    monkeypatch.setenv("KUBERNETES_SERVICE_SCHEME", "http")
+    monkeypatch.setenv("NHD_K8S_TOKEN_FILE", "/nonexistent-token")
+    try:
+        yield srv
+    finally:
+        sys.meta_path.remove(blocker)
+        srv.stop()
+
+
+def _backend(**kw):
+    from nhd_tpu.k8s.kube import KubeClusterBackend
+    from nhd_tpu.k8s.restclient import ApiException
+    from nhd_tpu.k8s.retry import RetryPolicy
+
+    kw.setdefault("resync_interval", 0)  # resync driven by hand in tests
+    # real retry semantics, millisecond backoff (suite wall-clock)
+    kw.setdefault("retry_policy", RetryPolicy(
+        base_delay=0.002, max_delay=0.01, exc_class=ApiException
+    ))
+    return KubeClusterBackend(start_watches=False, **kw)
+
+
+# ---------------------------------------------------------------------------
+# retry over the wire
+# ---------------------------------------------------------------------------
+
+
+def test_retry_absorbs_transient_503s(stub):
+    stub.add_node("n1")
+    b = _backend()
+    before = API_COUNTERS.get("api_retries_total")
+    stub.fail_gets = 2          # next two GETs answer 503
+    assert b.get_nodes() == ["n1"]
+    assert API_COUNTERS.get("api_retries_total") >= before + 2
+    # three GETs total hit the wire for the one logical call
+    assert len([r for r in stub.requests if r[0] == "GET"]) >= 3
+
+
+def test_outage_reads_raise_transient_not_missing(stub):
+    """When the retry budget is spent on a *retryable* failure, reads
+    raise TransientBackendError: 'server unavailable' must never
+    masquerade as 'pod does not exist' (which would mass-fail healthy
+    pods with FailedCfgParse during an outage). A genuine 404 still
+    reads as missing."""
+    from nhd_tpu.k8s.interface import TransientBackendError
+
+    stub.add_pod("p1")
+    b = _backend()
+    assert b.pod_exists("p1", "default") is True
+    stub.fail_gets = 99
+    with pytest.raises(TransientBackendError):
+        b.pod_exists("p1", "default")
+    stub.fail_gets = 0
+    assert b.pod_exists("p1", "default") is True
+    assert b.pod_exists("ghost", "default") is False  # real 404
+
+
+# ---------------------------------------------------------------------------
+# watch: hung socket + malformed lines (the two satellite hazards)
+# ---------------------------------------------------------------------------
+
+
+def test_hung_watch_ends_stream_instead_of_blocking(stub, monkeypatch):
+    """timeout=None used to park the watch thread on a dead socket
+    forever; the finite read timeout must end the stream normally so the
+    reconnect loop takes over."""
+    from nhd_tpu.k8s import restclient
+
+    monkeypatch.setattr(restclient, "_WATCH_READ_TIMEOUT", 0.3)
+    restclient._set_config(
+        restclient.Configuration(f"http://127.0.0.1:{stub.port}")
+    )
+    api = restclient.CoreV1Api()
+    stub.queue_watch_event("/api/v1/pods", "ADDED", make_pod("w1"))
+    stub.watch_hang = 30.0      # stream stays open and silent after w1
+    w = restclient.Watch()
+    t0 = time.monotonic()
+    events = list(w.stream(api.list_pod_for_all_namespaces))
+    elapsed = time.monotonic() - t0
+    # the queued event arrived, then the dead socket timed out quickly —
+    # no exception escaped the generator, the caller just reconnects
+    assert [e["object"].metadata.name for e in events] == ["w1"]
+    assert elapsed < 5.0
+
+
+def test_malformed_watch_line_drops_and_ends_stream(stub):
+    from nhd_tpu.k8s import restclient
+
+    restclient._set_config(
+        restclient.Configuration(f"http://127.0.0.1:{stub.port}")
+    )
+    api = restclient.CoreV1Api()
+    good = make_pod("w1", uid="uid-w1")
+    good["metadata"]["resourceVersion"] = "7"
+    stub.queue_watch_event("/api/v1/pods", "ADDED", good)
+    stub.queue_watch_raw("/api/v1/pods", b'{"type": "ADDED", "obj\n')
+    before = API_COUNTERS.get("watch_malformed_lines_total")
+    w = restclient.Watch()
+    events = list(w.stream(api.list_pod_for_all_namespaces))
+    # events before the garbage arrive; the garbled line is dropped and
+    # the stream ends normally — no JSONDecodeError out of the generator
+    assert [e["object"].metadata.name for e in events] == ["w1"]
+    assert API_COUNTERS.get("watch_malformed_lines_total") == before + 1
+    # the reconnect works and resumes from the last GOOD resourceVersion
+    stub.queue_watch_event("/api/v1/pods", "ADDED", make_pod("w2"))
+    events = list(w.stream(api.list_pod_for_all_namespaces))
+    assert [e["object"].metadata.name for e in events] == ["w2"]
+    watch_paths = [p for (m, p, _, _) in stub.requests if "watch=true" in p]
+    assert watch_paths[-1].endswith("resourceVersion=7")
+
+
+# ---------------------------------------------------------------------------
+# 410 full-replay dedupe (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def test_410_replay_does_not_double_emit_pod_create(stub):
+    """After a 410 Gone the fresh watch re-delivers ADDED for every live
+    object; the backend must upsert, not re-emit pod_create."""
+    b = _backend()
+    b._watch_backoff = 0.05
+    b._start_watches()
+    try:
+        pod = make_pod("w1", uid="uid-w1",
+                       annotations={"sigproc.viasat.io/cfg_type": "triad"})
+        stub.queue_watch_event("/api/v1/pods", "ADDED", pod)
+        deadline = time.time() + 5
+        creates = []
+        while time.time() < deadline and not creates:
+            creates += [e for e in b.poll_watch_events(timeout=0.1)
+                        if e.kind == "pod_create"]
+        assert len(creates) == 1
+
+        # the stub replays the same ADDED on the next connection — the
+        # full-replay shape a post-410 watch produces
+        before = API_COUNTERS.get("watch_dedup_replays_total")
+        stub.queue_watch_event("/api/v1/pods", "ADDED", pod)
+        deadline = time.time() + 3
+        while (time.time() < deadline
+               and API_COUNTERS.get("watch_dedup_replays_total") == before):
+            creates += [e for e in b.poll_watch_events(timeout=0.1)
+                        if e.kind == "pod_create"]
+        assert API_COUNTERS.get("watch_dedup_replays_total") == before + 1
+        assert len(creates) == 1            # still exactly one emission
+
+        # a genuinely NEW incarnation (same name, new uid) does emit
+        stub.queue_watch_event(
+            "/api/v1/pods", "ADDED", make_pod("w1", uid="uid-w1-reborn")
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline and len(creates) < 2:
+            creates += [e for e in b.poll_watch_events(timeout=0.1)
+                        if e.kind == "pod_create"]
+        assert len(creates) == 2
+        assert creates[-1].uid == "uid-w1-reborn"
+    finally:
+        b.stop_watches()
+
+
+# ---------------------------------------------------------------------------
+# resync: the net under the watch plane
+# ---------------------------------------------------------------------------
+
+
+def test_inband_error_event_is_contained(stub):
+    """An in-band ERROR watch event carries a Status, not a Pod: it must
+    never be dereferenced as a pod, and it clears the tracked
+    resourceVersion so the reconnect starts a fresh watch instead of
+    replaying the same expired RV forever."""
+    b = _backend()
+    # the Status object would raise on any pod-shaped attribute access
+    assert b._note_pod("ERROR", object()) is None
+    assert b._note_pod("BOOKMARK", object()) is None
+
+    class W:
+        resource_version = "42"
+
+    w = W()
+    assert b._watch_error(w, {"type": "ERROR", "object": {}}) is True
+    assert w.resource_version is None
+    assert b._watch_error(w, {"type": "ADDED", "object": {}}) is False
+
+
+def test_modified_for_unknown_pod_emits_the_missed_create(stub):
+    """A MODIFIED for a pod we never saw ADDED means the create event was
+    lost upstream — it must surface as pod_create, not silently mark the
+    pod 'known' (which would also stop resync from ever repairing it)."""
+    from nhd_tpu.k8s import restclient
+
+    b = _backend()
+    obj = restclient._wrap(make_pod("p1", uid="u1"))
+    ev = b._note_pod("MODIFIED", obj)
+    assert ev is not None and ev.kind == "pod_create" and ev.uid == "u1"
+    # a second MODIFIED for the now-known pod is state-only
+    assert b._note_pod("MODIFIED", obj) is None
+
+
+def test_resync_emits_missed_create_and_delete(stub):
+    b = _backend()
+    # p1 appears with NO watch event delivered (stream was down)
+    stub.add_pod("p1", uid="uid-p1",
+                 annotations={"sigproc.viasat.io/cfg_type": "triad"})
+    b.resync()
+    evs = list(b.poll_watch_events())
+    creates = [e for e in evs if e.kind == "pod_create"]
+    assert [(e.namespace, e.name, e.uid) for e in creates] == [
+        ("default", "p1", "uid-p1")
+    ]
+    assert creates[0].annotations == {"sigproc.viasat.io/cfg_type": "triad"}
+
+    # steady state: nothing changed → nothing emitted
+    b.resync()
+    assert list(b.poll_watch_events()) == []
+
+    # p1 vanishes, again with no watch event
+    del stub.pods[("default", "p1")]
+    b.resync()
+    evs = list(b.poll_watch_events())
+    deletes = [e for e in evs if e.kind == "pod_delete"]
+    assert [(e.namespace, e.name, e.uid) for e in deletes] == [
+        ("default", "p1", "uid-p1")
+    ]
+    # the synthetic delete carries the last-seen annotations (release
+    # path needs them after the object is gone)
+    assert deletes[0].annotations == {"sigproc.viasat.io/cfg_type": "triad"}
+
+
+def test_resync_catches_delete_recreate_aliasing(stub):
+    b = _backend()
+    stub.add_pod("p1", uid="uid-old")
+    b.resync()
+    b.poll_watch_events()
+    # delete + recreate under the same name while the watch was blind
+    stub.add_pod("p1", uid="uid-new")
+    b.resync()
+    kinds = [(e.kind, e.uid) for e in b.poll_watch_events()]
+    assert kinds == [("pod_delete", "uid-old"), ("pod_create", "uid-new")]
+
+
+def test_resync_does_not_override_fresher_watch_state(stub):
+    """A pod created while resync's relist is in flight is in the watch
+    state but not in the (stale) listing — resync must NOT emit a
+    spurious synthetic delete for it (the touch-sequence guard)."""
+    from nhd_tpu.k8s import restclient
+
+    b = _backend()
+    stub.add_pod("p1", uid="u1")
+    b.resync()
+    b.poll_watch_events()  # baseline established
+
+    real_list = b.v1._api.list_pod_for_all_namespaces
+
+    def list_with_mid_flight_create(*a, **kw):
+        resp = real_list(*a, **kw)          # stale: p2 not in it
+        # the watch delivers p2's ADDED while the listing is in flight
+        b._note_pod("ADDED", restclient._wrap(make_pod("p2", uid="u2")))
+        return resp
+
+    b.v1._wrapped["list_pod_for_all_namespaces"] = list_with_mid_flight_create
+    b.resync()
+    evs = list(b.poll_watch_events())
+    assert not any(
+        e.kind == "pod_delete" and e.name == "p2" for e in evs
+    ), "resync deleted a pod the watch had just created"
+    assert ("default", "p2") in b._known_pods
+
+
+def test_resync_emits_missed_node_changes(stub):
+    b = _backend()
+    stub.add_node("n1")
+    b.resync()
+    assert [e.kind for e in b.poll_watch_events()] == []  # baseline only
+    # cordon happens while the node watch is blind
+    stub.nodes["n1"]["spec"]["unschedulable"] = True
+    before = API_COUNTERS.get("resync_synthetic_events_total")
+    b.resync()
+    evs = [e for e in b.poll_watch_events() if e.kind == "node_update"]
+    assert len(evs) == 1
+    assert evs[0].unschedulable is True and evs[0].was_unschedulable is False
+    assert API_COUNTERS.get("resync_synthetic_events_total") == before + 1
+    # steady state again
+    b.resync()
+    assert [e for e in b.poll_watch_events() if e.kind == "node_update"] == []
+
+
+# ---------------------------------------------------------------------------
+# the HTTP fault shim end-to-end: storm in front, clean API behind
+# ---------------------------------------------------------------------------
+
+
+def test_http_fault_storm_absorbed_by_retry_layer(stub):
+    stub.add_node("n1")
+    stub.add_pod("p1")
+    b = _backend()
+    shim = install_http_faults(
+        b,
+        FaultProfile(name="t", http_error=0.4, http_conn_reset=0.1),
+        random.Random(3),
+    )
+    # every logical call must succeed despite the storm (seeded, so the
+    # injected fault sequence is fixed)
+    for _ in range(10):
+        assert b.get_nodes() == ["n1"]
+        assert b.pod_exists("p1", "default") is True
+    assert shim.stats["http_errors"] + shim.stats["conn_resets"] > 0
+
+
+def test_watch_cut_recovers_via_resync(stub):
+    """Mid-stream cuts LOSE events (the stub, like a real API server,
+    doesn't replay what it already sent); the resync net must repair the
+    gap from a full relist."""
+    b = _backend()
+    shim = install_http_faults(
+        b, FaultProfile(name="t", watch_cut=0.5), random.Random(11)
+    )
+    b._watch_backoff = 0.05
+    b._start_watches()
+    try:
+        for i in range(4):
+            # the pod exists AND a watch event is queued — cut streams
+            # may drop the event, but the relist always sees the pod
+            stub.add_pod(f"w{i}", uid=f"uid-{i}")
+            stub.queue_watch_event(
+                "/api/v1/pods", "ADDED", make_pod(f"w{i}", uid=f"uid-{i}")
+            )
+        seen = set()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(seen) < 4:
+            for e in b.poll_watch_events(timeout=0.1):
+                if e.kind == "pod_create":
+                    seen.add(e.name)
+            if len(seen) < 4:
+                shim.enabled = False      # storm over; relist runs clean
+                b.resync()
+                shim.enabled = True
+        assert seen == {"w0", "w1", "w2", "w3"}
+        assert shim.stats["watch_cuts"] >= 1
+    finally:
+        b.stop_watches()

@@ -57,7 +57,13 @@ def stub(monkeypatch):
 
 def _backend(**kw):
     from nhd_tpu.k8s.kube import KubeClusterBackend
+    from nhd_tpu.k8s.restclient import ApiException
+    from nhd_tpu.k8s.retry import RetryPolicy
 
+    # real retry semantics, millisecond backoff (suite wall-clock)
+    kw.setdefault("retry_policy", RetryPolicy(
+        base_delay=0.002, max_delay=0.01, exc_class=ApiException
+    ))
     return KubeClusterBackend(start_watches=False, **kw)
 
 
@@ -155,11 +161,19 @@ def test_nad_and_gpu_map_round_trip(stub):
     assert annots["sigproc.viasat.io/nhd_gpu_devices.nvidia0"] == "1"
 
 
-def test_patch_failure_returns_false(stub):
+def test_patch_failure_raises_transient(stub):
+    """A persistent 500 from the API server exhausts the retry policy and
+    surfaces as TransientBackendError (scheduler requeues the pod); a 404
+    — terminal — still returns False."""
+    from nhd_tpu.k8s.interface import TransientBackendError
+
     stub.add_pod("p1")
     stub.fail_patches = True
     b = _backend()
-    assert b.annotate_pod_config("default", "p1", "cfg") is False
+    with pytest.raises(TransientBackendError):
+        b.annotate_pod_config("default", "p1", "cfg")
+    stub.fail_patches = False
+    assert b.annotate_pod_config("default", "ghost", "cfg") is False
 
 
 # ---------------------------------------------------------------------------

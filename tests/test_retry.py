@@ -1,0 +1,387 @@
+"""RetryPolicy unit tests (k8s/retry.py): failure classification, backoff
+jitter bounds, per-call deadlines, Retry-After, and the circuit breaker's
+open→half-open→close lifecycle — all against an injected clock and
+recorded sleeps, zero real waiting."""
+
+import random
+
+import pytest
+
+from nhd_tpu.k8s.restclient import ApiException
+from nhd_tpu.k8s.retry import (
+    CIRCUIT_CLOSED,
+    CIRCUIT_HALF_OPEN,
+    CIRCUIT_OPEN,
+    ApiCounters,
+    CircuitOpenError,
+    RetryingApi,
+    RetryPolicy,
+    classify,
+    retryable,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, seconds):
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def make_policy(**kw):
+    clock = FakeClock()
+    counters = ApiCounters()
+    kw.setdefault("rng", random.Random(7))
+    policy = RetryPolicy(
+        clock=clock, sleep=clock.sleep, counters=counters, **kw
+    )
+    return policy, clock, counters
+
+
+class Flaky:
+    """Fails with the given exceptions in order, then returns 'ok'."""
+
+    def __init__(self, *excs):
+        self.excs = list(excs)
+        self.calls = 0
+
+    def __call__(self, *a, **kw):
+        self.calls += 1
+        if self.excs:
+            raise self.excs.pop(0)
+        return "ok"
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("status,want", [
+    (429, True), (500, True), (502, True), (503, True), (504, True),
+    (0, True),                       # restclient maps URLError to status-0
+    (400, False), (403, False), (404, False), (409, False), (410, False),
+    (501, False),                    # Not Implemented never improves
+])
+def test_classify_by_status(status, want):
+    assert retryable(ApiException(status=status, reason="x")) is want
+
+
+def test_classify_statusless_network_error_is_retryable():
+    # the real kubernetes client raises bare network exceptions with no
+    # .status attribute at all
+    assert retryable(ConnectionResetError("peer reset")) is True
+
+
+def test_classify_clientside_bug_is_terminal():
+    # statusless exceptions are only retryable when they are genuine
+    # transport failures; a deterministic client-side bug must surface
+    # immediately instead of burning backoff and feeding the breaker
+    assert retryable(TypeError("unexpected keyword argument")) is False
+    assert retryable(KeyError("missing")) is False
+    assert retryable(AttributeError("nope")) is False
+
+
+def test_classify_valueerror_is_terminal():
+    # the V1Binding deserialization quirk: a ValueError after a 2xx MEANS
+    # SUCCESS and must reach the caller untouched (K8SMgr.py:487-491)
+    assert retryable(ValueError("Invalid value for `target`")) is False
+
+
+def test_classify_429_retry_after_header():
+    exc = ApiException(status=429, reason="TooManyRequests",
+                       headers={"Retry-After": "1.5"})
+    assert classify(exc) == (True, 1.5)
+
+
+def test_classify_retry_after_garbage_ignored():
+    exc = ApiException(status=429, reason="x",
+                       headers={"Retry-After": "Wed, 21 Oct"})
+    assert classify(exc) == (True, None)
+
+
+# ---------------------------------------------------------------------------
+# the call loop
+# ---------------------------------------------------------------------------
+
+
+def test_success_passes_through():
+    policy, clock, counters = make_policy()
+    assert policy.call(lambda: 42) == 42
+    assert clock.sleeps == []
+    assert counters.get("api_calls_total") == 1
+
+
+def test_transient_failures_then_success():
+    policy, clock, counters = make_policy(attempts=4)
+    fn = Flaky(ApiException(status=503), ApiException(status=500))
+    assert policy.call(fn) == "ok"
+    assert fn.calls == 3
+    assert len(clock.sleeps) == 2
+    assert counters.get("api_retries_total") == 2
+    assert counters.get("api_giveups_total") == 0
+
+
+def test_terminal_failure_raises_immediately():
+    policy, clock, _ = make_policy()
+    fn = Flaky(ApiException(status=404, reason="NotFound"))
+    with pytest.raises(ApiException) as ei:
+        policy.call(fn)
+    assert ei.value.status == 404
+    assert fn.calls == 1 and clock.sleeps == []
+
+
+def test_valueerror_propagates_and_counts_as_success():
+    policy, _, _ = make_policy(breaker_threshold=1)
+    with pytest.raises(ValueError):
+        policy.call(Flaky(ValueError("quirk")))
+    # the wire call succeeded: the breaker must not have moved
+    assert policy.circuit_state == CIRCUIT_CLOSED
+
+
+def test_attempt_budget_exhaustion():
+    policy, clock, counters = make_policy(attempts=3)
+    fn = Flaky(*[ApiException(status=503)] * 10)
+    with pytest.raises(ApiException):
+        policy.call(fn)
+    assert fn.calls == 3                       # 1 try + 2 retries
+    assert counters.get("api_giveups_total") == 1
+
+
+def test_deadline_expiry_stops_retries():
+    # deadline shorter than one backoff step: a single failure gives up
+    # even though the attempt budget would allow more
+    policy, clock, counters = make_policy(
+        attempts=100, base_delay=1.0, max_delay=1.0, deadline=0.5
+    )
+    fn = Flaky(*[ApiException(status=503)] * 10)
+    with pytest.raises(ApiException):
+        policy.call(fn)
+    assert fn.calls == 1
+    assert counters.get("api_giveups_total") == 1
+
+
+def test_jitter_bounds_seeded():
+    # decorrelated jitter: every sleep within [base, cap], reproducible
+    # for a fixed seed
+    policy, clock, _ = make_policy(
+        attempts=6, base_delay=0.1, max_delay=2.0, deadline=1e9,
+        rng=random.Random(42),
+    )
+    fn = Flaky(*[ApiException(status=500)] * 5)
+    assert policy.call(fn) == "ok"
+    assert len(clock.sleeps) == 5
+    for s in clock.sleeps:
+        assert 0.1 <= s <= 2.0
+    # and the sequence is deterministic for the seed
+    policy2, clock2, _ = make_policy(
+        attempts=6, base_delay=0.1, max_delay=2.0, deadline=1e9,
+        rng=random.Random(42),
+    )
+    policy2.call(Flaky(*[ApiException(status=500)] * 5))
+    assert clock2.sleeps == clock.sleeps
+
+
+def test_retry_after_floors_the_backoff():
+    policy, clock, _ = make_policy(
+        attempts=2, base_delay=0.01, max_delay=5.0, deadline=1e9
+    )
+    fn = Flaky(ApiException(status=429, headers={"Retry-After": "1.25"}))
+    assert policy.call(fn) == "ok"
+    assert clock.sleeps[0] >= 1.25
+
+
+def test_retry_after_beyond_max_delay_is_honored():
+    """A throttling server's Retry-After wins over max_delay (re-hitting
+    inside the window it asked us to stay away defeats the point); only
+    the per-call deadline bounds it."""
+    policy, clock, _ = make_policy(
+        attempts=3, base_delay=0.01, max_delay=2.0, deadline=60.0
+    )
+    fn = Flaky(ApiException(status=429, headers={"Retry-After": "10"}))
+    assert policy.call(fn) == "ok"
+    assert clock.sleeps[0] >= 10.0
+
+
+def test_half_open_wedged_probe_times_out():
+    """If the half-open probe never reports back (hung socket, thread
+    unwound by BaseException), a fresh probe is admitted after another
+    cooldown instead of rejecting everyone forever."""
+    policy, clock, _ = make_policy(
+        attempts=1, breaker_threshold=1, breaker_cooldown=10.0
+    )
+    with pytest.raises(ApiException):
+        policy.call(Flaky(ApiException(status=500)))
+    clock.advance(10.1)
+    assert policy._admit() is True       # probe 1 admitted… and vanishes
+    assert policy._admit() is False      # still in flight: others wait
+    clock.advance(10.1)
+    assert policy._admit() is True       # presumed dead: new probe
+    assert policy.circuit_state == CIRCUIT_HALF_OPEN
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_opens_after_consecutive_failures():
+    policy, clock, counters = make_policy(
+        attempts=1, breaker_threshold=3, breaker_cooldown=30.0
+    )
+    for _ in range(3):
+        with pytest.raises(ApiException):
+            policy.call(Flaky(ApiException(status=503)))
+    assert policy.circuit_state == CIRCUIT_OPEN
+    assert counters.get("api_circuit_open_total") == 1
+    # while open: instant rejection, the function never runs
+    fn = Flaky()
+    with pytest.raises(CircuitOpenError):
+        policy.call(fn)
+    assert fn.calls == 0
+    assert counters.get("api_circuit_rejections_total") == 1
+
+
+def test_circuit_half_opens_after_cooldown_and_closes_on_success():
+    policy, clock, _ = make_policy(
+        attempts=1, breaker_threshold=2, breaker_cooldown=10.0
+    )
+    for _ in range(2):
+        with pytest.raises(ApiException):
+            policy.call(Flaky(ApiException(status=500)))
+    assert policy.circuit_state == CIRCUIT_OPEN
+    clock.advance(10.1)
+    # the probe is admitted and succeeds → closed
+    assert policy.call(Flaky()) == "ok"
+    assert policy.circuit_state == CIRCUIT_CLOSED
+
+
+def test_half_open_probe_failure_reopens():
+    policy, clock, counters = make_policy(
+        attempts=1, breaker_threshold=2, breaker_cooldown=10.0
+    )
+    for _ in range(2):
+        with pytest.raises(ApiException):
+            policy.call(Flaky(ApiException(status=500)))
+    clock.advance(10.1)
+    with pytest.raises(ApiException):
+        policy.call(Flaky(ApiException(status=502)))
+    assert policy.circuit_state == CIRCUIT_OPEN
+    assert counters.get("api_circuit_open_total") == 2
+    # and the cooldown restarted: still rejecting before it lapses
+    clock.advance(5.0)
+    with pytest.raises(CircuitOpenError):
+        policy.call(Flaky())
+
+
+def test_half_open_admits_exactly_one_probe():
+    policy, clock, _ = make_policy(
+        attempts=1, breaker_threshold=1, breaker_cooldown=10.0
+    )
+    with pytest.raises(ApiException):
+        policy.call(Flaky(ApiException(status=500)))
+    clock.advance(10.1)
+    assert policy._admit() is True          # the probe slot
+    assert policy.circuit_state == CIRCUIT_HALF_OPEN
+    assert policy._admit() is False         # everyone else waits
+
+
+def test_half_open_probe_with_terminal_error_closes_the_circuit():
+    """A terminal 4xx IS a server response: a half-open probe answered
+    404 proves the server is back and must close the breaker, not wedge
+    it in HALF_OPEN rejecting every later call."""
+    policy, clock, _ = make_policy(
+        attempts=1, breaker_threshold=2, breaker_cooldown=10.0
+    )
+    for _ in range(2):
+        with pytest.raises(ApiException):
+            policy.call(Flaky(ApiException(status=500)))
+    assert policy.circuit_state == CIRCUIT_OPEN
+    clock.advance(10.1)
+    # the probe reaches the server, which answers 404 (terminal)
+    with pytest.raises(ApiException):
+        policy.call(Flaky(ApiException(status=404)))
+    assert policy.circuit_state == CIRCUIT_CLOSED
+    # and ordinary calls flow again
+    assert policy.call(Flaky()) == "ok"
+
+
+def test_terminal_failures_do_not_feed_the_breaker():
+    policy, clock, _ = make_policy(attempts=1, breaker_threshold=2)
+    for _ in range(10):
+        with pytest.raises(ApiException):
+            policy.call(Flaky(ApiException(status=404)))
+    assert policy.circuit_state == CIRCUIT_CLOSED
+
+
+def test_open_circuit_uses_wired_exception_class():
+    class MyExc(Exception):
+        def __init__(self, status=0, reason=""):
+            self.status, self.reason = status, reason
+
+    policy, clock, _ = make_policy(
+        attempts=1, breaker_threshold=1, exc_class=MyExc
+    )
+    with pytest.raises(ApiException):
+        policy.call(Flaky(ApiException(status=500)))
+    with pytest.raises(MyExc):
+        policy.call(Flaky())
+
+
+# ---------------------------------------------------------------------------
+# RetryingApi proxy
+# ---------------------------------------------------------------------------
+
+
+class _Api:
+    def __init__(self):
+        self.fail_reads = 0
+        self.watch_calls = 0
+
+    def read_thing(self):
+        if self.fail_reads:
+            self.fail_reads -= 1
+            raise ApiException(status=503)
+        return "thing"
+
+    def list_thing(self, watch=False):
+        if watch:
+            self.watch_calls += 1
+            raise ApiException(status=503)
+        return ["thing"]
+
+    not_callable = "just-data"
+
+
+def test_retrying_api_wraps_calls():
+    policy, clock, counters = make_policy(attempts=3)
+    api = RetryingApi(_Api(), policy)
+    api._api.fail_reads = 2
+    assert api.read_thing() == "thing"
+    assert counters.get("api_retries_total") == 2
+
+
+def test_retrying_api_passes_watch_through():
+    # the watch plane owns its own reconnect backoff; the policy must not
+    # double-retry stream establishment
+    policy, clock, counters = make_policy(attempts=5)
+    api = RetryingApi(_Api(), policy)
+    with pytest.raises(ApiException):
+        api.list_thing(watch=True)
+    assert api._api.watch_calls == 1
+    assert clock.sleeps == []
+
+
+def test_retrying_api_exposes_data_attributes():
+    policy, _, _ = make_policy()
+    api = RetryingApi(_Api(), policy)
+    assert api.not_callable == "just-data"

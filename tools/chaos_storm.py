@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Fault-storm matrix: N seeds × fault profiles of chaos WITH API-layer
+fault injection (sim/faults.py) on the fake backend.
+
+Every cell runs the full churn storm under the chosen fault profile, then
+quiesces and checks the crash-only recovery claim: zero conservation-
+invariant violations AND no pod left stranded by an API fault
+(ChaosSim.stuck_pods()). This is the reproducible command behind
+docs/RESILIENCE.md; CI runs the one-seed fast cell in
+tests/test_faults.py.
+
+    make chaos                         # 6 seeds x {light,storm,heavy}
+    make chaos CHAOS_SEEDS=25          # wider sweep
+    python tools/chaos_storm.py --profiles heavy --seeds 50 --steps 120
+
+Exit status is non-zero on the first failing cell; the seed and profile
+are printed so the failure replays with
+``ChaosSim(seed=<seed>, n_nodes=<n>, api_faults=PROFILES[<profile>])``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+# host-side loop; keep jax off the TPU tunnel (see tools/soak.py for why
+# the env var alone is not enough on this image)
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nhd_tpu.utils import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=6,
+                    help="seeds per profile (default 6)")
+    ap.add_argument("--steps", type=int, default=60,
+                    help="churn steps per run (default 60)")
+    ap.add_argument("--nodes", type=int, default=4,
+                    help="cluster size per run (default 4)")
+    ap.add_argument("--profiles", default="light,storm,heavy",
+                    help="comma-separated profile names (sim/faults.py "
+                         "PROFILES; default light,storm,heavy)")
+    ap.add_argument("--start-seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import PROFILES
+
+    profiles = [p.strip() for p in args.profiles.split(",") if p.strip()]
+    for p in profiles:
+        if p not in PROFILES:
+            print(f"unknown profile {p!r}; have {sorted(PROFILES)}")
+            return 2
+
+    t0 = time.time()
+    cells = 0
+    for profile in profiles:
+        totals = {"dropped_events": 0, "poisoned_events": 0,
+                  "transient_binds": 0, "transient_annotates": 0}
+        for seed in range(args.start_seed, args.start_seed + args.seeds):
+            faults = PROFILES[profile] if profile != "none" else None
+            sim = ChaosSim(seed=seed, n_nodes=args.nodes, api_faults=faults)
+            stats = sim.run(steps=args.steps)
+            sim.quiesce()
+            stuck = sim.stuck_pods()
+            if stats.violations or stuck:
+                print(f"CHAOS FAIL profile={profile} seed={seed} "
+                      f"nodes={args.nodes} steps={args.steps}:")
+                for v in stats.violations:
+                    print(f"  violation: {v}")
+                for key in stuck:
+                    print(f"  stuck pod: {key}")
+                return 1
+            if faults is not None:
+                for k in totals:
+                    totals[k] += sim.backend.fault_stats[k]
+            cells += 1
+        print(f"profile {profile:>6}: {args.seeds} seeds clean "
+              f"(faults injected: {totals})")
+    print(f"chaos matrix OK: {cells} cells "
+          f"({len(profiles)} profiles x {args.seeds} seeds, "
+          f"{args.steps} steps) in {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
