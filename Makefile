@@ -9,7 +9,7 @@ SOAK_STEPS ?= 120
 CHAOS_SEEDS ?= 6
 CHAOS_STEPS ?= 60
 
-.PHONY: test lint proto bench wheel clean native soak chaos docker docker-smoke release
+.PHONY: test lint proto bench wheel clean native soak chaos trace-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -61,6 +61,11 @@ soak:
 # profiles (docs/RESILIENCE.md; CI runs the fast cell in tests/test_faults.py)
 chaos:
 	python tools/chaos_storm.py --seeds $(CHAOS_SEEDS) --steps $(CHAOS_STEPS)
+
+# flight-recorder demo: run the sim with tracing on, dump the Chrome
+# trace, validate its schema + per-pod span pipeline (docs/OBSERVABILITY.md)
+trace-demo:
+	python tools/trace_demo.py
 
 # container image + in-container smoke test (reference: Makefile:244-252;
 # no registry push here — zero-egress environment, tag locally instead)

@@ -290,9 +290,18 @@ def bench_daemon(n_pods: int = 150) -> None:
     — on the fake backend, with pods arriving through the WATCH QUEUE
     (not a direct attempt_scheduling_batch call, which is what
     bench[bind-latency] measures). Reports measured create→bind
-    p50/p99 plus the nhd_last_bind_p99_seconds Prometheus gauge
-    scraped from the live /metrics endpoint."""
+    p50/p99 plus a p99 upper bound read from the live /metrics
+    nhd_bind_latency_seconds histogram (which replaced the lossy
+    last_* gauges — PR 3)."""
+    import re
     import urllib.request
+
+    from nhd_tpu.obs.histo import reset_all
+
+    # the histogram registry is process-global and bench_bind_latency's
+    # direct-call binds already observed into it — reset so the scraped
+    # p99 measures THIS daemon run, like the old last-batch gauge did
+    reset_all()
 
     import numpy as np
 
@@ -340,10 +349,26 @@ def bench_daemon(n_pods: int = 150) -> None:
             body = urllib.request.urlopen(
                 f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
             ).read().decode()
+            # p99 upper bound from the cumulative histogram: the smallest
+            # bucket edge covering >= 99% of observations (what
+            # histogram_quantile() would report from one scrape)
+            buckets = []
             for line in body.splitlines():
-                if line.startswith("nhd_last_bind_p99_seconds"):
-                    gauge = f"{float(line.split()[-1]) * 1e3:.2f}ms"
-                    break
+                m = re.match(
+                    r'nhd_bind_latency_seconds_bucket\{le="([^"]+)"\} (\d+)',
+                    line,
+                )
+                if m:
+                    edge = (float("inf") if m.group(1) == "+Inf"
+                            else float(m.group(1)))
+                    buckets.append((edge, int(m.group(2))))
+            if buckets and buckets[-1][1] > 0:
+                total = buckets[-1][1]
+                for edge, count in buckets:
+                    if count >= 0.99 * total:
+                        gauge = (f"<={edge * 1e3:.1f}ms"
+                                 if edge != float("inf") else ">30s")
+                        break
         except Exception as exc:
             gauge = f"scrape-failed ({exc})"
         lat_ms = np.asarray(lat[10:]) * 1e3  # drop warmup
@@ -361,7 +386,7 @@ def bench_daemon(n_pods: int = 150) -> None:
             f"p50={np.percentile(lat_ms, 50):.2f}ms "
             f"p99={np.percentile(lat_ms, 99):.2f}ms "
             f"max={lat_ms.max():.2f}ms; "
-            f"prometheus last_bind_p99={gauge}"
+            f"prometheus histogram bind_p99 {gauge}"
         )
     finally:
         for t in threads:

@@ -110,6 +110,11 @@ RULES: Dict[str, Tuple[str, str]] = {
                "static_argnums/static_argnames parameter with an unhashable "
                "(mutable) default: first defaulted call raises, and mutable "
                "statics silently miss the jit cache"),
+    "NHD106": ("tracing",
+               "raw time.time()/perf_counter() timing inside a jit-traced "
+               "function: clock reads execute at trace time and constant-"
+               "fold — time on the host around the dispatch "
+               "(nhd_tpu.utils.tracing.phase)"),
     "NHD201": ("locks",
                "write to lock-guarded attribute outside 'with <lock>:' in a "
                "class that owns a threading.Lock/RLock"),

@@ -29,6 +29,14 @@ _COERCIONS = {"int", "float", "bool", "complex"}
 # attribute reads that yield static (host) values even on a tracer
 _STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "sharding", "weak_type"}
 _CACHE_DECORATORS = {"lru_cache", "cache"}
+# wall-clock reads inside a traced function execute once, at TRACE time —
+# the "timing" they produce is a compile-time constant folded into the
+# program, so every later cached call reports the first call's timestamp
+_CLOCK_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.thread_time", "time.perf_counter_ns",
+    "time.monotonic_ns", "time.time_ns",
+}
 
 
 def _is_jit_ref(node: ast.AST, jit_names: Set[str]) -> bool:
@@ -206,7 +214,16 @@ class _TracedChecker:
     def _check(self, node: ast.AST) -> None:
         if isinstance(node, ast.Call):
             d = _dotted(node.func)
-            if (
+            if d in _CLOCK_CALLS:
+                self._emit(
+                    "NHD106", node,
+                    f"{d}() inside jit-traced '{self.fn.name}' runs at "
+                    "trace time only — the value is a constant folded "
+                    "into the compiled program, so the timing is wrong "
+                    "on every cached call; time on the host around the "
+                    "dispatch (nhd_tpu.utils.tracing.phase)",
+                )
+            elif (
                 d in _COERCIONS
                 and node.args
                 and self.is_traced(node.args[0])

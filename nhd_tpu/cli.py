@@ -31,6 +31,7 @@ def build_threads(
     rpc_port: int = 45655,
     metrics_port: int = 0,
     respect_busy: bool = True,
+    trace_dir=None,
 ):
     """Wire up the thread set for a backend; returns (threads, rpc_queue)."""
     watch_q = WatchQueue()
@@ -50,7 +51,9 @@ def build_threads(
     if metrics_port:
         from nhd_tpu.rpc.metrics import MetricsServer
 
-        threads.append(MetricsServer(rpc_q, port=metrics_port))
+        threads.append(MetricsServer(
+            rpc_q, port=metrics_port, trace_dir=trace_dir, backend=backend
+        ))
 
     return threads, rpc_q
 
@@ -179,6 +182,11 @@ def main(argv=None) -> int:
     parser.add_argument("--run-seconds", type=float, default=0,
                         help="exit cleanly after N seconds with a summary "
                              "(demo/smoke runs; 0 = run forever)")
+    parser.add_argument("--trace-out", metavar="DIR", default=None,
+                        help="enable the flight recorder and write Chrome "
+                             "trace JSON here (dump triggers: clean exit, "
+                             "and GET /trace?save=1 on the metrics port; "
+                             "ring size via NHD_TRACE_CAPACITY)")
     args = parser.parse_args(argv)
 
     logger = get_logger(__name__)
@@ -200,6 +208,12 @@ def main(argv=None) -> int:
         else:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    if args.trace_out:
+        from nhd_tpu import obs
+
+        obs.enable(capacity=int(os.environ.get("NHD_TRACE_CAPACITY", "16384")))
+        logger.warning(f"flight recorder on; traces → {args.trace_out}")
+
     if args.explain or args.explain_pod:
         return explain_main(args)
 
@@ -219,27 +233,46 @@ def main(argv=None) -> int:
         backend = KubeClusterBackend()
 
     threads, _ = build_threads(
-        backend, rpc_port=args.rpc_port, metrics_port=args.metrics_port
+        backend, rpc_port=args.rpc_port, metrics_port=args.metrics_port,
+        trace_dir=args.trace_out,
     )
     for t in threads:
         t.start()
 
+    def dump_trace() -> None:
+        if not args.trace_out:
+            return
+        from nhd_tpu import obs
+
+        rec = obs.get_recorder()
+        if rec is not None:
+            path = obs.dump_chrome_trace(rec, args.trace_out)
+            print(f"trace written to {path}")
+
     # liveness watchdog (reference: bin/nhd:43-56): crash-only — if any
     # thread dies the whole process exits and the Deployment restarts it
     deadline = time.monotonic() + args.run_seconds if args.run_seconds else None
-    while True:
-        time.sleep(1)
-        for t in threads:
-            if not t.is_alive():
-                logger.error(f"thread {t.name} died; exiting")
-                os._exit(-1)
-        if deadline is not None and time.monotonic() >= deadline:
-            if args.fake:
-                snap = backend.snapshot_stats()
-                print(f"demo summary: {snap['bound_pods']}/"
-                      f"{snap['total_pods']} pods "
-                      f"bound across {snap['nodes']} nodes")
-            return 0
+    try:
+        while True:
+            time.sleep(1)
+            for t in threads:
+                if not t.is_alive():
+                    logger.error(f"thread {t.name} died; exiting")
+                    os._exit(-1)
+            if deadline is not None and time.monotonic() >= deadline:
+                if args.fake:
+                    snap = backend.snapshot_stats()
+                    print(f"demo summary: {snap['bound_pods']}/"
+                          f"{snap['total_pods']} pods "
+                          f"bound across {snap['nodes']} nodes")
+                dump_trace()
+                return 0
+    except KeyboardInterrupt:
+        # Ctrl-C on a run-forever daemon is the other "clean exit" the
+        # --trace-out help text promises a dump for
+        logger.warning("interrupted; shutting down")
+        dump_trace()
+        return 0
 
 
 if __name__ == "__main__":

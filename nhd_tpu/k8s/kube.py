@@ -625,6 +625,8 @@ class KubeClusterBackend(ClusterBackend):
         to alias. Synthetic events are indistinguishable from real ones
         downstream (same WatchEvent contract), so the controller and
         scheduler need no resync-awareness at all."""
+        from nhd_tpu.obs.recorder import span
+
         API_COUNTERS.inc("resyncs_total")
         with self._state_lock:
             # everything the watch threads touch after this point is
@@ -634,7 +636,11 @@ class KubeClusterBackend(ClusterBackend):
             seq0 = self._watch_seq
             self._relist_floor = seq0  # tombstones >= seq0 must survive
         try:
-            self._resync_diff(seq0)
+            # flight-recorder visibility: a resync pass is the API plane's
+            # heaviest periodic call (full relist) — it shows in traces as
+            # its own interval instead of as unexplained watch latency
+            with span("resync", cat="api"):
+                self._resync_diff(seq0)
         finally:
             with self._state_lock:
                 self._relist_floor = None

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from nhd_tpu.obs import histo as _histo
+
 # exceptions that mean "the network/transport failed" when no HTTP status
 # is attached. Statusless exceptions OUTSIDE this set are client-side bugs
 # (TypeError, KeyError, …) — retrying them burns backoff sleeps on a
@@ -301,7 +303,19 @@ class RetryPolicy:
 
     def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         if not self._admit():
-            raise self._reject()
+            raise self._reject()  # rejected calls never hit the wire —
+            #                       they stay out of the latency histogram
+        t0 = time.perf_counter()
+        try:
+            return self._call_under_policy(fn, *args, **kwargs)
+        finally:
+            # whole-call latency incl. backoff sleeps — the figure a
+            # caller (the scheduler's commit path) actually waited
+            _histo.observe("api_call_seconds", time.perf_counter() - t0)
+
+    def _call_under_policy(
+        self, fn: Callable[..., Any], *args: Any, **kwargs: Any
+    ) -> Any:
         self._counters.inc("api_calls_total")
         deadline_at = self._clock() + self.deadline
         prev_delay = self.base_delay

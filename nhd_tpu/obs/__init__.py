@@ -1,0 +1,55 @@
+"""Observability: flight recorder, histograms, and JIT cache accounting.
+
+The scheduler's instrumentation spine (ISSUE 3): correlation IDs thread
+every pod's decision path from watch-event receipt to bind commit, spans
+land in a bounded ring (recorder.py), latency distributions land in
+Prometheus histograms (histo.py), and solver program reuse is counted per
+bucket shape (jitstats.py). Export: Chrome trace JSON (chrome.py), the
+/metrics text plane and /decisions + /explain + /trace HTTP views
+(rpc/metrics.py), and the gRPC stats service (rpc/server.py).
+
+Everything in this package is stdlib-only and import-light — producers
+(scheduler, solver, retry layer) import it unconditionally and pay one
+module-global read when tracing is off.
+"""
+
+from nhd_tpu.obs.chrome import (
+    chrome_trace,
+    chrome_trace_of,
+    dump_chrome_trace,
+    validate_chrome_trace,
+)
+from nhd_tpu.obs.histo import HISTOGRAMS, Histogram
+from nhd_tpu.obs.jitstats import JIT_STATS
+from nhd_tpu.obs.recorder import (
+    FlightRecorder,
+    Span,
+    correlate,
+    current_corr_id,
+    decisions_view,
+    disable,
+    enable,
+    get_recorder,
+    new_corr_id,
+    span,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "HISTOGRAMS",
+    "Histogram",
+    "JIT_STATS",
+    "Span",
+    "chrome_trace",
+    "chrome_trace_of",
+    "correlate",
+    "current_corr_id",
+    "decisions_view",
+    "disable",
+    "dump_chrome_trace",
+    "enable",
+    "get_recorder",
+    "new_corr_id",
+    "span",
+    "validate_chrome_trace",
+]

@@ -1,0 +1,119 @@
+"""Chrome trace-viewer export for the flight recorder.
+
+Emits the Trace Event Format's JSON-object form (the one chrome://tracing
+and ui.perfetto.dev both load): a ``traceEvents`` list of complete ("X")
+events with microsecond timestamps, plus thread-name metadata ("M")
+events so the viewer labels rows by producing thread. The correlation ID
+rides in ``args.corr``, so selecting any span of a pod surfaces the ID to
+filter the rest of its pipeline.
+
+The export is deterministic for deterministic input: events sort by
+(ts, tid, name), timestamps are relative to the earliest span, and thread
+IDs are assigned in first-seen-sorted order — golden-file tests diff the
+serialized form directly.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+from typing import Dict, List, Optional
+
+from nhd_tpu.obs.recorder import FlightRecorder, Span
+
+_PID = 1
+
+
+def chrome_trace(recorder: FlightRecorder) -> dict:
+    """Render the recorder's current ring as a Chrome trace dict."""
+    return chrome_trace_of(recorder.spans())
+
+
+def chrome_trace_of(spans: List[Span]) -> dict:
+    origin = min((s.t0 for s in spans), default=0.0)
+    tids: Dict[str, int] = {}
+    for name in sorted({s.thread for s in spans}):
+        tids[name] = len(tids) + 1
+    events: List[dict] = [
+        {
+            "ph": "M", "name": "thread_name", "pid": _PID, "tid": tid,
+            "args": {"name": tname},
+        }
+        for tname, tid in tids.items()
+    ]
+    body: List[dict] = []
+    for s in spans:
+        args: dict = {"corr": s.corr}
+        if s.attrs:
+            args.update(s.attrs)
+        body.append({
+            "ph": "X",
+            "name": s.name,
+            "cat": s.cat,
+            "pid": _PID,
+            "tid": tids[s.thread],
+            # microseconds, rounded so float noise can't perturb goldens
+            "ts": round((s.t0 - origin) * 1e6, 3),
+            "dur": round(s.dur * 1e6, 3),
+            "args": args,
+        })
+    body.sort(key=lambda e: (e["ts"], e["tid"], e["name"]))
+    events.extend(body)
+    return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def validate_chrome_trace(trace: object) -> List[str]:
+    """Schema check for an exported trace; returns a list of problems
+    (empty = valid). Shared by the test suite and ``make trace-demo`` so
+    they cannot drift on what 'loads in the viewer' means."""
+    errors: List[str] = []
+    if not isinstance(trace, dict):
+        return [f"top level must be an object, got {type(trace).__name__}"]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents must be a list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in ("X", "M"):
+            errors.append(f"{where}: unsupported ph {ph!r}")
+            continue
+        for field in ("name", "pid", "tid"):
+            if field not in ev:
+                errors.append(f"{where}: missing {field!r}")
+        if ph == "X":
+            for field in ("ts", "dur"):
+                v = ev.get(field)
+                if not isinstance(v, (int, float)) or v < 0:
+                    errors.append(f"{where}: {field} must be a number >= 0")
+            if not isinstance(ev.get("args", {}), dict):
+                errors.append(f"{where}: args must be an object")
+        else:  # metadata
+            if not isinstance(ev.get("args", {}).get("name"), str):
+                errors.append(f"{where}: metadata event needs args.name")
+    return errors
+
+
+# itertools.count: atomic under the GIL, so concurrent dump triggers
+# (ThreadingHTTPServer /trace?save=1 racing the CLI exit dump) can never
+# draw the same sequence number and clobber each other's file
+_dump_seq = itertools.count(1)
+
+
+def dump_chrome_trace(
+    recorder: FlightRecorder, out_dir: str, *, stem: Optional[str] = None
+) -> str:
+    """Write the current ring to ``out_dir`` as pretty-printed trace JSON;
+    returns the written path. Filenames carry pid + a per-process sequence
+    so repeated dump triggers never clobber each other."""
+    os.makedirs(out_dir, exist_ok=True)
+    name = stem or f"nhd-trace-{os.getpid()}-{next(_dump_seq):03d}"
+    path = os.path.join(out_dir, f"{name}.json")
+    with open(path, "w") as fh:
+        json.dump(chrome_trace(recorder), fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path

@@ -1,0 +1,163 @@
+"""Prometheus histograms for the latency-shaped scheduler metrics.
+
+The seed's ``last_*`` gauges were lossy by construction: a scrape sees
+only the most recent batch, so any batch that lands between scrapes —
+i.e. almost all of them — leaves no trace, and a p99 computed inside one
+batch says nothing about the fleet over time. Histograms fix both:
+cumulative buckets survive scrape gaps (counters never lose events) and
+``histogram_quantile()`` gives real percentiles over any window.
+
+Stdlib-only, like the rest of the metrics plane: a fixed ascending bucket
+list, one lock per histogram (observes come from scheduler, commit-pool,
+and API threads), and exact exposition rendering — bucket counts are
+integers printed as integers, sums use ``repr`` (shortest round-trip), so
+no ``:g`` precision loss on large counts (the same rule rpc/metrics.py
+follows for counters).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Sequence, Tuple
+
+# default latency buckets: 0.5 ms .. 30 s — covers the daemon fast path
+# (sub-ms binds, docs/TPU_STATUS.md) through federation gang sweeps
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+# API round trips are faster-grained: 1 ms .. 15 s (the retry deadline)
+API_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 15.0,
+)
+
+
+def _fmt(v: float) -> str:
+    """Exact, minimal float rendering for le labels and sums ('0.005',
+    not '5e-03'; integers shed their trailing '.0')."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class Histogram:
+    """One cumulative-bucket histogram (thread-safe)."""
+
+    def __init__(
+        self, name: str, help_text: str,
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(
+                f"buckets must be a non-empty ascending sequence, "
+                f"got {buckets!r}"
+            )
+        self.name = name
+        self.help_text = help_text
+        self.buckets: Tuple[float, ...] = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        # per-bucket (non-cumulative) counts; index len(buckets) = +Inf
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        # Prometheus buckets are 'le': value exactly on an edge belongs
+        # in that edge's bucket, hence bisect_left
+        i = bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        """(cumulative bucket counts incl. +Inf, sum, count)."""
+        with self._lock:
+            raw = list(self._counts)
+            total_sum, total_count = self._sum, self._count
+        cum: List[int] = []
+        running = 0
+        for c in raw:
+            running += c
+            cum.append(running)
+        return cum, total_sum, total_count
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    def render(self, prefix: str = "nhd_") -> List[str]:
+        """Prometheus text exposition lines for this histogram."""
+        cum, total_sum, total_count = self.snapshot()
+        full = f"{prefix}{self.name}"
+        lines = [
+            f"# HELP {full} {self.help_text}",
+            f"# TYPE {full} histogram",
+        ]
+        for edge, c in zip(self.buckets, cum):
+            lines.append(f'{full}_bucket{{le="{_fmt(edge)}"}} {c}')
+        lines.append(f'{full}_bucket{{le="+Inf"}} {cum[-1]}')
+        lines.append(f"{full}_sum {_fmt(total_sum)}")
+        lines.append(f"{full}_count {total_count}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# registry: adding a histogram here is all it takes to surface it on
+# /metrics (rpc/metrics.py renders the whole table, mirroring the
+# ApiCounters.KNOWN convention)
+# ---------------------------------------------------------------------------
+
+HISTOGRAMS: Dict[str, Histogram] = {
+    h.name: h
+    for h in (
+        Histogram(
+            "bind_latency_seconds",
+            "End-to-end per-pod bind latency: batch admission to bound",
+        ),
+        Histogram(
+            "queue_wait_seconds",
+            "Watch-event receipt to batch admission (event queue wait)",
+        ),
+        Histogram(
+            "solve_phase_seconds",
+            "Per-batch wall seconds in the batched feasibility solve",
+        ),
+        Histogram(
+            "select_phase_seconds",
+            "Per-batch wall seconds in candidate selection/packing",
+        ),
+        Histogram(
+            "assign_phase_seconds",
+            "Per-batch wall seconds in physical ID assignment",
+        ),
+        Histogram(
+            "api_call_seconds",
+            "Retry-layer API call latency (incl. backoff sleeps)",
+            API_BUCKETS,
+        ),
+    )
+}
+
+
+def observe(name: str, value: float) -> None:
+    """Observe into a registered histogram (KeyError on a typo'd name —
+    misspelled instrumentation must fail tests, not vanish)."""
+    HISTOGRAMS[name].observe(value)
+
+
+def render_all(prefix: str = "nhd_") -> List[str]:
+    lines: List[str] = []
+    for name in HISTOGRAMS:
+        lines.extend(HISTOGRAMS[name].render(prefix))
+    return lines
+
+
+def reset_all() -> None:
+    """Back to all-zero (test isolation)."""
+    for h in HISTOGRAMS.values():
+        h.reset()

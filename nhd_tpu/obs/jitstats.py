@@ -1,0 +1,64 @@
+"""Solver JIT program accounting: cache hits vs. recompiles, bucket shapes.
+
+Batch-solver throughput on accelerators lives or dies by compiled-program
+reuse (the pow-2 shape bucketing, SURVEY §7 hard part 3) — and a recompile
+storm is *silent*: the run just gets multi-second stalls wherever a new
+(bucket, padded-dims) shape first appears (r4/r5 measured fresh megaround
+traces at ~1 s each through the tunnel). This module makes reuse a
+scrapeable signal.
+
+Every solver dispatch site (kernel.py, solver/device_state.py) reports a
+*shape key* — the dims XLA specializes on (bucket G/U/K, padded type and
+node axes, rank width). A key seen for the first time is a compile;
+every later use of the same key is a cache hit. That approximates XLA's
+own cache exactly as long as keys include every specializing dim, which
+is the contract dispatch sites uphold. Exported via /metrics
+(rpc/metrics.py): hit/compile counters plus per-shape use counts — the
+bucket-shape occupancy table.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class JitStats:
+    """Thread-safe dispatch/compile accounting keyed by shape."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._uses: Dict[str, int] = {}
+        self._calls = 0
+        self._compiles = 0
+
+    def record_use(self, kind: str, shape_key: str) -> None:
+        """One solver dispatch of *kind* at *shape_key* (the dims the
+        compiled program specializes on). First sighting = a compile."""
+        key = f"{kind}:{shape_key}"
+        with self._lock:
+            self._calls += 1
+            if key not in self._uses:
+                self._compiles += 1
+                self._uses[key] = 0
+            self._uses[key] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "calls_total": self._calls,
+                "compiles_total": self._compiles,
+                "cache_hits_total": self._calls - self._compiles,
+                "distinct_programs": len(self._uses),
+                "shapes": dict(self._uses),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._uses = {}
+            self._calls = 0
+            self._compiles = 0
+
+
+#: process-wide registry (one jit cache per process, one counter set)
+JIT_STATS = JitStats()

@@ -1,0 +1,241 @@
+"""Flight recorder: correlation IDs, spans, and the bounded in-memory ring.
+
+The reference's only observability is verbose logs plus the gRPC stats
+plane (SURVEY §5.1/§5.5); counters say *how often* but never *where the
+time went* for one pod. The flight recorder answers that: every watch
+event mints a correlation ID that rides the pipeline (event queue → batch
+admission → solve → select → assign → bind commit), and each stage
+records a span into a bounded ring. Export is Chrome trace-viewer JSON
+(chrome://tracing / https://ui.perfetto.dev) plus a queryable
+"recent decisions" view (rpc/metrics.py, rpc/server.py).
+
+Design constraints, in order:
+
+* **off means off** — every producer call sites guard on
+  ``get_recorder() is None``; a disabled recorder costs one module-global
+  read on the batch path (bench.py's ≤2 % overhead acceptance);
+* **thread-safe by construction** — spans arrive concurrently from the
+  controller, scheduler, commit-pool, and RPC threads; the ring is a
+  ``deque(maxlen=...)`` guarded by one lock, and a span is immutable
+  after ``record`` returns;
+* **bounded** — the ring evicts oldest-first and counts what it dropped
+  (the ``nhd_trace_ring_dropped_total`` metric), so tracing can stay on
+  in production without growing the heap.
+
+Correlation IDs are a process-wide monotonic counter, not random tokens:
+deterministic runs produce deterministic traces (golden-file tests), and
+the IDs only need to be unique within one process's ring lifetime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+try:  # contextvars: per-thread in threads, carried across awaits in async
+    from contextvars import ContextVar
+except ImportError:  # pragma: no cover - py3.7+ always has it
+    ContextVar = None  # type: ignore[assignment]
+
+_corr_seq = itertools.count(1)
+_CORR_VAR: "ContextVar[Optional[str]]" = ContextVar("nhd_corr", default=None)
+
+
+def new_corr_id() -> str:
+    """Mint a fresh correlation ID (process-unique, monotonic)."""
+    return f"c{next(_corr_seq):06d}"
+
+
+def current_corr_id() -> Optional[str]:
+    """The correlation ID bound to the calling context (or None)."""
+    return _CORR_VAR.get()
+
+
+@contextlib.contextmanager
+def correlate(corr: Optional[str]) -> Iterator[None]:
+    """Bind *corr* as the context correlation ID for the block — log
+    records emitted inside (NHD_LOG_JSON=1) join against the trace."""
+    token = _CORR_VAR.set(corr)
+    try:
+        yield
+    finally:
+        _CORR_VAR.reset(token)
+
+
+class Span:
+    """One recorded interval. Immutable after construction; __slots__
+    because a gang-scale batch records tens of thousands of these."""
+
+    __slots__ = ("name", "cat", "corr", "t0", "dur", "thread", "attrs")
+
+    def __init__(
+        self,
+        name: str,
+        t0: float,
+        dur: float,
+        *,
+        cat: str = "span",
+        corr: Optional[str] = None,
+        thread: Optional[str] = None,
+        attrs: Optional[dict] = None,
+    ):
+        self.name = name
+        self.t0 = t0
+        self.dur = dur
+        self.cat = cat
+        self.corr = corr
+        self.thread = thread or threading.current_thread().name
+        self.attrs = attrs
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name, "cat": self.cat, "corr": self.corr,
+            "t0": self.t0, "dur": self.dur, "thread": self.thread,
+        }
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        return d
+
+
+class FlightRecorder:
+    """Bounded, thread-safe span ring + decision log.
+
+    ``capacity`` bounds the span ring; ``decision_capacity`` bounds the
+    independent per-pod decision log (a much smaller, higher-value record
+    that must not be evicted by span churn from one big batch).
+    """
+
+    def __init__(self, capacity: int = 16384, decision_capacity: int = 256):
+        if capacity < 1 or decision_capacity < 1:
+            raise ValueError(
+                f"capacities must be >= 1, got {capacity}/{decision_capacity}"
+            )
+        self.capacity = capacity
+        self.decision_capacity = decision_capacity
+        self._lock = threading.Lock()
+        self._spans: "deque[Span]" = deque(maxlen=capacity)
+        self._decisions: "deque[dict]" = deque(maxlen=decision_capacity)
+        self._dropped = 0
+
+    # -- producers ------------------------------------------------------
+
+    def record(
+        self,
+        name: str,
+        t0: float,
+        dur: float,
+        *,
+        cat: str = "span",
+        corr: Optional[str] = None,
+        thread: Optional[str] = None,
+        attrs: Optional[dict] = None,
+    ) -> None:
+        """Append one span (t0 on the time.monotonic() clock, seconds)."""
+        span = Span(
+            name, t0, dur, cat=cat,
+            corr=corr if corr is not None else _CORR_VAR.get(),
+            thread=thread, attrs=attrs,
+        )
+        with self._lock:
+            if len(self._spans) == self.capacity:
+                self._dropped += 1
+            self._spans.append(span)
+
+    def record_decision(self, decision: dict) -> None:
+        """Append one per-pod scheduling decision (see scheduler/core.py
+        for the record shape: pod, ns, corr, outcome, node, phases...)."""
+        with self._lock:
+            self._decisions.append(decision)
+
+    # -- consumers ------------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        """Snapshot of the ring, oldest first."""
+        with self._lock:
+            return list(self._spans)
+
+    def recent_decisions(self, n: int = 50) -> List[dict]:
+        """The last *n* per-pod decisions, newest first."""
+        with self._lock:
+            out = list(self._decisions)
+        out.reverse()
+        return [dict(d) for d in out[: max(n, 0)]]
+
+    def occupancy(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._decisions.clear()
+            self._dropped = 0
+
+
+# ---------------------------------------------------------------------------
+# process-global recorder (None = tracing off; the common case)
+# ---------------------------------------------------------------------------
+
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    """The active recorder, or None when tracing is off. Producers must
+    treat None as 'skip all span work' — this read is the entire
+    recorder-off cost on the hot path."""
+    return _RECORDER
+
+
+def enable(
+    capacity: int = 16384, decision_capacity: int = 256
+) -> FlightRecorder:
+    """Install (or replace) the process-global recorder and return it."""
+    global _RECORDER
+    _RECORDER = FlightRecorder(capacity, decision_capacity)
+    return _RECORDER
+
+
+def disable() -> None:
+    global _RECORDER
+    _RECORDER = None
+
+
+def decisions_view(n: int = 50) -> Dict[str, object]:
+    """The recent-decisions payload both query planes serve (HTTP
+    /decisions and gRPC GetRecentDecisions) — one definition, so the
+    transports cannot drift."""
+    rec = _RECORDER
+    return {
+        "enabled": rec is not None,
+        "decisions": rec.recent_decisions(n) if rec is not None else [],
+    }
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    *,
+    cat: str = "span",
+    corr: Optional[str] = None,
+    attrs: Optional[dict] = None,
+) -> Iterator[None]:
+    """Record the block as a span when tracing is on; free no-op when off."""
+    rec = _RECORDER
+    if rec is None:
+        yield
+        return
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        rec.record(
+            name, t0, time.monotonic() - t0, cat=cat, corr=corr, attrs=attrs
+        )

@@ -11,8 +11,9 @@ import queue
 RPC_TIMEOUT_SEC = 5.0  # reference: NHDRpcServer.py:58
 
 
-def ask_scheduler(sched_queue: "queue.Queue", msg_type):
-    """One request/reply round trip against the scheduler thread."""
+def ask_scheduler(sched_queue: "queue.Queue", msg_type, arg=None):
+    """One request/reply round trip against the scheduler thread.
+    ``arg`` is an optional message payload (EXPLAIN_INFO's queried pod)."""
     tmpq: "queue.Queue" = queue.Queue()
-    sched_queue.put((msg_type, tmpq))
+    sched_queue.put((msg_type, tmpq, arg))
     return tmpq.get(timeout=RPC_TIMEOUT_SEC)

@@ -1,22 +1,43 @@
-"""Prometheus-format metrics endpoint.
+"""Prometheus-format metrics endpoint + flight-recorder HTTP views.
 
 The reference's only metrics plane is its gRPC service (SURVEY §5.5 — "No
 Prometheus"). This adds a stdlib-only HTTP exporter: GET /metrics renders
-the same scheduler-owned stats (via the single-writer RPC queue, like the
-gRPC plane) in Prometheus text exposition format, so standard scrapers work
+the scheduler-owned stats (via the single-writer RPC queue, like the gRPC
+plane) in Prometheus text exposition format, so standard scrapers work
 without a sidecar. Opt-in via ``nhd-tpu --metrics-port``.
+
+Latency-shaped series are HISTOGRAMS (obs/histo.py) — they replaced the
+seed's lossy ``last_*`` gauges, which showed only whichever batch happened
+to run last before a scrape. The same server also exposes the flight
+recorder (obs/):
+
+    GET /decisions?n=50      recent per-pod decisions (JSON)
+    GET /explain?pod=ns/name unschedulability diagnosis (JSON, via the
+                             scheduler thread — solver/explain.py)
+    GET /trace[?save=1]      Chrome trace JSON of the span ring; save=1
+                             also writes it under --trace-out
 """
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List
+from typing import List, Optional
 
 from nhd_tpu.k8s.retry import API_COUNTERS, ApiCounters
+from nhd_tpu.obs import (
+    chrome_trace,
+    decisions_view,
+    dump_chrome_trace,
+    get_recorder,
+)
+from nhd_tpu.obs.histo import render_all as render_histograms
+from nhd_tpu.obs.jitstats import JIT_STATS
 from nhd_tpu.rpc import ask_scheduler
-from nhd_tpu.scheduler.core import RpcMsgType
+from nhd_tpu.scheduler.core import RpcMsgType, build_explain_request
 from nhd_tpu.utils import get_logger
 
 
@@ -54,10 +75,9 @@ def render_metrics(
          "Seconds in candidate selection/packing"),
         ("assign_seconds_total", "counter",
          "Seconds in physical ID assignment"),
-        ("last_batch_pods", "gauge", "Pod count of the last batch"),
-        ("last_batch_seconds", "gauge", "Wall seconds of the last batch"),
-        ("last_bind_p99_seconds", "gauge",
-         "p99 bind latency within the last batch"),
+        ("event_queue_depth", "gauge",
+         "Watch events waiting for the scheduler thread"),
+        ("uptime_seconds", "gauge", "Seconds since the scheduler started"),
     ):
         if perf is None or name not in perf:
             continue
@@ -66,6 +86,57 @@ def render_metrics(
             f"# TYPE nhd_{name} {kind}",
             f"nhd_{name} {perf[name]}",
         ]
+
+    # latency distributions (obs/histo.py) — the last_* gauge replacement
+    lines += render_histograms()
+
+    # solver JIT program accounting: compiled-shape occupancy makes a
+    # recompile storm a scrapeable signal (obs/jitstats.py)
+    jit = JIT_STATS.snapshot()
+    for name, kind, help_text in (
+        ("jit_calls_total", "counter", "Solver program dispatches"),
+        ("jit_compiles_total", "counter",
+         "Solver dispatches that hit a first-seen program shape "
+         "(trace+compile)"),
+        ("jit_cache_hits_total", "counter",
+         "Solver dispatches reusing an already-compiled shape"),
+        ("jit_distinct_programs", "gauge",
+         "Distinct compiled solver program shapes resident"),
+    ):
+        key = name[len("jit_"):]
+        lines += [
+            f"# HELP nhd_{name} {help_text}",
+            f"# TYPE nhd_{name} {kind}",
+            f"nhd_{name} {jit[key]}",
+        ]
+    if jit["shapes"]:
+        lines += [
+            "# HELP nhd_jit_shape_uses_total Dispatches per compiled "
+            "program shape (bucket-shape occupancy)",
+            "# TYPE nhd_jit_shape_uses_total counter",
+        ]
+        for key, uses in sorted(jit["shapes"].items()):
+            lines.append(f'nhd_jit_shape_uses_total{{shape="{key}"}} {uses}')
+
+    # flight-recorder ring state
+    rec = get_recorder()
+    for name, kind, help_text, value in (
+        ("trace_enabled", "gauge", "Flight recorder active",
+         int(rec is not None)),
+        ("trace_ring_spans", "gauge", "Spans currently in the trace ring",
+         rec.occupancy() if rec else 0),
+        ("trace_ring_capacity", "gauge", "Trace ring capacity",
+         rec.capacity if rec else 0),
+        ("trace_ring_dropped_total", "counter",
+         "Spans evicted from the trace ring",
+         rec.dropped() if rec else 0),
+    ):
+        lines += [
+            f"# HELP nhd_{name} {help_text}",
+            f"# TYPE nhd_{name} {kind}",
+            f"nhd_{name} {value}",
+        ]
+
     lines += [
         "# HELP nhd_node_free_cpus Free logical CPU cores per node",
         "# TYPE nhd_node_free_cpus gauge",
@@ -100,32 +171,59 @@ def render_metrics(
 
 
 class MetricsServer(threading.Thread):
-    """HTTP thread serving /metrics off the scheduler's RPC queue."""
+    """HTTP thread serving /metrics (plus the flight-recorder views) off
+    the scheduler's RPC queue. ``trace_dir``: where /trace?save=1 writes
+    dump files (the --trace-out directory). ``backend``: the cluster
+    backend, used by /explain to read the queried pod's config on THIS
+    thread (the scheduler thread only evaluates the finished request —
+    a degraded API server must never head-of-line-block scheduling)."""
 
-    def __init__(self, sched_queue: queue.Queue, *, port: int = 9464):
+    def __init__(
+        self, sched_queue: queue.Queue, *, port: int = 9464,
+        trace_dir: Optional[str] = None, backend=None,
+    ):
         super().__init__(name="nhd-metrics", daemon=True)
         self.logger = get_logger(__name__)
         self.mainq = sched_queue
+        self.trace_dir = trace_dir
+        self.backend = backend
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0].rstrip("/")
-                if path not in ("", "/metrics"):
-                    self.send_error(404)
-                    return
+                parsed = urllib.parse.urlsplit(self.path)
+                path = parsed.path.rstrip("/")
+                q = urllib.parse.parse_qs(parsed.query)
                 try:
-                    body = outer._collect().encode()
+                    if path in ("", "/metrics"):
+                        self._reply(
+                            200, outer._collect().encode(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif path == "/decisions":
+                        self._reply_json(200, outer._decisions(q))
+                    elif path == "/explain":
+                        status, body = outer._explain(q)
+                        self._reply_json(status, body)
+                    elif path == "/trace":
+                        status, body = outer._trace(q)
+                        self._reply_json(status, body)
+                    else:
+                        self.send_error(404)
                 except Exception as exc:  # scheduler unavailable
                     self.send_error(503, str(exc))
-                    return
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4"
-                )
+
+            def _reply(self, status: int, body: bytes, ctype: str) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _reply_json(self, status: int, obj: object) -> None:
+                self._reply(
+                    status, json.dumps(obj).encode(), "application/json"
+                )
 
             def log_message(self, *args) -> None:
                 pass  # keep scrapes out of the logs
@@ -144,6 +242,49 @@ class MetricsServer(threading.Thread):
         failed = ask_scheduler(self.mainq, RpcMsgType.SCHEDULER_INFO)
         perf = ask_scheduler(self.mainq, RpcMsgType.PERF_INFO)
         return render_metrics(nodes, failed, perf)
+
+    def _decisions(self, q: dict) -> dict:
+        try:
+            n = int(q.get("n", ["50"])[0])
+        except ValueError:
+            n = 50
+        return decisions_view(n)
+
+    def _explain(self, q: dict) -> tuple:
+        raw = q.get("pod", [""])[0]
+        if not raw:
+            return 400, {"error": "missing ?pod=[ns/]name"}
+        if self.backend is None:
+            return 503, {"error": "explain unavailable (no backend wired)"}
+        ns, _, pod = raw.rpartition("/")
+        ns = ns or "default"
+        # backend reads happen HERE, on the HTTP thread; the scheduler
+        # thread only evaluates the finished request against its mirror
+        req, err = build_explain_request(self.backend, pod, ns)
+        if err is not None:
+            kind, msg = err
+            status = {"not-found": 404, "bad-query": 400}.get(kind, 200)
+            return status, {"error": msg, "kind": kind}
+        reply = ask_scheduler(
+            self.mainq, RpcMsgType.EXPLAIN_INFO,
+            {"request": req, "label": f"{ns}/{pod}"},
+        )
+        return 200, reply
+
+    def _trace(self, q: dict) -> tuple:
+        rec = get_recorder()
+        if rec is None:
+            return 404, {
+                "error": "flight recorder disabled "
+                "(start with --trace-out or enable via nhd_tpu.obs)"
+            }
+        trace = chrome_trace(rec)
+        if q.get("save", ["0"])[0] == "1":
+            out_dir = self.trace_dir or "."
+            path = dump_chrome_trace(rec, out_dir)
+            self.logger.warning(f"trace dumped to {path}")
+            trace["savedTo"] = path
+        return 200, trace
 
     def run(self) -> None:
         self._started.set()

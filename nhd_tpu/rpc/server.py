@@ -15,6 +15,7 @@ Two differences from the reference:
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 from concurrent import futures
@@ -22,6 +23,7 @@ from typing import Callable, Dict, Optional
 
 import grpc
 
+from nhd_tpu.obs import decisions_view
 from nhd_tpu.rpc import nhd_stats_pb2 as pb
 from nhd_tpu.scheduler.core import RpcMsgType
 from nhd_tpu.utils import get_logger
@@ -127,6 +129,20 @@ class NHDControlHandler:
                 reply.podinfo.append(self._pod_info_proto(p))
         return reply
 
+    def GetRecentDecisions(self, request: bytes, context) -> bytes:
+        """Flight-recorder recent-decisions view over gRPC. JSON-over-
+        bytes, not protobuf: this image has protoc message bindings but no
+        grpc_python_plugin (module docstring), so extending the .proto
+        would strand the hand-built service — both ends of this method are
+        ours and the decision record is schema-fluid by design."""
+        try:
+            # TypeError included: json "n": null/list reaches int() —
+            # malformed requests degrade to the default, never error
+            n = int(json.loads(request.decode() or "{}").get("n", 50))
+        except (TypeError, ValueError, AttributeError):
+            n = 50
+        return json.dumps(decisions_view(n)).encode()
+
 
 _METHODS: Dict[str, tuple] = {
     "GetBasicNodeStats": (pb.Empty, pb.NodeStats),
@@ -134,6 +150,10 @@ _METHODS: Dict[str, tuple] = {
     "GetPodStats": (pb.Empty, pb.PodStats),
     "GetDetailedNodeStats": (pb.NodeReq, pb.DetailedNodeStats),
 }
+
+# JSON-over-bytes methods (see GetRecentDecisions): name only — identity
+# (de)serializers on both ends
+_RAW_METHODS = ("GetRecentDecisions",)
 
 
 def _generic_handler(handler: NHDControlHandler) -> grpc.GenericRpcHandler:
@@ -143,6 +163,12 @@ def _generic_handler(handler: NHDControlHandler) -> grpc.GenericRpcHandler:
             getattr(handler, name),
             request_deserializer=req_cls.FromString,
             response_serializer=resp_cls.SerializeToString,
+        )
+    for name in _RAW_METHODS:
+        method_handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(handler, name),
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
         )
     return grpc.method_handlers_generic_handler(SERVICE_NAME, method_handlers)
 
@@ -186,6 +212,12 @@ class NHDControlClient:
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=resp_cls.FromString,
             )
+        for name in _RAW_METHODS:
+            self._calls[name] = self.channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
 
     def get_basic_node_stats(self) -> pb.NodeStats:
         return self._calls["GetBasicNodeStats"](pb.Empty())
@@ -198,6 +230,12 @@ class NHDControlClient:
 
     def get_detailed_node_stats(self, node: str) -> pb.DetailedNodeStats:
         return self._calls["GetDetailedNodeStats"](pb.NodeReq(name=node))
+
+    def get_recent_decisions(self, n: int = 50) -> dict:
+        raw = self._calls["GetRecentDecisions"](
+            json.dumps({"n": n}).encode()
+        )
+        return json.loads(raw.decode())
 
     def close(self) -> None:
         self.channel.close()

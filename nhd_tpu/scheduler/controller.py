@@ -27,6 +27,7 @@ from nhd_tpu.k8s.interface import (
 )
 from nhd_tpu.core.node import HostNode
 from nhd_tpu.k8s.retry import API_COUNTERS
+from nhd_tpu.obs.recorder import get_recorder, new_corr_id
 from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
 from nhd_tpu.utils import get_logger
 
@@ -117,6 +118,17 @@ class Controller(threading.Thread):
             if ev.kind == "pod_create"
             else WatchType.TRIAD_POD_DELETE
         )
+        # correlation ID minted at watch-event receipt: this is where one
+        # pod's decision path enters the process, and every later span
+        # (queue wait, solve, select, assign, bind) carries this ID
+        corr = new_corr_id()
+        t_recv = time.monotonic()
+        rec = get_recorder()
+        if rec is not None:
+            rec.record(
+                "watch_event", t_recv, 0.0, cat="event", corr=corr,
+                attrs={"kind": ev.kind, "pod": f"{ev.namespace}/{ev.name}"},
+            )
         self.queue.put(
             WatchItem(
                 wt,
@@ -127,6 +139,8 @@ class Controller(threading.Thread):
                     "cfg": ev.annotations.get(CFG_ANNOTATION, ""),
                     "node": ev.node,
                 },
+                corr=corr,
+                t_enqueue=t_recv,
             )
         )
 

@@ -24,6 +24,8 @@ from nhd_tpu.core.node import HostNode
 from nhd_tpu.core.request import PodRequest
 from nhd_tpu.k8s.interface import ClusterBackend, EventType, TransientBackendError
 from nhd_tpu.k8s.retry import API_COUNTERS
+from nhd_tpu.obs import histo as obs_histo
+from nhd_tpu.obs.recorder import correlate, get_recorder, new_corr_id
 from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
 from nhd_tpu.solver.batch import BatchItem, BatchScheduler
 from nhd_tpu.utils import get_logger
@@ -88,6 +90,74 @@ COMMIT_WORKERS = int(os.environ.get("NHD_COMMIT_WORKERS", "1"))
 # requeue loop against a down API server
 REQUEUE_MAX = int(os.environ.get("NHD_BIND_REQUEUE_MAX", "8"))
 
+# unschedulable-pod explain budget for the flight recorder: with tracing
+# on, batches at or below EXPLAIN_MAX pods on clusters at or below
+# EXPLAIN_MAX_NODES nodes get a per-pod solver/explain.py reason summary
+# attached to their decision record. Explain is a serial per-node oracle
+# walk running on the single-writer thread — its cost scales with BOTH
+# dimensions (pods × nodes), so both are gated; past either bound the
+# decision records only the coarse outcome and GET /explain remains the
+# on-demand (off-thread-prepared) path
+EXPLAIN_MAX = int(os.environ.get("NHD_TRACE_EXPLAIN_MAX", "16"))
+EXPLAIN_MAX_NODES = int(os.environ.get("NHD_TRACE_EXPLAIN_MAX_NODES", "512"))
+
+
+def pod_spec_reservations(backend: ClusterBackend, pod: str, ns: str) -> Dict[str, int]:
+    """Pod-spec-native resources worth enforcing (reference:
+    NHDScheduler.py:214-225 — hugepages only). Module-level so the
+    explain query can build a request on a non-scheduler thread."""
+    res = backend.get_requested_pod_resources(pod, ns)
+    out = {}
+    if "hugepages-1Gi" in res:
+        raw = str(res["hugepages-1Gi"])
+        out["hugepages-1Gi"] = int(raw[: raw.find("G")]) if "G" in raw else int(raw)
+    return out
+
+
+def build_explain_request(
+    backend: ClusterBackend, pod: str, ns: str
+) -> Tuple[Optional[PodRequest], Optional[Tuple[str, str]]]:
+    """The backend-I/O half of an explain query: read the live pod's
+    config, type, reservations and groups, and build its PodRequest.
+    Returns (request, None) or (None, (kind, message)) — ``kind`` is a
+    stable machine token ("bad-query" / "not-found" / "bad-config") so
+    transports map errors to status codes structurally, never by
+    substring-matching message text.
+
+    Runs on the CALLER's thread (HTTP/gRPC handler), never on the
+    single-writer scheduler thread — on a real cluster every read here
+    is an API round trip through the retry layer (up to its per-call
+    deadline), and a degraded API server must cost the *query*, not
+    head-of-line-block scheduling. The scheduler thread only evaluates
+    the finished request against its in-memory mirror
+    (RpcMsgType.EXPLAIN_INFO)."""
+    if not pod:
+        return None, ("bad-query", "missing pod name")
+    if not backend.pod_exists(pod, ns):
+        return None, ("not-found", f"pod {ns}/{pod} not found")
+    _, cfg_text = backend.get_cfg_map(pod, ns)
+    if cfg_text is None:
+        return None, (
+            "bad-config", f"pod {ns}/{pod} has no readable config"
+        )
+    cfg_type = backend.get_cfg_type(pod, ns)
+    try:
+        parser = get_cfg_parser(cfg_type, cfg_text)
+        top = parser.to_topology(False)
+        if top is None:
+            raise ValueError("no usable topology in config")
+        top.add_pod_reservations(pod_spec_reservations(backend, pod, ns))
+        groups = frozenset(backend.get_pod_node_groups(pod, ns))
+        return PodRequest.from_topology(top, node_groups=groups), None
+    except Exception as exc:
+        # user-supplied config text: any parse failure IS the diagnosis
+        # (the scheduler fails such pods with FailedCfgParse)
+        return None, (
+            "bad-config",
+            f"config for {ns}/{pod} does not parse (the scheduler fails "
+            f"this pod with FailedCfgParse): {exc}",
+        )
+
 
 class CommitOutcome(Enum):
     """Result of one pod's annotate→bind commit sequence."""
@@ -115,6 +185,8 @@ class RpcMsgType(Enum):
     SCHEDULER_INFO = 1
     POD_INFO = 2
     PERF_INFO = 3
+    EXPLAIN_INFO = 4   # rebuild addition: solver/explain.py over the live
+    #                    mirror, payload = {'pod': ..., 'ns': ...}
 
 
 class Scheduler(threading.Thread):
@@ -152,7 +224,10 @@ class Scheduler(threading.Thread):
         self._mirror_dirty = False
         # cumulative solver-phase accounting (exported via PERF_INFO /
         # the Prometheus plane; the north-star metric is p99 bind latency,
-        # SURVEY §5.1/§5.5)
+        # SURVEY §5.1/§5.5). Latency DISTRIBUTIONS live in the histogram
+        # registry (obs/histo.py), which replaced the lossy last_* gauges:
+        # a scrape now sees every batch since process start, not just the
+        # most recent one.
         self.perf: Dict[str, float] = {
             "batches_total": 0,
             "scheduled_total": 0,
@@ -160,10 +235,8 @@ class Scheduler(threading.Thread):
             "select_seconds_total": 0.0,
             "assign_seconds_total": 0.0,
             "rounds_total": 0,
-            "last_batch_pods": 0,
-            "last_batch_seconds": 0.0,
-            "last_bind_p99_seconds": 0.0,
         }
+        self.t_started = time.monotonic()
         self._stop_event = threading.Event()
 
     # ------------------------------------------------------------------
@@ -296,14 +369,7 @@ class Scheduler(threading.Thread):
     # ------------------------------------------------------------------
 
     def _pod_reservations(self, pod: str, ns: str) -> Dict[str, int]:
-        """Pod-spec-native resources worth enforcing (reference:
-        NHDScheduler.py:214-225 — hugepages only)."""
-        res = self.backend.get_requested_pod_resources(pod, ns)
-        out = {}
-        if "hugepages-1Gi" in res:
-            raw = str(res["hugepages-1Gi"])
-            out["hugepages-1Gi"] = int(raw[: raw.find("G")]) if "G" in raw else int(raw)
-        return out
+        return pod_spec_reservations(self.backend, pod, ns)
 
     def _prepare_item(self, pod: str, ns: str) -> Optional[Tuple[CfgParser, BatchItem]]:
         """Parse one pending pod's config into a BatchItem."""
@@ -326,11 +392,38 @@ class Scheduler(threading.Thread):
         req = PodRequest.from_topology(top, node_groups=groups)
         return parser, BatchItem((ns, pod), req, top)
 
-    def attempt_scheduling_batch(self, pods: List[Tuple[str, str, str]]) -> int:
+    def attempt_scheduling_batch(
+        self,
+        pods: List[Tuple[str, str, str]],
+        meta: Optional[Dict[Tuple[str, str], Tuple[Optional[str], float]]] = None,
+    ) -> int:
         """Schedule a set of (pod, ns, uid) as one batched solve, then walk
         the reference's annotate→bind commit path per winner
-        (reference: NHDScheduler.py:249-353)."""
+        (reference: NHDScheduler.py:249-353).
+
+        ``meta`` maps (ns, pod) → (corr_id, t_enqueue) for pods arriving
+        off the watch queue; their correlation ID (minted at watch-event
+        receipt, controller.py) threads through every span this batch
+        records. Scan-path pods get a fresh ID at admission.
+        """
+        t_adm = time.monotonic()
+        rec = get_recorder()
         uids = {(ns, pod): uid for pod, ns, uid in pods}
+        corrs: Dict[Tuple[str, str], str] = {}
+        waits: Dict[Tuple[str, str], float] = {}
+        for pod, ns, _uid in pods:
+            key = (ns, pod)
+            corr, t_enq = (meta or {}).get(key, (None, 0.0))
+            corrs[key] = corr or new_corr_id()
+            if t_enq:
+                wait = max(t_adm - t_enq, 0.0)
+                waits[key] = wait
+                obs_histo.observe("queue_wait_seconds", wait)
+                if rec is not None:
+                    rec.record(
+                        "queue_wait", t_enq, wait, cat="pod",
+                        corr=corrs[key], attrs={"pod": f"{ns}/{pod}"},
+                    )
         prepared: List[Tuple[CfgParser, BatchItem]] = []
         for pod, ns, _uid in pods:
             if not self.backend.pod_exists(pod, ns):
@@ -345,12 +438,17 @@ class Scheduler(threading.Thread):
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
                 }
                 self.failed_schedule_count += 1
+                if rec is not None:
+                    rec.record_decision(self._decision(
+                        pod, ns, corrs[(ns, pod)], "config-parse-failed",
+                    ))
                 continue
             prepared.append(got)
         if not prepared:
             return 0
 
         t_batch = time.perf_counter()
+        t_batch_mono = time.monotonic()
         if len(self.nodes) > STREAM_NODE_THRESH:
             from nhd_tpu.solver.streaming import StreamingScheduler
 
@@ -372,11 +470,34 @@ class Scheduler(threading.Thread):
         self.perf["select_seconds_total"] += bstats.select_seconds
         self.perf["assign_seconds_total"] += bstats.assign_seconds
         self.perf["rounds_total"] += bstats.rounds
-        self.perf["last_batch_pods"] = len(prepared)
-        self.perf["last_batch_seconds"] = time.perf_counter() - t_batch
-        self.perf["last_bind_p99_seconds"] = bstats.bind_latency_percentile(
-            results, 99
-        )
+        # per-batch phase distributions (these histograms replaced the
+        # lossy last_* gauges: a scrape now sees every batch, not the
+        # most recent one)
+        obs_histo.observe("solve_phase_seconds", bstats.solve_seconds)
+        obs_histo.observe("select_phase_seconds", bstats.select_seconds)
+        obs_histo.observe("assign_phase_seconds", bstats.assign_seconds)
+        if rec is not None:
+            rec.record(
+                "batch", t_batch_mono, time.perf_counter() - t_batch,
+                cat="batch", corr=new_corr_id(),
+                attrs={"pods": len(prepared), "rounds": bstats.rounds},
+            )
+            # per-pod phase spans: the batch's solve/select/assign wall
+            # attributed to each pod under ITS correlation ID, laid out
+            # sequentially from batch start (phases are batch-level
+            # aggregates — the trace shows where the pod's batch spent
+            # its time, docs/OBSERVABILITY.md "span model")
+            t_sel0 = t_batch_mono + bstats.solve_seconds
+            t_asn0 = t_sel0 + bstats.select_seconds
+            for _parser, item in prepared:
+                p_attrs = {"pod": f"{item.key[0]}/{item.key[1]}"}
+                c = corrs.get(item.key)
+                rec.record("solve", t_batch_mono, bstats.solve_seconds,
+                           cat="pod", corr=c, attrs=p_attrs)
+                rec.record("select", t_sel0, bstats.select_seconds,
+                           cat="pod", corr=c, attrs=p_attrs)
+                rec.record("assign", t_asn0, bstats.assign_seconds,
+                           cat="pod", corr=c, attrs=p_attrs)
 
         winners: List[Tuple[CfgParser, BatchItem, object]] = []
         for (parser, item), result in zip(prepared, results):
@@ -390,6 +511,20 @@ class Scheduler(threading.Thread):
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
                 }
+                if rec is not None:
+                    d = self._decision(
+                        pod, ns, corrs.get(item.key), "unschedulable",
+                        queue_wait=waits.get(item.key), stats=bstats,
+                    )
+                    if (
+                        len(prepared) <= EXPLAIN_MAX
+                        and len(self.nodes) <= EXPLAIN_MAX_NODES
+                    ):
+                        # small batches on small clusters get the full
+                        # rejection reason from the explainer (per-node
+                        # first failing predicate)
+                        d["reasons"] = self._explain_summary(item)
+                    rec.record_decision(d)
             else:
                 winners.append((parser, item, result))
 
@@ -405,25 +540,47 @@ class Scheduler(threading.Thread):
 
             with ThreadPoolExecutor(max_workers=COMMIT_WORKERS) as pool:
                 outcomes = list(pool.map(
-                    lambda w: self._commit_pod_calls(*w), winners
+                    lambda w: self._commit_traced(*w, corrs.get(w[1].key)),
+                    winners,
                 ))
         else:
-            outcomes = [self._commit_pod_calls(*w) for w in winners]
+            outcomes = [
+                self._commit_traced(*w, corrs.get(w[1].key)) for w in winners
+            ]
 
         scheduled = 0
-        for (parser, item, result), outcome in zip(winners, outcomes):
+        for (parser, item, result), (outcome, t_done) in zip(winners, outcomes):
             ns, pod = item.key
+            corr = corrs.get(item.key)
             if outcome is CommitOutcome.OK:
                 scheduled += 1
+                # admission → commit-complete, the operator-facing figure
+                # (queue wait is its own histogram; their sum is receipt
+                # → bound)
+                obs_histo.observe(
+                    "bind_latency_seconds", max(t_done - t_adm, 0.0)
+                )
                 self._requeue_attempts.pop((ns, pod), None)
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.SCHEDULED, "time": time.time(),
                     "uid": uids.get((ns, pod), "0"),
                 }
+                if rec is not None:
+                    rec.record_decision(self._decision(
+                        pod, ns, corr, "scheduled", node=result.node,
+                        queue_wait=waits.get(item.key), stats=bstats,
+                        bind=max(t_done - t_adm, 0.0),
+                    ))
             elif outcome is CommitOutcome.RETRY and self._requeue_pod(
-                pod, ns, uids.get((ns, pod), "0"), self.nodes[result.node], item
+                pod, ns, uids.get((ns, pod), "0"), self.nodes[result.node],
+                item, corr=corr,
             ):
-                pass  # claim unwound, pod back on the queue
+                # claim unwound, pod back on the queue
+                if rec is not None:
+                    rec.record_decision(self._decision(
+                        pod, ns, corr, "requeued", node=result.node,
+                        queue_wait=waits.get(item.key), stats=bstats,
+                    ))
             else:
                 self._requeue_attempts.pop((ns, pod), None)
                 self._unwind(pod, ns, self.nodes[result.node], item)
@@ -431,19 +588,96 @@ class Scheduler(threading.Thread):
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
                 }
+                if rec is not None:
+                    rec.record_decision(self._decision(
+                        pod, ns, corr, "commit-failed", node=result.node,
+                        queue_wait=waits.get(item.key), stats=bstats,
+                    ))
         # commit-level count: a pod is "scheduled" only once bound (a pod
         # the solver placed but whose commit failed counts as failed, not
         # both — dashboards divide these)
         self.perf["scheduled_total"] += scheduled
         return scheduled
 
+    def _decision(
+        self,
+        pod: str,
+        ns: str,
+        corr: Optional[str],
+        outcome: str,
+        *,
+        node: Optional[str] = None,
+        queue_wait: Optional[float] = None,
+        stats=None,
+        bind: Optional[float] = None,
+    ) -> dict:
+        """One entry for the flight recorder's recent-decisions view."""
+        phases: Dict[str, float] = {}
+        if queue_wait is not None:
+            phases["queue_wait"] = queue_wait
+        if stats is not None:
+            phases["solve"] = stats.solve_seconds
+            phases["select"] = stats.select_seconds
+            phases["assign"] = stats.assign_seconds
+        if bind is not None:
+            phases["bind"] = bind
+        return {
+            "pod": pod, "ns": ns, "corr": corr, "outcome": outcome,
+            "node": node, "phases": phases, "time": time.time(),
+        }
+
+    def _explain_summary(self, item: BatchItem) -> dict:
+        """Reason histogram from the unschedulability explainer — why the
+        solver had no candidate node (reason → node count)."""
+        from nhd_tpu.solver.explain import explain
+
+        try:
+            return explain(
+                self.nodes, item.request,
+                respect_busy=self.batch.respect_busy,
+            ).summary
+        except Exception as exc:
+            # diagnosis decoration must never fail the batch: the pod's
+            # terminal outcome is already recorded; report the explainer
+            # breakage in its place
+            self.logger.warning(f"explain failed for {item.key}: {exc}")
+            return {"explain-error": 1}
+
+    def _commit_traced(
+        self, parser: CfgParser, item: BatchItem, result, corr: Optional[str]
+    ) -> Tuple[CommitOutcome, float]:
+        """_commit_pod_calls plus flight-recorder dressing: the per-pod
+        bind span, and the correlation ID bound into the context so JSON
+        log records emitted by the backend calls join the trace. Runs on
+        commit-pool threads; returns (outcome, completion stamp)."""
+        t0 = time.monotonic()
+        with correlate(corr):
+            outcome = self._commit_pod_calls(parser, item, result)
+        t_done = time.monotonic()
+        rec = get_recorder()
+        if rec is not None:
+            rec.record(
+                "bind", t0, t_done - t0, cat="pod", corr=corr,
+                attrs={
+                    "pod": f"{item.key[0]}/{item.key[1]}",
+                    "node": result.node, "outcome": outcome.name,
+                },
+            )
+        return outcome, t_done
+
     def _requeue_pod(
-        self, pod: str, ns: str, uid: str, node: HostNode, item: BatchItem
+        self, pod: str, ns: str, uid: str, node: HostNode, item: BatchItem,
+        *, corr: Optional[str] = None,
     ) -> bool:
         """Requeue a pod whose commit failed transiently (API-server
         health, not a verdict on the pod). Returns False once the per-pod
         budget is spent — the caller then takes the terminal-failure path,
-        and the periodic reconcile scan still retries at its own cadence."""
+        and the periodic reconcile scan still retries at its own cadence.
+
+        ``corr`` rides the requeued WatchItem so the retry's spans stay
+        under the pod's original correlation ID (one ID per pod across
+        transient-fault retries), and the fresh enqueue stamp makes the
+        requeue wait show up in queue_wait_seconds."""
         key = (ns, pod)
         attempts = self._requeue_attempts.get(key, 0) + 1
         if attempts > REQUEUE_MAX:
@@ -463,6 +697,8 @@ class Scheduler(threading.Thread):
         self.nqueue.put(WatchItem(
             WatchType.TRIAD_POD_CREATE,
             pod={"ns": ns, "name": pod, "uid": uid, "cfg": "", "node": ""},
+            corr=corr,
+            t_enqueue=time.monotonic(),
         ))
         return True
 
@@ -705,8 +941,11 @@ class Scheduler(threading.Thread):
                 )
         return out
 
-    def _parse_rpc_req(self, msg_type: RpcMsgType, reply_q: queue.Queue) -> None:
-        """Reference: NHDScheduler.py:408-423."""
+    def _parse_rpc_req(
+        self, msg_type: RpcMsgType, reply_q: queue.Queue, arg=None
+    ) -> None:
+        """Reference: NHDScheduler.py:408-423 (``arg`` is a rebuild
+        addition: EXPLAIN_INFO carries the queried pod)."""
         if msg_type == RpcMsgType.NODE_INFO:
             reply_q.put(self.get_basic_node_stats())
         elif msg_type == RpcMsgType.SCHEDULER_INFO:
@@ -714,7 +953,48 @@ class Scheduler(threading.Thread):
         elif msg_type == RpcMsgType.POD_INFO:
             reply_q.put(self.get_pod_stats())
         elif msg_type == RpcMsgType.PERF_INFO:
-            reply_q.put(dict(self.perf))
+            perf = dict(self.perf)
+            perf["event_queue_depth"] = self.nqueue.qsize()
+            perf["uptime_seconds"] = time.monotonic() - self.t_started
+            reply_q.put(perf)
+        elif msg_type == RpcMsgType.EXPLAIN_INFO:
+            arg = arg or {}
+            reply_q.put(self.explain_request(
+                arg.get("request"), arg.get("label", "?")
+            ))
+
+    def explain_request(self, req: Optional[PodRequest], label: str) -> dict:
+        """Unschedulability diagnosis for a pre-built request against the
+        current mirror (solver/explain.py as data, served over GET
+        /explain). Runs on the scheduler thread — the single owner of
+        ``self.nodes`` — via RpcMsgType.EXPLAIN_INFO; the backend I/O
+        that built ``req`` already happened on the caller's thread
+        (build_explain_request), so this handler touches only in-memory
+        state and cannot stall the scheduling loop on a degraded API
+        server. Never raises: the reply is a diagnosis either way."""
+        try:
+            if req is None:
+                return {"error": "no request supplied"}
+            from nhd_tpu.solver.explain import explain
+
+            rep = explain(
+                self.nodes, req, respect_busy=self.batch.respect_busy
+            )
+            return {
+                "pod": label,
+                "request": rep.pod_summary,
+                "summary": rep.summary,
+                "schedulable_nodes": rep.schedulable_nodes,
+                "verdicts": [
+                    {"node": v.node, "reason": v.reason, "detail": v.detail}
+                    for v in rep.verdicts
+                ],
+            }
+        except Exception as exc:
+            # a diagnostics query must answer with the failure, not kill
+            # the single-writer thread
+            self.logger.exception(f"explain failed for {label}")
+            return {"error": f"explain failed: {exc}"}
 
     # ------------------------------------------------------------------
     # event handling
@@ -741,7 +1021,10 @@ class Scheduler(threading.Thread):
                 # uid changed: stale record — release and resync
                 self.release_pod_resources(pod, ns)
                 self.pod_state.pop((ns, pod), None)
-            self.attempt_scheduling_batch([(pod, ns, uid)])
+            self.attempt_scheduling_batch(
+                [(pod, ns, uid)],
+                meta={(ns, pod): (item.corr, item.t_enqueue)},
+            )
 
         elif item.type in (WatchType.NODE_CORDON, WatchType.NODE_UNCORDON):
             node = self.nodes.get(item.node)
@@ -793,7 +1076,7 @@ class Scheduler(threading.Thread):
         most one loop turn, bind latency drops to solver time."""
         try:
             rpc = self.rpcq.get(block=False)
-            self._parse_rpc_req(rpc[0], rpc[1])
+            self._parse_rpc_req(*rpc)
             return idle_count
         except queue.Empty:
             pass

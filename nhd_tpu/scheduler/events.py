@@ -35,6 +35,11 @@ class WatchItem:
     pod: Optional[Dict[str, str]] = None   # {'ns', 'name', 'uid'}
     node: Optional[str] = None
     groups: Optional[str] = None
+    # flight-recorder plumbing (obs/): the correlation ID minted at
+    # watch-event receipt and the enqueue stamp (time.monotonic) — the
+    # scheduler turns their difference into the queue-wait span/histogram
+    corr: Optional[str] = None
+    t_enqueue: float = 0.0
 
 
 class WatchQueue:
@@ -51,3 +56,7 @@ class WatchQueue:
 
     def empty(self) -> bool:
         return self._q.empty()
+
+    def qsize(self) -> int:
+        """Approximate depth (the nhd_event_queue_depth gauge)."""
+        return self._q.qsize()

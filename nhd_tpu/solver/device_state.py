@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nhd_tpu.obs.jitstats import JIT_STATS
 from nhd_tpu.solver.encode import ClusterArrays
 from nhd_tpu.solver.kernel import (
     SolveOut,
@@ -177,6 +178,12 @@ class DeviceClusterState:
         """The padded solver call against the resident arrays
         ([Tp, Np] outputs, still on device)."""
         self._flush_staged()
+        JIT_STATS.record_use(
+            "solve",
+            f"G{pods.G}_U{self.cluster.U}_K{self.cluster.K}"
+            f"_T{_pad_pow2(pods.n_types)}_N{self.Np}"
+            + ("_mesh" if self.mesh is not None else ""),
+        )
         if self.mesh is not None:
             from nhd_tpu.parallel.sharding import get_sharded_solver
 
@@ -222,6 +229,11 @@ class DeviceClusterState:
             )
 
         self._flush_staged()  # async wholesale re-upload of dirty state
+        JIT_STATS.record_use(
+            "solve_rank_fused",
+            f"G{pods.G}_U{self.cluster.U}_K{self.cluster.K}"
+            f"_R{R}_T{_pad_pow2(pods.n_types)}_N{self.Np}",
+        )
         fused = _get_fused_ranked(
             pods.G, self.cluster.U, self.cluster.K, R,
         )
@@ -260,6 +272,11 @@ class DeviceClusterState:
         self._flush_staged()
         shapes = tuple(
             (pods.G, _pad_pow2(pods.n_types)) for pods in bucket_pods
+        )
+        JIT_STATS.record_use(
+            "megaround",
+            "B" + "_".join(f"G{g}T{t}" for g, t in shapes)
+            + f"_U{self.cluster.U}_K{self.cluster.K}_N{self.Np}",
         )
         out_shardings_key = None
         if self._node_sharding is not None:

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nhd_tpu.obs.jitstats import JIT_STATS
 from nhd_tpu.solver.combos import get_tables
 
 
@@ -324,6 +325,10 @@ def solve_bucket_ranked(cluster, pods, R: int) -> jax.Array:
         )
 
     out = _solve_padded(cluster, pods)
+    # recompile accounting: the ranker specializes on (R, padded T)
+    JIT_STATS.record_use(
+        "rank", f"R{min(R, Np)}_T{_pad_pow2(pods.n_types)}_N{Np}"
+    )
     ranker = _get_ranker(min(R, Np))
     return ranker(
         out.cand, out.pref, out.best_c, out.best_m, out.best_a, out.n_picks,
@@ -351,6 +356,13 @@ def _solve_padded(cluster, pods) -> SolveOut:
             [a, np.zeros((Tp - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
         )
 
+    # recompile accounting (obs/jitstats.py): the compiled program is
+    # keyed by the bucket (G, U, K) plus the padded axes XLA specializes
+    # on — a first-seen key here IS a fresh trace+compile, the silent
+    # stall the nhd_jit_* metrics make scrapeable
+    JIT_STATS.record_use(
+        "solve", f"G{pods.G}_U{cluster.U}_K{cluster.K}_T{Tp}_N{Np}"
+    )
     solver = get_solver(pods.G, cluster.U, cluster.K)
     return solver(
         pad_n(cluster.numa_nodes), pad_n(cluster.smt), pad_n(cluster.active),

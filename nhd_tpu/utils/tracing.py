@@ -4,7 +4,12 @@ The reference has no observability beyond logs (SURVEY §5.1). Here:
 
 * ``phase`` — a context-managed wall-clock phase timer accumulating into a
   dict, for callers instrumenting multi-stage flows (BatchScheduler keeps
-  its own typed BatchStats fields for the solve/select/assign breakdown);
+  its own typed BatchStats fields for the solve/select/assign breakdown).
+  When the flight recorder (nhd_tpu/obs) is enabled, each phase also
+  lands in the span ring under the context correlation ID — existing
+  call sites join the trace with no edits;
+* ``span`` — re-exported from the flight recorder for call sites that
+  want a span without a local accumulator dict;
 * ``profiler_trace`` — wraps a block in ``jax.profiler.trace`` when a
   directory is given (view with TensorBoard / xprof), no-op otherwise.
   bench.py enables it via NHD_BENCH_PROFILE=<dir>.
@@ -16,15 +21,24 @@ import contextlib
 import time
 from typing import Dict, Iterator, Optional
 
+from nhd_tpu.obs.recorder import get_recorder, span
+
+__all__ = ["phase", "profiler_trace", "span"]
+
 
 @contextlib.contextmanager
 def phase(acc: Dict[str, float], name: str) -> Iterator[None]:
-    """Accumulate the block's wall time into ``acc[name]``."""
+    """Accumulate the block's wall time into ``acc[name]`` (and the
+    flight-recorder ring, when tracing is on)."""
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        acc[name] = acc.get(name, 0.0) + time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        acc[name] = acc.get(name, 0.0) + dt
+        rec = get_recorder()
+        if rec is not None:
+            rec.record(name, time.monotonic() - dt, dt, cat="phase")
 
 
 @contextlib.contextmanager

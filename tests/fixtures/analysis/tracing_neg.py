@@ -1,4 +1,6 @@
 # nhdlint fixture: tracing-pack patterns that must NOT be flagged.
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -10,6 +12,15 @@ def plain_host_function(x):
     if x > 0:
         return int(x)
     return np.asarray(x)
+
+
+def host_timing(acc, x):
+    # not jit-reachable: wall-clock timing on the host is the normal
+    # pattern (utils/tracing.py phase does exactly this)
+    t0 = time.perf_counter()
+    y = plain_host_function(x)
+    acc["stage"] = time.perf_counter() - t0
+    return y
 
 
 @jax.jit

@@ -1,6 +1,8 @@
 # nhdlint fixture: every tracing-pack hazard, one per line.
 # Flagged lines carry EXPECT markers the fixture tests parse; this file
 # is analyzed as text only, never imported.
+import time
+
 import jax
 import numpy as np
 from functools import partial
@@ -24,6 +26,14 @@ solver = jax.jit(kernel)  # marks kernel as jit-traced
 def decorated(a):
     b = a * 2
     return float(b)  # EXPECT[NHD101]
+
+
+@jax.jit
+def timed_kernel(a):
+    t0 = time.perf_counter()  # EXPECT[NHD106] — trace-time constant
+    b = a * 2
+    dt = time.time() - t0  # EXPECT[NHD106]
+    return b, dt
 
 
 def helper(c):

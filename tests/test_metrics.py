@@ -1,19 +1,27 @@
 """Prometheus metrics endpoint tests — deliberately grpc-free: the exporter
-is stdlib-only and must keep working without the optional cluster extras."""
+is stdlib-only and must keep working without the optional cluster extras.
+Covers the histogram families that replaced the lossy last_* gauges
+(PR 3), the exposition-format exactness rules, and the flight-recorder
+HTTP views (/decisions, /explain, /trace)."""
 
+import json
 import queue
+import re
 import threading
 import urllib.error
 import urllib.request
 
 import pytest
 
+import nhd_tpu.obs as obs
+from nhd_tpu.obs.histo import Histogram, reset_all
 from nhd_tpu.rpc.metrics import MetricsServer, render_metrics
 from tests.test_scheduler import make_backend, make_scheduler, pod_cfg
 
 
 @pytest.fixture
 def metrics_stack():
+    reset_all()  # histogram registry is process-global; isolate counts
     backend = make_backend(n_nodes=2)
     backend.create_pod("triad-0", cfg_text=pod_cfg())
     sched = make_scheduler(backend)
@@ -27,20 +35,24 @@ def metrics_stack():
                 item = sched.rpcq.get(timeout=0.05)
             except queue.Empty:
                 continue
-            sched._parse_rpc_req(item[0], item[1])
+            sched._parse_rpc_req(*item)
 
     threading.Thread(target=pump, daemon=True).start()
-    server = MetricsServer(sched.rpcq, port=0)
+    server = MetricsServer(sched.rpcq, port=0, backend=backend)
     server.start()
     yield server
     server.stop()
     stop.set()
 
 
-def test_metrics_endpoint(metrics_stack):
-    body = urllib.request.urlopen(
-        f"http://localhost:{metrics_stack.port}/metrics", timeout=5
+def _get(server, path: str) -> str:
+    return urllib.request.urlopen(
+        f"http://localhost:{server.port}{path}", timeout=5
     ).read().decode()
+
+
+def test_metrics_endpoint(metrics_stack):
+    body = _get(metrics_stack, "/metrics")
     assert "nhd_failed_schedule_total 0" in body
     assert 'nhd_node_pods{node="node0"} 1' in body
     assert 'nhd_node_active{node="node1"} 1' in body
@@ -49,14 +61,83 @@ def test_metrics_endpoint(metrics_stack):
     assert "nhd_batches_total 1" in body
     assert "nhd_scheduled_total 1" in body
     assert "nhd_solve_seconds_total" in body
-    assert "nhd_last_bind_p99_seconds" in body
+    # PR 3 gap fixes: queue depth, uptime, trace-ring occupancy
+    assert "nhd_event_queue_depth 0" in body
+    assert "nhd_uptime_seconds" in body
+    assert "nhd_trace_ring_spans 0" in body
+    assert "nhd_trace_enabled 0" in body
+    # JIT program accounting from the batch's solves
+    assert "nhd_jit_compiles_total" in body
+    assert 'nhd_jit_shape_uses_total{shape="' in body
+
+
+def test_metrics_histogram_families(metrics_stack):
+    """Acceptance: >= 4 histogram families with correct cumulative
+    buckets serve on /metrics."""
+    body = _get(metrics_stack, "/metrics")
+    families = set(re.findall(r"# TYPE (nhd_\w+) histogram", body))
+    assert {
+        "nhd_bind_latency_seconds", "nhd_queue_wait_seconds",
+        "nhd_solve_phase_seconds", "nhd_select_phase_seconds",
+        "nhd_assign_phase_seconds", "nhd_api_call_seconds",
+    } <= families
+    assert len(families) >= 4
+    # the fixture's one batch observed exactly one phase sample and one
+    # bind; cumulative buckets must be monotone and end at the count
+    for fam in ("nhd_solve_phase_seconds", "nhd_bind_latency_seconds"):
+        counts = [
+            int(m) for m in re.findall(
+                fam + r'_bucket\{le="[^"]+"\} (\d+)', body
+            )
+        ]
+        assert counts == sorted(counts), f"{fam} buckets not cumulative"
+        total = int(re.search(fam + r"_count (\d+)", body).group(1))
+        assert counts[-1] == total == 1
+    # the lossy last_* gauges are gone
+    assert "nhd_last_batch_pods" not in body
+    assert "nhd_last_bind_p99_seconds" not in body
+
+
+def test_histogram_buckets_exact():
+    h = Histogram("t_seconds", "test histogram", (0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 5.0, 50.0):
+        h.observe(v)
+    cum, total_sum, count = h.snapshot()
+    # le is inclusive: the 0.1 observation lands in the 0.1 bucket
+    assert cum == [2, 3, 4, 5]
+    assert count == 5 and total_sum == 55.65
+    lines = h.render()
+    assert "# TYPE nhd_t_seconds histogram" in lines
+    assert 'nhd_t_seconds_bucket{le="0.1"} 2' in lines
+    assert 'nhd_t_seconds_bucket{le="1"} 3' in lines
+    assert 'nhd_t_seconds_bucket{le="10"} 4' in lines
+    assert 'nhd_t_seconds_bucket{le="+Inf"} 5' in lines
+    # exact (non-:g) rendering for sum and count
+    assert "nhd_t_seconds_sum 55.65" in lines
+    assert "nhd_t_seconds_count 5" in lines
+
+
+def test_histogram_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        Histogram("x", "h", ())
+    with pytest.raises(ValueError):
+        Histogram("x", "h", (1.0, 0.5))
+    with pytest.raises(ValueError):
+        Histogram("x", "h", (1.0, 1.0))
+
+
+def test_histogram_large_counts_render_exactly():
+    h = Histogram("big_seconds", "exactness", (1.0,))
+    h._counts[0] = 10_000_019  # > 1e6: the :g precision-loss regime
+    h._count = 10_000_019
+    lines = h.render()
+    assert 'nhd_big_seconds_bucket{le="1"} 10000019' in lines
+    assert "nhd_big_seconds_count 10000019" in lines
 
 
 def test_metrics_query_string_ok(metrics_stack):
     """Prometheus params add a query string; still a valid scrape."""
-    body = urllib.request.urlopen(
-        f"http://localhost:{metrics_stack.port}/metrics?collect=node", timeout=5
-    ).read().decode()
+    body = _get(metrics_stack, "/metrics?collect=node")
     assert "nhd_node_free_cpus" in body
 
 
@@ -65,6 +146,58 @@ def test_metrics_404(metrics_stack):
         urllib.request.urlopen(
             f"http://localhost:{metrics_stack.port}/nope", timeout=5
         )
+
+
+def test_explain_endpoint(metrics_stack):
+    """GET /explain?pod= reuses solver/explain.py through the scheduler
+    thread (the single owner of the node mirror)."""
+    out = json.loads(_get(metrics_stack, "/explain?pod=default/triad-0"))
+    assert out["pod"] == "default/triad-0"
+    assert "schedulable" in out["summary"] or out["summary"]
+    assert isinstance(out["verdicts"], list) and len(out["verdicts"]) == 2
+    # bare pod name defaults to the default namespace
+    out2 = json.loads(_get(metrics_stack, "/explain?pod=triad-0"))
+    assert out2["pod"] == "default/triad-0"
+
+
+def test_explain_endpoint_errors(metrics_stack):
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _get(metrics_stack, "/explain?pod=default/ghost")
+    assert exc_info.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _get(metrics_stack, "/explain")
+    assert exc_info.value.code == 400
+
+
+def test_decisions_endpoint_recorder_off(metrics_stack):
+    out = json.loads(_get(metrics_stack, "/decisions"))
+    assert out == {"enabled": False, "decisions": []}
+
+
+def test_decisions_and_trace_endpoints_recorder_on(metrics_stack):
+    rec = obs.enable(capacity=256)
+    try:
+        rec.record("solve", 1.0, 0.5, cat="pod", corr="c-x")
+        rec.record_decision({
+            "pod": "p0", "ns": "default", "corr": "c-x",
+            "outcome": "scheduled", "node": "node0", "phases": {},
+        })
+        out = json.loads(_get(metrics_stack, "/decisions?n=5"))
+        assert out["enabled"] and out["decisions"][0]["pod"] == "p0"
+        trace = json.loads(_get(metrics_stack, "/trace"))
+        assert obs.validate_chrome_trace(trace) == []
+        body = _get(metrics_stack, "/metrics")
+        assert "nhd_trace_enabled 1" in body
+        assert "nhd_trace_ring_spans 1" in body
+        assert "nhd_trace_ring_capacity 256" in body
+    finally:
+        obs.disable()
+
+
+def test_trace_endpoint_recorder_off(metrics_stack):
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _get(metrics_stack, "/trace")
+    assert exc_info.value.code == 404
 
 
 def test_stop_releases_port(metrics_stack):

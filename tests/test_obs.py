@@ -1,0 +1,316 @@
+"""Flight-recorder tests: ring semantics, Chrome trace export (golden),
+cross-thread recording, and the end-to-end correlation-ID pipeline
+(watch-event receipt → queue → solve/select/assign → bind)."""
+
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+import nhd_tpu.obs as obs
+from nhd_tpu.obs import (
+    FlightRecorder,
+    Span,
+    chrome_trace_of,
+    correlate,
+    validate_chrome_trace,
+)
+from nhd_tpu.scheduler.controller import Controller
+from nhd_tpu.utils.logging import JsonFormatter
+from tests.test_scheduler import make_backend, make_scheduler, pod_cfg
+
+GOLDEN = Path(__file__).resolve().parent / "fixtures" / "obs"
+
+
+@pytest.fixture
+def recorder():
+    rec = obs.enable(capacity=4096)
+    yield rec
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+def test_ring_bounds_and_drop_accounting():
+    rec = FlightRecorder(capacity=8, decision_capacity=4)
+    for i in range(20):
+        rec.record(f"s{i}", float(i), 0.5)
+    assert rec.occupancy() == 8
+    assert rec.dropped() == 12
+    names = [s.name for s in rec.spans()]
+    assert names == [f"s{i}" for i in range(12, 20)]  # oldest evicted
+    for i in range(6):
+        rec.record_decision({"pod": f"p{i}", "outcome": "scheduled"})
+    got = rec.recent_decisions(10)
+    assert [d["pod"] for d in got] == ["p5", "p4", "p3", "p2"]  # newest first
+    rec.clear()
+    assert rec.occupancy() == 0 and rec.dropped() == 0
+
+
+def test_span_context_manager_and_disabled_noop():
+    obs.disable()
+    with obs.span("never"):
+        pass  # recorder off: must not raise, must not record anywhere
+    rec = obs.enable(capacity=16)
+    try:
+        with correlate("c-test"):
+            with obs.span("timed", cat="unit", attrs={"k": 1}):
+                pass
+        (s,) = rec.spans()
+        assert s.name == "timed" and s.cat == "unit"
+        assert s.corr == "c-test" and s.attrs == {"k": 1}
+        assert s.dur >= 0.0
+    finally:
+        obs.disable()
+
+
+def test_corr_ids_unique_and_context_bound():
+    a, b = obs.new_corr_id(), obs.new_corr_id()
+    assert a != b
+    assert obs.current_corr_id() is None
+    with correlate(a):
+        assert obs.current_corr_id() == a
+        with correlate(b):
+            assert obs.current_corr_id() == b
+        assert obs.current_corr_id() == a
+    assert obs.current_corr_id() is None
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export
+# ---------------------------------------------------------------------------
+
+def _golden_spans():
+    """A deterministic one-pod pipeline (exact binary-fraction durations,
+    so the µs conversion is lossless across platforms)."""
+    pod = {"pod": "default/triad-0"}
+    return [
+        Span("watch_event", 1.0, 0.0, cat="event", corr="c000001",
+             thread="nhd-controller",
+             attrs={"kind": "pod_create", "pod": "default/triad-0"}),
+        Span("queue_wait", 1.0, 0.25, cat="pod", corr="c000001",
+             thread="nhd-scheduler", attrs=pod),
+        Span("batch", 1.25, 1.1875, cat="batch", corr="c000002",
+             thread="nhd-scheduler", attrs={"pods": 1, "rounds": 1}),
+        Span("solve", 1.25, 0.5, cat="pod", corr="c000001",
+             thread="nhd-scheduler", attrs=pod),
+        Span("select", 1.75, 0.125, cat="pod", corr="c000001",
+             thread="nhd-scheduler", attrs=pod),
+        Span("assign", 1.875, 0.0625, cat="pod", corr="c000001",
+             thread="nhd-scheduler", attrs=pod),
+        Span("bind", 1.9375, 0.5, cat="pod", corr="c000001",
+             thread="nhd-scheduler",
+             attrs={"pod": "default/triad-0", "node": "node0",
+                    "outcome": "OK"}),
+    ]
+
+
+def test_chrome_trace_golden():
+    """The serialized export is pinned byte-for-byte: viewers are lenient,
+    diffs are not — any drift in event shape must be a conscious change
+    (regenerate with `python tools/trace_demo.py --regen-golden`)."""
+    got = json.dumps(
+        chrome_trace_of(_golden_spans()), indent=2, sort_keys=True
+    ) + "\n"
+    golden = (GOLDEN / "golden_trace.json").read_text()
+    assert got == golden
+
+
+def test_chrome_trace_validates_and_orders():
+    trace = chrome_trace_of(_golden_spans())
+    assert validate_chrome_trace(trace) == []
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+    # thread metadata rows exist for both producing threads
+    meta = {e["args"]["name"] for e in trace["traceEvents"]
+            if e["ph"] == "M"}
+    assert meta == {"nhd-controller", "nhd-scheduler"}
+
+
+def test_validator_rejects_malformed():
+    assert validate_chrome_trace([]) != []
+    assert validate_chrome_trace({"traceEvents": "nope"}) != []
+    assert validate_chrome_trace(
+        {"traceEvents": [{"ph": "X", "name": "a", "pid": 1, "tid": 1,
+                          "ts": -5, "dur": 0}]}
+    ) != []
+    assert validate_chrome_trace(
+        {"traceEvents": [{"ph": "Q", "name": "a", "pid": 1, "tid": 1}]}
+    ) != []
+
+
+# ---------------------------------------------------------------------------
+# concurrency: spans from multiple threads never interleave corruptly
+# ---------------------------------------------------------------------------
+
+def test_concurrent_recording_is_uncorrupted():
+    rec = FlightRecorder(capacity=1000)
+    n_threads, per_thread = 4, 2000
+    start = threading.Barrier(n_threads)
+
+    def worker(tid: int):
+        start.wait()
+        for i in range(per_thread):
+            rec.record(
+                f"t{tid}", float(i), 0.001, cat="conc",
+                corr=f"c-t{tid}-{i}", thread=f"worker-{tid}",
+            )
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = rec.spans()
+    assert len(spans) == 1000  # exactly capacity — no loss accounting drift
+    assert rec.dropped() == n_threads * per_thread - 1000
+    for s in spans:
+        # every span is internally consistent: its corr names its own
+        # producing thread and iteration (a torn write would mismatch)
+        tid = s.name[1:]
+        assert s.thread == f"worker-{tid}"
+        assert s.corr.startswith(f"c-t{tid}-")
+        assert s.cat == "conc" and s.dur == 0.001
+    assert validate_chrome_trace(chrome_trace_of(spans)) == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the correlation ID threads watch receipt → bind
+# ---------------------------------------------------------------------------
+
+def _drain(sched):
+    while not sched.nqueue.empty():
+        sched.run_once()
+
+
+def test_watch_to_bind_shares_one_corr_id(recorder):
+    backend = make_backend(n_nodes=2)
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+    backend.create_pod("triad-0", cfg_text=pod_cfg())  # emits watch event
+    ctrl.run_once()
+    _drain(sched)
+    assert backend.pods[("default", "triad-0")].node is not None
+
+    by_corr = {}
+    for s in recorder.spans():
+        by_corr.setdefault(s.corr, set()).add(s.name)
+    pod_corrs = [
+        corr for corr, names in by_corr.items()
+        if {"watch_event", "queue_wait", "solve", "select", "assign",
+            "bind"} <= names
+    ]
+    assert pod_corrs, f"no corr carries the full pipeline: {by_corr}"
+
+    # the queue-wait histogram saw the event→admission gap
+    from nhd_tpu.obs.histo import HISTOGRAMS
+
+    assert HISTOGRAMS["queue_wait_seconds"].snapshot()[2] >= 1
+
+    # decisions view: the pod is there, newest first, with phases
+    (d,) = [d for d in recorder.recent_decisions(10)
+            if d["pod"] == "triad-0"]
+    assert d["outcome"] == "scheduled" and d["node"] is not None
+    assert d["corr"] in pod_corrs
+    assert {"solve", "select", "assign", "bind"} <= set(d["phases"])
+
+    # and the whole ring exports a loadable trace
+    assert validate_chrome_trace(obs.chrome_trace(recorder)) == []
+
+
+def test_requeued_pod_keeps_its_corr_id(recorder):
+    """A transient bind failure requeues the pod; the retry's spans and
+    decision stay under the ORIGINAL correlation ID (one ID per pod
+    across fault-recovery retries)."""
+    from nhd_tpu.sim.faults import FaultProfile, FaultyBackend
+
+    backend = make_backend(n_nodes=2)
+    sched = make_scheduler(backend)
+    ctrl = Controller(backend, sched.nqueue)
+    faulty = FaultyBackend(
+        backend, FaultProfile(name="t", transient_bind=1.0)
+    )
+    sched.backend = faulty  # scheduler commits through the fault shim
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    for _ in range(8):
+        ctrl.run_once(now=0.0)
+        _drain(sched)
+    assert backend.pods[("default", "triad-0")].node is not None
+    decisions = [d for d in recorder.recent_decisions(20)
+                 if d["pod"] == "triad-0"]
+    outcomes = {d["outcome"] for d in decisions}
+    assert {"requeued", "scheduled"} <= outcomes
+    assert len({d["corr"] for d in decisions}) == 1
+    bind_corrs = {s.corr for s in recorder.spans() if s.name == "bind"}
+    assert bind_corrs == {decisions[0]["corr"]}  # both attempts, one ID
+
+
+def test_unschedulable_decision_carries_explain_reasons(recorder):
+    backend = make_backend(n_nodes=2)
+    sched = make_scheduler(backend)
+    backend.create_pod(
+        "greedy-0", cfg_text=pod_cfg(hugepages_gb=100000)
+    )
+    sched.check_pending_pods()
+    (d,) = [d for d in recorder.recent_decisions(10)
+            if d["pod"] == "greedy-0"]
+    assert d["outcome"] == "unschedulable"
+    assert d["reasons"].get("insufficient-hugepages") == 2
+
+
+def test_chaos_run_with_tracing_produces_valid_trace(recorder):
+    """Acceptance: a sim run with tracing enabled produces a Chrome trace
+    that loads, with solve/select/assign/bind spans sharing one corr ID
+    per pod."""
+    from nhd_tpu.sim.chaos import ChaosSim
+
+    sim = ChaosSim(seed=3, n_nodes=4)
+    stats = sim.run(steps=15)
+    assert stats.violations == []
+    trace = obs.chrome_trace(recorder)
+    assert validate_chrome_trace(trace) == []
+    by_corr = {}
+    for s in recorder.spans():
+        by_corr.setdefault(s.corr, set()).add(s.name)
+    assert any(
+        {"solve", "select", "assign", "bind"} <= names
+        for names in by_corr.values()
+    ), "no pod corr carries solve/select/assign/bind"
+    assert recorder.recent_decisions(5)
+
+
+# ---------------------------------------------------------------------------
+# JSON logging joins the trace via the corr id
+# ---------------------------------------------------------------------------
+
+def test_json_log_formatter_stamps_corr_id():
+    import logging
+
+    fmt = JsonFormatter()
+    record = logging.LogRecord(
+        "nhd.test", logging.WARNING, __file__, 1, "bind failed for %s",
+        ("default/p0",), None,
+    )
+    with correlate("c-log-1"):
+        line = fmt.format(record)
+    out = json.loads(line)
+    assert out["corr"] == "c-log-1"
+    assert out["msg"] == "bind failed for default/p0"
+    assert out["level"] == "WARNING" and out["logger"] == "nhd.test"
+    # outside any correlate block the field is null, never absent
+    out2 = json.loads(fmt.format(record))
+    assert out2["corr"] is None
+
+
+def test_json_log_formatter_env_switch(monkeypatch):
+    from nhd_tpu.utils import logging as nhd_logging
+
+    monkeypatch.setenv("NHD_LOG_JSON", "1")
+    assert isinstance(nhd_logging._pick_formatter(), JsonFormatter)
+    monkeypatch.delenv("NHD_LOG_JSON")
+    assert not isinstance(nhd_logging._pick_formatter(), JsonFormatter)

@@ -5,6 +5,7 @@ an asserting, hermetic round trip: fake cluster → scheduler → gRPC server
 → client.
 """
 
+import json
 import queue
 import threading
 
@@ -33,7 +34,7 @@ def stack():
                 item = sched.rpcq.get(timeout=0.05)
             except queue.Empty:
                 continue
-            sched._parse_rpc_req(item[0], item[1])
+            sched._parse_rpc_req(*item)
 
     pump_thread = threading.Thread(target=pump, daemon=True)
     pump_thread.start()
@@ -92,6 +93,34 @@ def test_detailed_node_stats(stack):
     empty = client.get_detailed_node_stats("node1")
     assert empty.status == pb.NHD_STATUS_OK
     assert len(empty.podinfo) == 0
+
+
+def test_recent_decisions_roundtrip(stack):
+    """The flight-recorder decisions view over the gRPC plane (JSON-over-
+    bytes generic method — no generated stubs on this image)."""
+    import nhd_tpu.obs as obs
+
+    _, _, client = stack
+    out = client.get_recent_decisions()
+    assert out == {"enabled": False, "decisions": []}
+    rec = obs.enable(capacity=64)
+    try:
+        rec.record_decision({
+            "pod": "p0", "ns": "default", "corr": "c-grpc",
+            "outcome": "scheduled", "node": "node0", "phases": {},
+        })
+        rec.record_decision({
+            "pod": "p1", "ns": "default", "corr": "c-grpc2",
+            "outcome": "unschedulable", "node": None, "phases": {},
+        })
+        out = client.get_recent_decisions(n=1)
+        assert out["enabled"] is True
+        assert [d["pod"] for d in out["decisions"]] == ["p1"]  # newest
+        # malformed "n" degrades to the default instead of erroring
+        raw = client._calls["GetRecentDecisions"](b'{"n": null}')
+        assert len(json.loads(raw.decode())["decisions"]) == 2
+    finally:
+        obs.disable()
 
 
 def test_scheduler_unresponsive_returns_err(monkeypatch):

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Flight-recorder demo + schema gate: run the sim with tracing on,
+dump the Chrome trace, and validate it (`make trace-demo`).
+
+Drives the fake-backend control plane the same way the daemon does —
+pods arrive through the watch queue, the controller translates, the
+scheduler batches and binds — with the recorder enabled, then:
+
+1. writes the Chrome trace JSON (open in chrome://tracing or
+   https://ui.perfetto.dev) to --out;
+2. validates it against the schema the tests enforce
+   (nhd_tpu.obs.validate_chrome_trace);
+3. checks every bound pod's correlation ID carries the full
+   solve/select/assign/bind pipeline;
+4. prints the recent-decisions view.
+
+Exits non-zero on any validation failure, so CI can gate on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+ROOT = __import__("pathlib").Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def regen_golden() -> int:
+    """Rewrite the golden Chrome-trace fixture from the exact span set
+    tests/test_obs.py pins — the one sanctioned way to accept a
+    deliberate export-format change."""
+    sys.path.insert(0, str(ROOT / "tests"))
+    from test_obs import _golden_spans  # noqa: E402 (fixture source)
+
+    from nhd_tpu.obs import chrome_trace_of
+
+    out = json.dumps(
+        chrome_trace_of(_golden_spans()), indent=2, sort_keys=True
+    ) + "\n"
+    path = ROOT / "tests" / "fixtures" / "obs" / "golden_trace.json"
+    path.write_text(out)
+    print(f"trace-demo: golden regenerated → {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="nhd_tpu trace demo")
+    parser.add_argument("--out", default="/tmp/nhd_trace_demo",
+                        help="directory for the dumped trace JSON")
+    parser.add_argument("--pods", type=int, default=6)
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--regen-golden", action="store_true",
+                        help="regenerate tests/fixtures/obs/"
+                             "golden_trace.json from the deterministic "
+                             "span set in tests/test_obs.py, then exit")
+    args = parser.parse_args(argv)
+
+    if args.regen_golden:
+        return regen_golden()
+
+    import nhd_tpu.obs as obs
+    from nhd_tpu.k8s.fake import FakeClusterBackend
+    from nhd_tpu.scheduler.controller import Controller
+    from nhd_tpu.scheduler.core import Scheduler
+    from nhd_tpu.scheduler.events import WatchQueue
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+
+    rec = obs.enable(capacity=16384)
+
+    backend = FakeClusterBackend()
+    for i in range(args.nodes):
+        spec = SynthNodeSpec(name=f"demo-node{i}")
+        backend.add_node(spec.name, make_node_labels(spec),
+                         hugepages_gb=spec.hugepages_gb)
+    sched = Scheduler(backend, WatchQueue(), respect_busy=False)
+    sched.build_initial_node_list()
+    controller = Controller(backend, sched.nqueue)
+
+    for i in range(args.pods):
+        backend.create_pod(
+            f"demo-{i}",
+            cfg_text=make_triad_config(gpus_per_group=i % 2, cpu_workers=2),
+        )
+        controller.run_once()
+        while not sched.nqueue.empty():
+            sched.run_once()
+
+    bound = sum(1 for p in backend.pods.values() if p.node)
+    print(f"trace-demo: {bound}/{args.pods} pods bound "
+          f"across {args.nodes} nodes")
+
+    trace = obs.chrome_trace(rec)
+    errors = obs.validate_chrome_trace(trace)
+    if errors:
+        print("trace-demo: SCHEMA INVALID:")
+        for e in errors[:10]:
+            print(f"  {e}")
+        return 1
+    path = obs.dump_chrome_trace(rec, args.out, stem="trace_demo")
+    print(f"trace-demo: schema OK, {len(trace['traceEvents'])} events "
+          f"→ {path}")
+
+    # every bound pod's corr must carry the full pipeline
+    by_corr: dict = {}
+    for s in rec.spans():
+        by_corr.setdefault(s.corr, set()).add(s.name)
+    want = {"queue_wait", "solve", "select", "assign", "bind"}
+    complete = sum(1 for names in by_corr.values() if want <= names)
+    print(f"trace-demo: {complete} correlation id(s) carry the full "
+          f"{'/'.join(sorted(want))} pipeline")
+    if complete < bound:
+        print(f"trace-demo: FAIL — expected >= {bound}")
+        return 1
+
+    print("trace-demo: recent decisions:")
+    for d in rec.recent_decisions(5):
+        phases = {k: f"{v * 1e3:.2f}ms" for k, v in d["phases"].items()}
+        print(f"  {d['ns']}/{d['pod']} corr={d['corr']} "
+              f"{d['outcome']} node={d['node']} {json.dumps(phases)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
