@@ -9,7 +9,7 @@ SOAK_STEPS ?= 120
 CHAOS_SEEDS ?= 6
 CHAOS_STEPS ?= 60
 
-.PHONY: test lint proto bench wheel clean native soak chaos trace-demo docker docker-smoke release
+.PHONY: test lint sanitize proto bench wheel clean native soak chaos trace-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -21,9 +21,11 @@ test:
 
 # static analysis: nhdlint (stdlib, always runs; also gates tier-1 via
 # tests/test_static_analysis.py) + ruff + scoped mypy when installed
-# (configs in pyproject.toml; rule docs in docs/STATIC_ANALYSIS.md)
+# (configs in pyproject.toml; rule docs in docs/STATIC_ANALYSIS.md).
+# Covers tools/ and tests/ too; the deliberate-violation lint fixtures
+# are excluded. Lock-graph export: add --lock-graph-dot graph.dot
 lint:
-	python -m nhd_tpu.analysis nhd_tpu
+	python -m nhd_tpu.analysis nhd_tpu tools tests --exclude tests/fixtures
 	@if python -c "import ruff" >/dev/null 2>&1; then \
 		python -m ruff check nhd_tpu; \
 	else \
@@ -34,6 +36,14 @@ lint:
 	else \
 		echo "mypy not installed; skipping (pip install mypy)"; \
 	fi
+
+# runtime deadlock sanitizer (nhdsan, nhd_tpu/sanitizer/): the
+# concurrency-heavy suites under instrumented locks — a wait-for-graph
+# cycle fails loud with a witness instead of hanging the run
+# (docs/OBSERVABILITY.md; NHD_SAN_REPORT holds the dump path)
+sanitize:
+	NHD_SAN=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
+		tests/test_streaming.py tests/test_faults.py -q
 
 # full release gate: lint + suite + benchmark smoke on the CPU backend
 check: lint test

@@ -13,13 +13,23 @@ dependency, so the gate runs everywhere the tests run):
   excepts      NHD3xx  exception hygiene (silently swallowed errors)
   determinism  NHD4xx  unseeded randomness / wall-clock in solver paths
 
+plus one *project* pack that sees every module at once:
+
+  lockgraph    NHD21x  interprocedural lock-order inversions, blocking
+                       calls under locks, re-entrant Lock acquisition —
+                       with DOT/JSON export of the whole-program lock
+                       graph (--lock-graph-dot / --lock-graph-json)
+
 Run ``python -m nhd_tpu.analysis nhd_tpu/`` or see docs/STATIC_ANALYSIS.md
 for the rule catalogue, suppression syntax and the baseline workflow.
 """
 
 from nhd_tpu.analysis.core import (
+    ALL_PACK_NAMES,
     Finding,
+    ModuleSource,
     PACKS,
+    PROJECT_PACKS,
     RULES,
     analyze_file,
     analyze_paths,
@@ -30,8 +40,11 @@ from nhd_tpu.analysis.core import (
 )
 
 __all__ = [
+    "ALL_PACK_NAMES",
     "Finding",
+    "ModuleSource",
     "PACKS",
+    "PROJECT_PACKS",
     "RULES",
     "analyze_file",
     "analyze_paths",

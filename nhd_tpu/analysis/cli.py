@@ -15,8 +15,8 @@ from pathlib import Path
 from typing import List, Optional, Sequence
 
 from nhd_tpu.analysis.core import (
+    ALL_PACK_NAMES,
     Finding,
-    PACKS,
     RULES,
     analyze_paths,
     load_baseline,
@@ -36,9 +36,20 @@ def _parser() -> argparse.ArgumentParser:
     )
     p.add_argument("paths", nargs="*", default=["nhd_tpu"],
                    help="files or directories to analyze (default: nhd_tpu)")
-    p.add_argument("--packs", default=",".join(PACKS),
+    p.add_argument("--packs", default=",".join(ALL_PACK_NAMES),
                    help=f"comma-separated packs to run (default: all of "
-                        f"{','.join(PACKS)})")
+                        f"{','.join(ALL_PACK_NAMES)})")
+    p.add_argument("--exclude", action="append", default=[],
+                   metavar="PATTERN",
+                   help="fnmatch pattern of paths to skip (repeatable; "
+                        "matches whole paths, suffixes, or directory "
+                        "segments — e.g. tests/fixtures)")
+    p.add_argument("--lock-graph-json", metavar="FILE", default=None,
+                   help="write the interprocedural lock graph (locks, "
+                        "order edges, inversions) as JSON")
+    p.add_argument("--lock-graph-dot", metavar="FILE", default=None,
+                   help="write the lock graph as Graphviz DOT (inverted "
+                        "pairs highlighted)")
     p.add_argument("-f", "--format", dest="fmt", choices=("human", "json"),
                    default="human")
     p.add_argument("--baseline", default=None,
@@ -56,10 +67,17 @@ def _parser() -> argparse.ArgumentParser:
 
 def _resolve_packs(arg: str) -> Optional[List[str]]:
     packs = [x.strip() for x in arg.split(",") if x.strip()]
-    unknown = [x for x in packs if x not in PACKS]
+    if not packs:
+        # an empty selection (e.g. --packs "$UNSET_VAR") must not read
+        # as "clean" with zero rules run — same reasoning as the
+        # no-files-found guard below
+        print("nhdlint: --packs selected no packs "
+              f"(have: {', '.join(ALL_PACK_NAMES)})", file=sys.stderr)
+        return None
+    unknown = [x for x in packs if x not in ALL_PACK_NAMES]
     if unknown:
         print(f"nhdlint: unknown pack(s): {', '.join(unknown)} "
-              f"(have: {', '.join(PACKS)})", file=sys.stderr)
+              f"(have: {', '.join(ALL_PACK_NAMES)})", file=sys.stderr)
         return None
     return packs
 
@@ -76,13 +94,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if packs is None:
         return 2
 
-    reports = analyze_paths(args.paths, packs)
+    modules: List = []
+    reports = analyze_paths(
+        args.paths, packs, exclude=args.exclude, modules_out=modules
+    )
     if not reports:
         # a path typo must not read as "clean" — that would silently
         # disable the whole lint tier in make lint / CI
         print(f"nhdlint: no Python files found under: "
               f"{', '.join(args.paths)}", file=sys.stderr)
         return 2
+
+    if args.lock_graph_json or args.lock_graph_dot:
+        from nhd_tpu.analysis.lockgraph import build_lock_graph, lock_graph_dot
+
+        graph = build_lock_graph(modules)
+        if args.lock_graph_json:
+            Path(args.lock_graph_json).write_text(
+                json.dumps(graph, indent=2) + "\n"
+            )
+            print(f"nhdlint: lock graph -> {args.lock_graph_json}",
+                  file=sys.stderr)
+        if args.lock_graph_dot:
+            Path(args.lock_graph_dot).write_text(lock_graph_dot(graph))
+            print(f"nhdlint: lock graph DOT -> {args.lock_graph_dot}",
+                  file=sys.stderr)
+
     findings: List[Finding] = [f for r in reports for f in r.findings]
     suppressed = sum(r.suppressed for r in reports)
     unused_ignores = [
@@ -91,7 +128,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     baseline_path = Path(args.baseline or DEFAULT_BASELINE)
     if args.write_baseline:
-        if set(packs) != set(PACKS):
+        if set(packs) != set(ALL_PACK_NAMES):
             # a subset write would silently drop every other pack's
             # grandfathered entries from the file
             print("nhdlint: --write-baseline requires all packs "

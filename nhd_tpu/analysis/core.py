@@ -1,9 +1,13 @@
 """nhdlint engine: findings, suppressions, baseline, file walking.
 
-Rule packs live in sibling ``rules_*`` modules; each exposes
-``check_module(tree, src, path) -> List[Finding]``. This module owns
-everything rule-independent so a pack is just one visitor plus a rule
-table entry.
+Per-file rule packs live in sibling ``rules_*`` modules; each exposes
+``check_module(tree, src, path) -> List[Finding]``. *Project* packs
+(``PROJECT_PACKS``) see every parsed module at once and emit
+whole-program findings — the interprocedural lock-graph rules
+(``lockgraph.py``) need the cross-module call graph, which no
+one-file-at-a-time visitor can build. This module owns everything
+rule-independent so a pack is just one visitor (or project function)
+plus a rule table entry.
 """
 
 from __future__ import annotations
@@ -15,6 +19,7 @@ import json
 import re
 import tokenize
 from dataclasses import asdict, dataclass, field
+from fnmatch import fnmatch
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -59,6 +64,15 @@ class FileReport:
     unused_ignores: List[int] = field(default_factory=list)  # line numbers
 
 
+@dataclass
+class ModuleSource:
+    """One successfully parsed module, as handed to project packs."""
+
+    path: str                      # display path (posix separators)
+    src: str
+    tree: ast.Module
+
+
 # ---------------------------------------------------------------------------
 # rule registry (packs register lazily to keep import order trivial)
 # ---------------------------------------------------------------------------
@@ -89,6 +103,38 @@ PACKS: Dict[str, Callable] = {
     "excepts": _pack_excepts,
     "determinism": _pack_determinism,
 }
+
+
+def _pack_lockgraph(modules):
+    from nhd_tpu.analysis.lockgraph import check_project
+    return check_project(modules)
+
+
+# project packs: check_project(modules: Sequence[ModuleSource]) -> findings.
+# They run over the whole analyzed path set at once (analyze_file hands
+# them a one-module project, so EXPECT fixtures keep working unchanged).
+PROJECT_PACKS: Dict[str, Callable] = {
+    "lockgraph": _pack_lockgraph,
+}
+
+ALL_PACK_NAMES: Tuple[str, ...] = (*PACKS, *PROJECT_PACKS)
+
+
+def _split_packs(
+    packs: Optional[Sequence[str]],
+) -> Tuple[List[str], List[str]]:
+    """(file packs, project packs) in registry order; None = all. Unknown
+    names raise KeyError — the CLI validates first, library callers get
+    the loud failure."""
+    if packs is None:
+        return list(PACKS), list(PROJECT_PACKS)
+    unknown = [p for p in packs if p not in PACKS and p not in PROJECT_PACKS]
+    if unknown:
+        raise KeyError(f"unknown pack(s): {', '.join(unknown)}")
+    return (
+        [p for p in PACKS if p in packs],
+        [p for p in PROJECT_PACKS if p in packs],
+    )
 
 # rule id -> (pack, one-line description); the single source docs and
 # --list-rules render from
@@ -121,6 +167,16 @@ RULES: Dict[str, Tuple[str, str]] = {
     "NHD202": ("locks",
                "bare <lock>.acquire() call: an exception before release() "
                "deadlocks every other thread; use 'with <lock>:'"),
+    "NHD210": ("lockgraph",
+               "lock-order inversion: one call path acquires A then B, "
+               "another B then A — two threads interleaving them deadlock"),
+    "NHD211": ("lockgraph",
+               "blocking call (unbounded queue get/join/wait, socket "
+               "recv/accept, pjit solve entry) reached while a lock is "
+               "held — directly or through the call graph"),
+    "NHD212": ("lockgraph",
+               "re-entrant acquisition of a non-reentrant Lock through a "
+               "call path (callback invoked under the lock it takes)"),
     "NHD301": ("excepts",
                "bare 'except:' catches SystemExit/KeyboardInterrupt and "
                "hides programming errors"),
@@ -223,13 +279,12 @@ def parse_suppressions(
 # analysis driver
 # ---------------------------------------------------------------------------
 
-def analyze_file(
-    path: str | Path,
-    packs: Optional[Sequence[str]] = None,
-    *,
-    src: Optional[str] = None,
-) -> FileReport:
-    """Run the selected packs over one file, applying inline suppressions."""
+def _load_module(
+    path: str | Path, src: Optional[str] = None
+) -> Tuple[FileReport, Optional[ModuleSource], Dict[int, Optional[frozenset]]]:
+    """Read + parse one file. The report comes back terminal (NHD000 /
+    skipped) when the module is None; otherwise findings are still to be
+    collected and applied via _apply_findings."""
     p = Path(path)
     display = p.as_posix()
     report = FileReport(path=display)
@@ -240,7 +295,7 @@ def analyze_file(
             report.findings.append(Finding(
                 "NHD000", display, 1, 0, f"unreadable file: {exc}"
             ))
-            return report
+            return report, None, {}
     try:
         tree: Optional[ast.Module] = ast.parse(src, filename=display)
     except SyntaxError as exc:
@@ -251,20 +306,27 @@ def analyze_file(
     skip_file, ignores = parse_suppressions(src, tree)
     if skip_file:
         report.skipped = True
-        return report
+        return report, None, {}
     if tree is None:
         assert syntax_error is not None
         report.findings.append(Finding(
             "NHD000", display, syntax_error.lineno or 1, 0,
             f"syntax error: {syntax_error.msg}",
         ))
-        return report
+        return report, None, {}
+    return report, ModuleSource(display, src, tree), ignores
 
-    lines = src.splitlines()
-    raw: List[Finding] = []
-    for name in packs or PACKS:
-        raw.extend(PACKS[name](tree, src, display))
 
+def _apply_findings(
+    report: FileReport,
+    module: ModuleSource,
+    ignores: Dict[int, Optional[frozenset]],
+    raw: List[Finding],
+    ran: set,
+) -> None:
+    """Attach snippets, apply inline suppressions, account unused
+    directives; mutates *report* in place."""
+    lines = module.src.splitlines()
     used_ignore_lines = set()
     for f in raw:
         snippet = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
@@ -278,20 +340,61 @@ def analyze_file(
     # a directive is "unused" only when every rule it could suppress was
     # actually checked this run — a --packs subset must not tell people
     # to delete suppressions that are load-bearing for the full run
-    ran = set(packs or PACKS)
     ran_rules = {rid for rid, (pack, _) in RULES.items() if pack in ran}
     for line, rules in ignores.items():
         if line in used_ignore_lines:
             continue
-        judged = ran == set(PACKS) if rules is None else rules <= ran_rules
+        judged = (
+            ran == set(ALL_PACK_NAMES) if rules is None else rules <= ran_rules
+        )
         if judged:
             report.unused_ignores.append(line)
     report.unused_ignores.sort()
     report.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+
+
+def analyze_file(
+    path: str | Path,
+    packs: Optional[Sequence[str]] = None,
+    *,
+    src: Optional[str] = None,
+) -> FileReport:
+    """Run the selected packs over one file, applying inline suppressions.
+    Project packs see a one-module project — fixture files exercise the
+    interprocedural rules within a single module this way."""
+    file_packs, proj_packs = _split_packs(packs)
+    report, module, ignores = _load_module(path, src)
+    if module is None:
+        return report
+    raw: List[Finding] = []
+    for name in file_packs:
+        raw.extend(PACKS[name](module.tree, module.src, module.path))
+    for name in proj_packs:
+        raw.extend(PROJECT_PACKS[name]([module]))
+    _apply_findings(report, module, ignores, raw, set(file_packs + proj_packs))
     return report
 
 
-def iter_py_files(paths: Iterable[str | Path]) -> List[Path]:
+def _excluded(p: Path, patterns: Sequence[str]) -> bool:
+    """fnmatch against the posix path, anchored loosely: a pattern
+    matches the whole path, a path suffix, or any directory segment run
+    (so ``tests/fixtures`` excludes the fixture tree wherever the repo
+    root sits)."""
+    s = p.as_posix()
+    for pat in patterns:
+        if (
+            fnmatch(s, pat)
+            or fnmatch(s, f"*/{pat}")
+            or fnmatch(s, f"{pat}/*")
+            or fnmatch(s, f"*/{pat}/*")
+        ):
+            return True
+    return False
+
+
+def iter_py_files(
+    paths: Iterable[str | Path], *, exclude: Sequence[str] = ()
+) -> List[Path]:
     """Expand files/directories into a sorted, de-duplicated .py list."""
     out = []
     for p in paths:
@@ -302,7 +405,7 @@ def iter_py_files(paths: Iterable[str | Path]) -> List[Path]:
             out.append(p)
     seen, uniq = set(), []
     for p in out:
-        if p not in seen:
+        if p not in seen and not _excluded(p, exclude):
             seen.add(p)
             uniq.append(p)
     return uniq
@@ -311,8 +414,43 @@ def iter_py_files(paths: Iterable[str | Path]) -> List[Path]:
 def analyze_paths(
     paths: Iterable[str | Path],
     packs: Optional[Sequence[str]] = None,
+    *,
+    exclude: Sequence[str] = (),
+    modules_out: Optional[List[ModuleSource]] = None,
 ) -> List[FileReport]:
-    return [analyze_file(p, packs) for p in iter_py_files(paths)]
+    """Run the selected packs over a path set. Per-file packs run file by
+    file; project packs run once over every successfully parsed module,
+    their findings distributed back to the owning file's report (so
+    inline suppressions and the baseline apply uniformly). Pass a list as
+    ``modules_out`` to receive the parsed ModuleSource set — the CLI's
+    lock-graph export reuses it instead of re-parsing every file."""
+    file_packs, proj_packs = _split_packs(packs)
+    ran = set(file_packs + proj_packs)
+    loaded = [
+        _load_module(p) for p in iter_py_files(paths, exclude=exclude)
+    ]
+    raw_by_path: Dict[str, List[Finding]] = {}
+    modules = [m for _, m, _ in loaded if m is not None]
+    if modules_out is not None:
+        modules_out.extend(modules)
+    for module in modules:
+        raw = raw_by_path.setdefault(module.path, [])
+        for name in file_packs:
+            raw.extend(PACKS[name](module.tree, module.src, module.path))
+    for name in proj_packs:
+        for f in PROJECT_PACKS[name](modules):
+            # a project finding always lands in an analyzed module; guard
+            # anyway so a pack bug can't KeyError the whole run
+            if f.path in raw_by_path:
+                raw_by_path[f.path].append(f)
+    reports: List[FileReport] = []
+    for report, module, ignores in loaded:
+        if module is not None:
+            _apply_findings(
+                report, module, ignores, raw_by_path[module.path], ran
+            )
+        reports.append(report)
+    return reports
 
 
 # ---------------------------------------------------------------------------

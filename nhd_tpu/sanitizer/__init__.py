@@ -1,0 +1,37 @@
+"""nhdsan — runtime deadlock sanitizer (see runtime.py for the design).
+
+Quick use::
+
+    from nhd_tpu.sanitizer import Sanitizer, DeadlockError
+
+    san = Sanitizer()          # private instance: no global patching
+    a, b = san.Lock(), san.Lock()
+    # threads interleaving a->b and b->a now raise DeadlockError with a
+    # wait-for-graph witness instead of hanging forever
+
+or process-wide (the tests/conftest.py NHD_SAN=1 path)::
+
+    from nhd_tpu.sanitizer import install, uninstall
+    san = install()            # patches threading.Lock/RLock/Condition
+    ...                        # + queue.get / Thread.join / Event.wait
+    san.report()               # cycles, hold-while-blocking, lock stats
+    uninstall()
+"""
+
+from nhd_tpu.sanitizer.runtime import (
+    DeadlockError,
+    SanLock,
+    Sanitizer,
+    get_sanitizer,
+    install,
+    uninstall,
+)
+
+__all__ = [
+    "DeadlockError",
+    "SanLock",
+    "Sanitizer",
+    "get_sanitizer",
+    "install",
+    "uninstall",
+]

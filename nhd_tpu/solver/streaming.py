@@ -44,6 +44,7 @@ oversized-first exception.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -63,6 +64,17 @@ from nhd_tpu.solver.batch import (
 from nhd_tpu.solver.encode import cluster_dims
 from nhd_tpu.solver.kernel import bucket_tractable
 from nhd_tpu.utils import get_logger
+
+# Serializes tile-worker mesh solves when the mesh is CPU-backed: on the
+# host backend all "devices" are one process's threads, and two
+# concurrent pjit SPMD solves interleave their per-device host
+# collectives — on a low-core box neither solve's participants all get
+# scheduled, so both rendezvous barriers wait forever (the tier-1
+# streaming-mesh deadlock, ROADMAP open item; the cycle shape is kept as
+# an nhdsan regression in tests/test_sanitizer.py). One solve in flight
+# at a time always completes; real accelerator backends rendezvous in
+# hardware and skip this lock entirely.
+_CPU_MESH_SOLVE_LOCK = threading.Lock()
 
 
 class StreamingScheduler:
@@ -293,6 +305,27 @@ class StreamingScheduler:
                 )
             return got
 
+        # CPU-backed mesh: one per-tile schedule() sub-call in flight at
+        # a time (module docstring + _CPU_MESH_SOLVE_LOCK). The gate is
+        # deliberately coarse — it wraps the whole sub-call, host-side
+        # select/assign included, because only the batch internals know
+        # where the collective-bearing solves sit; chunk encode, the
+        # group-overlap offer filter and spill forwarding still overlap
+        # across tiles. Real accelerators skip the gate entirely.
+        serialize_mesh = False
+        try:
+            mesh = self.batch._resolve_mesh()
+            serialize_mesh = mesh is not None and all(
+                getattr(d, "platform", None) == "cpu"
+                for d in mesh.devices.flat
+            )
+        except Exception:
+            serialize_mesh = False
+        solve_gate = (
+            _CPU_MESH_SOLVE_LOCK if serialize_mesh
+            else contextlib.nullcontext()
+        )
+
         contexts: List[Optional[ScheduleContext]] = [None] * len(tiles)
         # per-tile saturation certificates: a request type that came back
         # unschedulable from a tile stays unschedulable there for the rest
@@ -333,9 +366,10 @@ class StreamingScheduler:
             if not offer:
                 return pending
             if contexts[ti] is None:
-                contexts[ti] = self.batch.make_context(
-                    tiles[ti], now=now, interner=interner
-                )
+                with solve_gate:
+                    contexts[ti] = self.batch.make_context(
+                        tiles[ti], now=now, interner=interner
+                    )
             t_sub = time.perf_counter()
             if share_enc:
                 sub_items, encoded, local_of = chunk_encoded(
@@ -345,23 +379,25 @@ class StreamingScheduler:
                 # (local_of maps the same global_ids in order) — skip the
                 # two 100k-element remap comprehensions for it
                 identity = len(offer) == len(sub_items)
-                sub_results, sub_stats = self.batch.schedule(
-                    tiles[ti], sub_items, now=now, context=contexts[ti],
-                    encoded=encoded,
-                    offer=(
-                        None if identity
-                        else [local_of[i] for i in offer]
-                    ),
-                )
+                with solve_gate:
+                    sub_results, sub_stats = self.batch.schedule(
+                        tiles[ti], sub_items, now=now, context=contexts[ti],
+                        encoded=encoded,
+                        offer=(
+                            None if identity
+                            else [local_of[i] for i in offer]
+                        ),
+                    )
                 if not identity:
                     sub_results = [sub_results[local_of[i]] for i in offer]
             else:
                 # >48 distinct groups: per-tile interners, per-offer
                 # encode (the pre-sharing behavior)
                 sub_items = [items[i] for i in offer]
-                sub_results, sub_stats = self.batch.schedule(
-                    tiles[ti], sub_items, now=now, context=contexts[ti]
-                )
+                with solve_gate:
+                    sub_results, sub_stats = self.batch.schedule(
+                        tiles[ti], sub_items, now=now, context=contexts[ti]
+                    )
             # merge: remap round numbers into the streaming timeline
             with lock:
                 offset = len(stats.round_end_seconds)
@@ -433,6 +469,7 @@ class StreamingScheduler:
                         tile_busy[ti] = False
                         done.notify_all()
                     return
+                submit_next = False
                 with lock:
                     outstanding -= 1
                     # spill forwarding: first-fit stops at the last tile;
@@ -445,8 +482,14 @@ class StreamingScheduler:
                         outstanding += 1
                         tile_q[nxt].append((chunk_id, leftover, hops + 1))
                         if not tile_busy[nxt]:
+                            # reserve the wake-up under the lock, submit
+                            # outside it: Executor.submit can block in
+                            # Thread.start() while spinning up a worker,
+                            # and holding the pipeline lock across that
+                            # wait stalls every other stage (nhdsan
+                            # hold-while-blocking witness)
                             tile_busy[nxt] = True
-                            pool.submit(run_tile, nxt)
+                            submit_next = True
                     elif leftover:
                         self.logger.info(
                             f"streaming: {len(leftover)} pods of chunk "
@@ -455,6 +498,8 @@ class StreamingScheduler:
                         )
                     if outstanding == 0:
                         done.notify_all()
+                if submit_next:
+                    pool.submit(run_tile, nxt)
 
         # default 4 workers regardless of core count: tile stages spend
         # much of their wall blocked on accelerator relay flushes and XLA
@@ -514,6 +559,7 @@ class StreamingScheduler:
         with ThreadPoolExecutor(
             max_workers=n_workers, thread_name_prefix="nhd-stream"
         ) as pool:
+            to_start: List[int] = []
             with lock:
                 cid = 0
                 for ti, block in start_blocks:
@@ -525,7 +571,13 @@ class StreamingScheduler:
                         cid += 1
                     if tile_q[ti] and not tile_busy[ti]:
                         tile_busy[ti] = True
-                        pool.submit(run_tile, ti)
+                        to_start.append(ti)
+            # submit outside the lock (same reasoning as run_tile's spill
+            # forwarding): tile_busy reserved the wake-ups, so no other
+            # thread can double-submit these tiles
+            for ti in to_start:
+                pool.submit(run_tile, ti)
+            with lock:
                 while outstanding > 0 and not errors:
                     done.wait()
         if errors:

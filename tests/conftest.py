@@ -45,3 +45,57 @@ def subprocess_env(**extra):
     )
     env.update(extra)
     return env
+
+
+# ---------------------------------------------------------------------------
+# nhdsan: NHD_SAN=1 runs the whole session under the runtime deadlock
+# sanitizer (docs/OBSERVABILITY.md). Installed at conftest IMPORT time —
+# before pytest collection imports any test module — so module-level
+# locks in nhd_tpu (created while tests import, e.g. solver/streaming's
+# _CPU_MESH_SOLVE_LOCK) are instrumented too. Only jax internals and the
+# stdlib machinery imported above stay raw, by design.
+# ---------------------------------------------------------------------------
+
+import json  # noqa: E402
+
+import pytest  # noqa: E402
+
+if os.environ.get("NHD_SAN") == "1":
+    from nhd_tpu.sanitizer import install as _nhd_san_install
+
+    _nhd_san_install()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def nhd_san_session():
+    """When NHD_SAN=1 the sanitizer was installed at conftest import
+    (above); this fixture owns the teardown: dump the witness report
+    (NHD_SAN_REPORT, default /tmp/nhd_san_report.json) and fail the
+    session if any wait-for-graph cycle was observed — a deadlock the
+    per-test layer converted into a DeadlockError, or one recorded by a
+    thread whose test had already moved on."""
+    if os.environ.get("NHD_SAN") != "1":
+        yield
+        return
+    from nhd_tpu.sanitizer import get_sanitizer, uninstall
+
+    san = get_sanitizer()
+    assert san is not None, "NHD_SAN=1 but install did not run at import"
+    try:
+        yield
+    finally:
+        uninstall()
+        report = san.report()
+        out = os.environ.get("NHD_SAN_REPORT", "/tmp/nhd_san_report.json")
+        try:
+            with open(out, "w") as fh:
+                json.dump(
+                    {"report": report, "trace": san.chrome_trace()},
+                    fh, indent=2,
+                )
+        except OSError:
+            pass
+    assert not report["cycles"], (
+        f"nhdsan observed {len(report['cycles'])} wait-for-graph "
+        f"cycle(s); full witnesses in {out}"
+    )

@@ -1,0 +1,88 @@
+"""Patterns the lockgraph pack must NOT flag.
+
+Consistent lock order, bounded waits, condition-wait on the lock it
+releases, RLock re-entrancy, and blocking calls with no lock held.
+"""
+
+import queue
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+_Q = queue.Queue()
+_COND = threading.Condition(_B)
+
+
+def module_condition_wait():
+    with _B:
+        _COND.wait()  # releases _B (module-level Condition aliases it)
+
+
+def nested_consistent_one():
+    with _A:
+        with _B:  # same order everywhere: no inversion
+            pass
+
+
+def nested_consistent_two():
+    with _A:
+        with _B:
+            pass
+
+
+def bounded_wait_under_lock():
+    with _A:
+        return _Q.get(timeout=1.0)  # bounded: not a deadlock
+
+
+def nonblocking_get_under_lock():
+    with _A:
+        return _Q.get(block=False)
+
+
+def blocking_without_lock():
+    return _Q.get()  # blocking, but nothing held
+
+
+def dict_get_under_lock(d):
+    with _A:
+        return d.get("key")  # has a positional arg: dict.get, not a wait
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._jobs = []
+
+    def wait_for_job(self):
+        with self._cv:
+            while not self._jobs:
+                self._cv.wait()  # releases its own lock: canonical
+            return self._jobs.pop()
+
+    def reenter(self):
+        with self._lock:
+            self._helper()  # RLock: re-entry is legal
+
+    def _helper(self):
+        with self._lock:
+            return list(self._jobs)
+
+
+def make_callback():
+    with _A:
+        # DEFINING a closure under the lock is not calling it: the
+        # blocking body runs later, lock-free
+        def cb():
+            return _Q.get()
+
+    return cb
+
+
+def local_lock_worker():
+    import threading as _t
+
+    lock = _t.Lock()    # function-local: no cross-call identity, out of
+    with lock:          # scope for the static layer (nhdsan covers it)
+        return _Q.get(timeout=1.0)

@@ -1,0 +1,64 @@
+"""Deliberate lockgraph violations; every flagged line carries EXPECT.
+
+Two-function lock-order inversion (NHD210), a blocking queue get under a
+lock reached through a call (NHD211, direct and interprocedural), and a
+non-reentrant Lock re-acquired through a callback path (NHD212).
+"""
+
+import queue
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+_Q = queue.Queue()
+
+
+def forward():
+    with _A:
+        with _B:  # EXPECT[NHD210]
+            pass
+
+
+def backward():
+    with _B:
+        with _A:  # EXPECT[NHD210]
+            pass
+
+
+def drain():
+    # no lock held here: the violation belongs to the caller
+    return _Q.get()
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def flush(self):
+        with self._lock:
+            self._items.clear()
+            _Q.get()  # EXPECT[NHD211]
+
+    def flush_indirect(self):
+        with self._lock:
+            drain()  # EXPECT[NHD211]
+
+    def _on_change(self):
+        with self._lock:
+            return len(self._items)
+
+    def mutate(self):
+        with self._lock:
+            self._items["k"] = 1
+            self._on_change()  # EXPECT[NHD212]
+
+
+def spawn_worker():
+    # closures get their own summaries: the blocking call lives in the
+    # nested def, the violation at the call-under-lock site
+    def worker():
+        return _Q.get()
+
+    with _A:
+        return worker()  # EXPECT[NHD211]

@@ -21,6 +21,7 @@ This is the closest a single host gets to the reference's multi-node
 story (SURVEY §5.8) without a cluster.
 """
 
+import functools
 import os
 import subprocess
 import sys
@@ -28,6 +29,92 @@ import textwrap
 from typing import Optional
 
 import pytest
+
+# Minimal cross-process SPMD capability probe: 2 processes rendezvous and
+# run ONE jitted reduction over a globally sharded array. Some jaxlib
+# builds reject this outright ("Multiprocess computations aren't
+# implemented on the CPU backend") — an environmental limitation, not a
+# regression, so the SPMD tests skip with that reason instead of failing.
+_PROBE = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = ""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    rank = int(sys.argv[1]); port = sys.argv[2]
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=2, process_id=rank)
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(jax.devices(), ("d",))
+    arr = jax.make_array_from_single_device_arrays(
+        (2,), NamedSharding(mesh, P("d")),
+        [jax.device_put(jnp.ones((1,)), jax.local_devices()[0])],
+    )
+    out = jax.jit(jnp.sum)(arr)
+    assert float(out) == 2.0, out
+    print("SPMD_OK", flush=True)
+""")
+
+
+@functools.lru_cache(maxsize=1)
+def _spmd_unsupported_reason() -> Optional[str]:
+    """None when this host can run cross-process SPMD collectives on the
+    CPU backend; otherwise the reason to skip. Probed once per session;
+    infra-flavored probe failures retry on a fresh port before being
+    believed."""
+    from tests.conftest import subprocess_env
+
+    last = "probe never ran"
+    for _ in range(3):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _PROBE, str(rank), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=subprocess_env(),
+            )
+            for rank in (0, 1)
+        ]
+        outs = []
+        timed_out = False
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                timed_out = True
+                out = ""
+            outs.append(out)
+        if timed_out:
+            last = "capability probe timed out (coordinator rendezvous)"
+            continue
+        if all(p.returncode == 0 and "SPMD_OK" in o
+               for p, o in zip(procs, outs)):
+            return None
+        tail = ""
+        for o in outs:
+            for line in o.splitlines():
+                if "Error" in line or "implemented" in line:
+                    tail = line.strip()[-200:]
+        last = tail or (
+            f"capability probe failed "
+            f"(rc={[p.returncode for p in procs]})"
+        )
+        if "implemented" in last:    # deterministic: no point retrying
+            return last
+    return last
+
+
+def _require_spmd() -> None:
+    reason = _spmd_unsupported_reason()
+    if reason is not None:
+        pytest.skip(
+            f"cross-process SPMD unavailable on this host: {reason}"
+        )
 
 _WORKER = textwrap.dedent("""
     import os, sys
@@ -220,6 +307,7 @@ def test_multi_process_region_scheduling(nproc):
 
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_multi_process_global_spmd_solve(nproc):
+    _require_spmd()
     _run_procs("spmd", nproc)
 
 
@@ -229,6 +317,7 @@ def test_multi_process_multi_device_spmd_solve():
     mesh whose shards live in two OS processes, cross-process collectives
     included, bit-identical to the local single-device solve (VERDICT r4
     item 6: no multi-device-per-process leg existed)."""
+    _require_spmd()
     _run_procs("spmd", 2, dev_per_proc=4)
 
 

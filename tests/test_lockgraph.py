@@ -1,0 +1,270 @@
+"""Interprocedural lock-graph analysis (nhdlint pack 'lockgraph').
+
+Single-file behavior is pinned by the EXPECT fixtures (wired into
+test_static_analysis.py's fixture matrix); here: cross-module edges, the
+graph export formats, and the baseline fingerprint-rotation guarantees
+the grandfather workflow depends on.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from nhd_tpu.analysis import (
+    analyze_file,
+    analyze_paths,
+    load_baseline,
+    subtract_baseline,
+    write_baseline,
+)
+from nhd_tpu.analysis.cli import main as cli_main
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
+
+_MOD_A = '''\
+import threading
+
+from pkg.b import grab_b
+
+_A = threading.Lock()
+
+
+def hold_a_then_b():
+    with _A:
+        grab_b()
+
+
+def grab_a():
+    with _A:
+        pass
+'''
+
+_MOD_B = '''\
+import threading
+
+from pkg.a import grab_a
+
+_B = threading.Lock()
+
+
+def grab_b():
+    with _B:
+        pass
+
+
+def hold_b_then_a():
+    with _B:
+        grab_a()
+'''
+
+
+@pytest.fixture
+def cross_module_pkg(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text(_MOD_A)
+    (pkg / "b.py").write_text(_MOD_B)
+    return pkg
+
+
+def test_cross_module_inversion_detected(cross_module_pkg):
+    """The tentpole case: A→B lives in one module, B→A in another; only
+    the whole-project call graph can see the cycle."""
+    reports = analyze_paths([cross_module_pkg], ["lockgraph"])
+    found = {
+        (Path(f.path).name, f.rule, f.line)
+        for r in reports for f in r.findings
+    }
+    # the witnesses sit at the call-under-lock lines in each module
+    assert ("a.py", "NHD210", 10) in found, found
+    assert ("b.py", "NHD210", 15) in found, found
+    # and each module alone has no inversion to see
+    for name in ("a.py", "b.py"):
+        solo = analyze_file(cross_module_pkg / name, ["lockgraph"])
+        assert solo.findings == [], solo.findings
+
+
+def test_cross_module_inversion_suppressible_inline(cross_module_pkg):
+    src = (cross_module_pkg / "a.py").read_text()
+    src = src.replace(
+        "        grab_b()",
+        "        grab_b()  # nhdlint: ignore[NHD210]",
+    )
+    (cross_module_pkg / "a.py").write_text(src)
+    reports = analyze_paths([cross_module_pkg], ["lockgraph"])
+    by_name = {Path(r.path).name: r for r in reports}
+    assert by_name["a.py"].findings == []
+    assert by_name["a.py"].suppressed == 1
+    # the b.py direction still reports
+    assert [f.rule for f in by_name["b.py"].findings] == ["NHD210"]
+
+
+def test_transitive_blocking_through_modules(tmp_path):
+    """NHD211 follows the call graph across modules: the lock holder is
+    two modules away from the queue.get."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "sink.py").write_text(
+        "import queue\n"
+        "_Q = queue.Queue()\n"
+        "def drain():\n"
+        "    return _Q.get()\n"
+    )
+    (pkg / "mid.py").write_text(
+        "from pkg.sink import drain\n"
+        "def relay():\n"
+        "    return drain()\n"
+    )
+    (pkg / "top.py").write_text(
+        "import threading\n"
+        "from pkg.mid import relay\n"
+        "_L = threading.Lock()\n"
+        "def pump():\n"
+        "    with _L:\n"
+        "        return relay()\n"
+    )
+    reports = analyze_paths([pkg], ["lockgraph"])
+    findings = [f for r in reports for f in r.findings]
+    assert [f.rule for f in findings] == ["NHD211"]
+    f = findings[0]
+    assert Path(f.path).name == "top.py" and f.line == 6
+    assert "drain" in f.message and "sink.py:4" in f.message
+
+
+def test_exclude_patterns_skip_paths(tmp_path):
+    (tmp_path / "keep.py").write_text("x = 1\n")
+    sub = tmp_path / "generated"
+    sub.mkdir()
+    (sub / "junk.py").write_text("def f(:\n")     # would be NHD000
+    reports = analyze_paths([tmp_path], exclude=["generated"])
+    assert [Path(r.path).name for r in reports] == ["keep.py"]
+
+
+# ---------------------------------------------------------------------------
+# lock graph export
+# ---------------------------------------------------------------------------
+
+def test_lock_graph_json_and_dot_export(cross_module_pkg, tmp_path, capsys):
+    out_json = tmp_path / "graph.json"
+    out_dot = tmp_path / "graph.dot"
+    rc = cli_main([
+        str(cross_module_pkg), "--packs", "lockgraph", "--no-baseline",
+        "--lock-graph-json", str(out_json),
+        "--lock-graph-dot", str(out_dot),
+    ])
+    assert rc == 1          # the seeded inversion reports
+    graph = json.loads(out_json.read_text())
+    assert graph["version"] == 1
+    keys = {l["key"] for l in graph["locks"]}
+    assert any(k.endswith(":_A") for k in keys)
+    assert any(k.endswith(":_B") for k in keys)
+    for lock in graph["locks"]:
+        assert set(lock) == {"key", "name", "kind", "site"}
+        path, _, line = lock["site"].rpartition(":")
+        assert path.endswith(".py") and line.isdigit()
+    # both directions present as edges, and the pair is flagged inverted
+    edges = {(e["from"].rsplit(":", 1)[1], e["to"].rsplit(":", 1)[1])
+             for e in graph["edges"]}
+    assert {("_A", "_B"), ("_B", "_A")} <= edges
+    assert len(graph["inversions"]) == 1
+    dot = out_dot.read_text()
+    assert dot.startswith("digraph nhd_lock_order")
+    assert "color=red" in dot   # the inverted pair is highlighted
+
+
+def test_lock_graph_export_on_clean_tree(tmp_path, capsys):
+    (tmp_path / "clean.py").write_text(
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "def f():\n"
+        "    with _L:\n"
+        "        pass\n"
+    )
+    out = tmp_path / "g.json"
+    rc = cli_main([str(tmp_path), "--no-baseline",
+                   "--lock-graph-json", str(out)])
+    assert rc == 0
+    graph = json.loads(out.read_text())
+    assert len(graph["locks"]) == 1
+    assert graph["edges"] == [] and graph["inversions"] == []
+
+
+# ---------------------------------------------------------------------------
+# baseline fingerprint rotation (satellite): renames and line shifts must
+# not resurrect grandfathered findings
+# ---------------------------------------------------------------------------
+
+def _baseline_of(path: Path, tmp_path: Path) -> Path:
+    findings = analyze_file(path, ["lockgraph"]).findings
+    assert findings, "fixture must produce findings to grandfather"
+    bl = tmp_path / "bl.json"
+    write_baseline(findings, bl)
+    return bl
+
+
+def test_baseline_survives_line_shift_for_lockgraph(tmp_path):
+    src = (FIXTURES / "lockgraph_pos.py").read_text()
+    p = tmp_path / "shifted.py"
+    p.write_text(src)
+    bl = _baseline_of(p, tmp_path)
+    p.write_text("# pad\n# pad\n# pad\n" + src)
+    shifted = analyze_file(p, ["lockgraph"]).findings
+    new, baselined = subtract_baseline(shifted, load_baseline(bl))
+    assert new == [] and baselined == len(shifted) > 0
+
+
+def test_baseline_survives_unrelated_function_rename(tmp_path):
+    """Renaming a function that is not on any offending line must not
+    resurrect baselined findings (fingerprints key on the offending
+    line's text, not on function or line identity)."""
+    src = (FIXTURES / "lockgraph_pos.py").read_text()
+    p = tmp_path / "renamed.py"
+    p.write_text(src)
+    bl = _baseline_of(p, tmp_path)
+    # 'backward' owns the B->A direction; its def line is not a finding
+    # line (the finding sits on the inner 'with _A:')
+    assert "def backward" in src
+    p.write_text(src.replace("def backward", "def reversed_order"))
+    renamed = analyze_file(p, ["lockgraph"]).findings
+    new, baselined = subtract_baseline(renamed, load_baseline(bl))
+    assert new == [] and baselined == len(renamed) > 0
+
+
+def test_baseline_rotation_detects_edited_offending_line(tmp_path):
+    """Editing the offending line itself IS a fresh finding — rotation
+    must not over-forgive."""
+    p = tmp_path / "edited.py"
+    src = (
+        "import queue\n"
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "_Q = queue.Queue()\n"
+        "def f():\n"
+        "    with _L:\n"
+        "        _Q.get()\n"
+    )
+    p.write_text(src)
+    bl = _baseline_of(p, tmp_path)
+    p.write_text(src.replace("_Q.get()", "_Q.get()  # changed"))
+    edited = analyze_file(p, ["lockgraph"]).findings
+    new, baselined = subtract_baseline(edited, load_baseline(bl))
+    assert baselined == 0 and len(new) == 1
+
+
+def test_baseline_rename_of_offending_callee_is_fresh(tmp_path):
+    """Renaming the function *called on* the offending line changes the
+    line's text — by design a fresh finding, the same contract the
+    PR 1 baseline documents for edited lines."""
+    src = (FIXTURES / "lockgraph_pos.py").read_text()
+    p = tmp_path / "callee_renamed.py"
+    p.write_text(src)
+    bl = _baseline_of(p, tmp_path)
+    p.write_text(src.replace("_on_change", "_fire_callbacks"))
+    renamed = analyze_file(p, ["lockgraph"]).findings
+    new, _ = subtract_baseline(renamed, load_baseline(bl))
+    assert any(f.rule == "NHD212" for f in new)

@@ -22,7 +22,7 @@ from pathlib import Path
 import pytest
 
 from nhd_tpu.analysis import (
-    PACKS,
+    ALL_PACK_NAMES,
     RULES,
     analyze_file,
     analyze_paths,
@@ -67,26 +67,31 @@ def found_of(path: Path, packs=None) -> set:
     ("solver/det_pos.py", ["determinism"]),
     ("solver/det_neg.py", ["determinism"]),
     ("det_out_of_scope.py", ["determinism"]),
+    ("lockgraph_pos.py", ["lockgraph"]),
+    ("lockgraph_neg.py", ["lockgraph"]),
 ])
 def test_fixture_exact_findings(name, packs):
     path = FIXTURES / name
     assert found_of(path, packs) == expected_of(path)
 
 
+_POS_FIXTURES = ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
+                 "solver/det_pos.py", "lockgraph_pos.py")
+
+
 def test_fixtures_have_positive_coverage_for_every_pack():
-    """Every rule pack has at least one deliberately injected violation
-    that its fixture catches (the acceptance-criteria clause)."""
+    """Every rule pack — per-file and project — has at least one
+    deliberately injected violation that its fixture catches (the
+    acceptance-criteria clause)."""
     seen_packs = set()
-    for name in ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
-                 "solver/det_pos.py"):
+    for name in _POS_FIXTURES:
         for rule, _ in expected_of(FIXTURES / name):
             seen_packs.add(RULES[rule][0])
-    assert seen_packs == set(PACKS)
+    assert seen_packs == set(ALL_PACK_NAMES)
 
 
 def test_all_rule_ids_in_fixtures_are_registered():
-    for name in ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
-                 "solver/det_pos.py"):
+    for name in _POS_FIXTURES:
         for rule, _ in expected_of(FIXTURES / name):
             assert rule in RULES
 
@@ -365,6 +370,14 @@ def test_cli_unknown_pack_is_usage_error(capsys):
     assert cli_main(["--packs", "nope"]) == 2
 
 
+def test_cli_empty_packs_is_usage_error(capsys):
+    """--packs "" (e.g. an unset CI variable) must not read as 'clean'
+    with zero rules run."""
+    assert cli_main([str(FIXTURES / "lockgraph_pos.py"),
+                     "--packs", "", "--no-baseline"]) == 2
+    assert "selected no packs" in capsys.readouterr().err
+
+
 def test_cli_no_matching_files_is_usage_error(tmp_path, capsys):
     """A path typo must not read as 'clean' — that would silently turn
     the lint tier off in make lint / CI."""
@@ -409,14 +422,34 @@ def test_module_entrypoint_runs_without_jax():
 # ---------------------------------------------------------------------------
 
 def test_gate_nhd_tpu_is_clean():
-    """All four packs over the whole package: any new unsuppressed,
-    unbaselined finding fails tier-1. To grandfather an existing finding
-    deliberately, run:  python -m nhd_tpu.analysis nhd_tpu --write-baseline
+    """Every pack (incl. the interprocedural lockgraph) over the whole
+    package: any new unsuppressed, unbaselined finding fails tier-1. To
+    grandfather an existing finding deliberately, run:
+    python -m nhd_tpu.analysis nhd_tpu --write-baseline
     (see docs/STATIC_ANALYSIS.md for when that is acceptable)."""
     reports = analyze_paths([REPO / "nhd_tpu"])
     # a refactor that points the gate at an empty/renamed dir must not
     # pass vacuously
     assert len(reports) > 40
+    findings = [f for r in reports for f in r.findings]
+    baseline = load_baseline(REPO / ".nhdlint-baseline.json")
+    new, _ = subtract_baseline(findings, baseline)
+    assert not new, (
+        "nhdlint found new unsuppressed issues:\n" + "\n".join(
+            f"  {f.path}:{f.line}: {f.rule} {f.message}" for f in new
+        )
+    )
+
+
+def test_gate_tools_and_tests_are_clean():
+    """make lint covers tools/ and tests/ too (deliberate-violation
+    fixture files excluded) — this gate keeps that surface clean in
+    tier-1, same contract as the package gate above."""
+    reports = analyze_paths(
+        [REPO / "tools", REPO / "tests"], exclude=["tests/fixtures"]
+    )
+    assert len(reports) > 30
+    assert not any("fixtures" in r.path for r in reports)
     findings = [f for r in reports for f in r.findings]
     baseline = load_baseline(REPO / ".nhdlint-baseline.json")
     new, _ = subtract_baseline(findings, baseline)
