@@ -8,8 +8,10 @@ SOAK_SEEDS ?= 100
 SOAK_STEPS ?= 120
 CHAOS_SEEDS ?= 6
 CHAOS_STEPS ?= 60
+HA_SEEDS ?= 6
+HA_STEPS ?= 50
 
-.PHONY: test lint sanitize proto bench wheel clean native soak chaos trace-demo docker docker-smoke release
+.PHONY: test lint sanitize proto bench wheel clean native soak chaos ha-chaos trace-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -43,7 +45,7 @@ lint:
 # (docs/OBSERVABILITY.md; NHD_SAN_REPORT holds the dump path)
 sanitize:
 	NHD_SAN=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
-		tests/test_streaming.py tests/test_faults.py -q
+		tests/test_streaming.py tests/test_faults.py tests/test_ha.py -q
 
 # full release gate: lint + suite + benchmark smoke on the CPU backend
 check: lint test
@@ -71,6 +73,15 @@ soak:
 # profiles (docs/RESILIENCE.md; CI runs the fast cell in tests/test_faults.py)
 chaos:
 	python tools/chaos_storm.py --seeds $(CHAOS_SEEDS) --steps $(CHAOS_STEPS)
+
+# split-brain matrix: TWO scheduler replicas under leader election share
+# each cell's cluster, lease-renewal faults force leadership churn; zero
+# double-epoch binds, bounded leadership gaps, converged end state
+# (docs/RESILIENCE.md "HA & fencing"; CI runs the 3-seed subset in
+# tests/test_ha.py)
+ha-chaos:
+	python tools/chaos_storm.py --ha --profiles ha-light,ha-storm \
+		--seeds $(HA_SEEDS) --steps $(HA_STEPS)
 
 # flight-recorder demo: run the sim with tracing on, dump the Chrome
 # trace, validate its schema + per-pod span pipeline (docs/OBSERVABILITY.md)

@@ -97,11 +97,17 @@ def _pack_determinism(tree, src, path):
     return check_module(tree, src, path)
 
 
+def _pack_fencing(tree, src, path):
+    from nhd_tpu.analysis.rules_fencing import check_module
+    return check_module(tree, src, path)
+
+
 PACKS: Dict[str, Callable] = {
     "tracing": _pack_tracing,
     "locks": _pack_locks,
     "excepts": _pack_excepts,
     "determinism": _pack_determinism,
+    "fencing": _pack_fencing,
 }
 
 
@@ -189,6 +195,12 @@ RULES: Dict[str, Tuple[str, str]] = {
     "NHD402": ("determinism",
                "wall-clock read (time.time/datetime.now) in a solver/encode "
                "path: use the caller-passed 'now' or time.monotonic"),
+    "NHD501": ("fencing",
+               "mutating ClusterBackend call (bind/annotate/NAD) in "
+               "nhd_tpu/scheduler/ outside the fenced-commit helper "
+               "Scheduler._commit_write: the write would not carry the "
+               "fencing epoch, so a deposed leader's in-flight commit "
+               "could land after a standby's promotion"),
 }
 
 

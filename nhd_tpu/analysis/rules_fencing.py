@@ -1,0 +1,96 @@
+"""NHD5xx — fenced-commit discipline in the scheduling control plane.
+
+HA mode (k8s/lease.py) is only sound if EVERY mutating backend call on
+the commit path carries the current fencing epoch — one raw call is a
+hole a deposed leader's in-flight batch can land through. The repo's
+contract: inside ``nhd_tpu/scheduler/``, the four commit-path mutators
+(``bind_pod_to_node``, ``annotate_pod_config``, ``annotate_pod_gpu_map``,
+``add_nad_to_pod``) are invoked ONLY through the fenced-commit helper
+``Scheduler._commit_write`` (scheduler/core.py), which stamps the epoch.
+
+* NHD501 — a ``*.backend.<mutator>(...)`` call in scheduler code outside
+  the helper. Passing the bound method TO the helper
+  (``self._commit_write(self.backend.bind_pod_to_node, ...)``) is the
+  sanctioned form and is not a call expression, so it never flags.
+
+Reads, ``generate_pod_event`` (idempotent audit trail), and the
+controller's TriadSet reconciliation (gated on leadership at the loop
+level, and create-idempotent: a double-create answers 409) are out of
+scope — the rule guards exactly the writes whose double application
+corrupts cluster state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from nhd_tpu.analysis.core import Finding, _dotted
+
+# module-path gate: the pack judges only scheduler control-plane code
+_SCOPE_PARTS = ("scheduler",)
+
+#: the commit-path mutators that MUST carry a fencing epoch
+FENCED_MUTATORS = frozenset({
+    "bind_pod_to_node",
+    "annotate_pod_config",
+    "annotate_pod_gpu_map",
+    "add_nad_to_pod",
+})
+
+#: the one function allowed to issue them
+FENCE_HELPER = "_commit_write"
+
+
+def _in_scope(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(p in parts for p in _SCOPE_PARTS)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self._func_stack: List[str] = []
+
+    def _visit_func(self, node) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _enclosing(self) -> Optional[str]:
+        return self._func_stack[-1] if self._func_stack else None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        if d is not None:
+            parts = d.split(".")
+            # any receiver whose terminal name is 'backend': self.backend,
+            # sched.backend, AND a bare `backend` parameter — a helper
+            # taking the backend directly must not evade the rule
+            if (
+                len(parts) >= 2
+                and parts[-1] in FENCED_MUTATORS
+                and parts[-2] == "backend"
+                and self._enclosing() != FENCE_HELPER
+            ):
+                self.findings.append(Finding(
+                    "NHD501", self.path, node.lineno, node.col_offset,
+                    f"{d}() mutates cluster state outside the fenced-commit "
+                    f"helper: without the fencing epoch a deposed leader's "
+                    f"in-flight write can land after a standby's promotion "
+                    f"— route it through Scheduler.{FENCE_HELPER}() "
+                    "(docs/RESILIENCE.md 'HA & fencing')",
+                ))
+        self.generic_visit(node)
+
+
+def check_module(tree: ast.Module, src: str, path: str) -> List[Finding]:
+    if not _in_scope(path):
+        return []
+    visitor = _Visitor(path)
+    visitor.visit(tree)
+    return visitor.findings

@@ -32,14 +32,38 @@ def build_threads(
     metrics_port: int = 0,
     respect_busy: bool = True,
     trace_dir=None,
+    ha_identity=None,
 ):
-    """Wire up the thread set for a backend; returns (threads, rpc_queue)."""
+    """Wire up the thread set for a backend; returns (threads, rpc_queue).
+
+    With ``ha_identity`` set the replica runs in HA mode (k8s/lease.py):
+    it starts as a STANDBY — watching, keeping its node mirror warm, but
+    not acting — until the lease keeper wins the election; every commit
+    is then stamped with the fencing epoch, and the stall watchdog
+    releases the lease + exits crash-only if the scheduling loop wedges,
+    so the other replica takes over within one renew interval."""
     watch_q = WatchQueue()
     rpc_q: queue.Queue = queue.Queue(maxsize=128)  # reference: bin/nhd:21
 
-    scheduler = Scheduler(backend, watch_q, rpc_q, respect_busy=respect_busy)
-    controller = Controller(backend, watch_q)
+    elector = None
+    if ha_identity:
+        from nhd_tpu.k8s.lease import LeaderElector
+
+        elector = LeaderElector(backend, identity=ha_identity)
+
+    scheduler = Scheduler(
+        backend, watch_q, rpc_q, respect_busy=respect_busy, elector=elector
+    )
+    controller = Controller(backend, watch_q, elector=elector)
     threads = [controller, scheduler]
+
+    if elector is not None:
+        from nhd_tpu.k8s.lease import LeaseKeeper, StallWatchdog
+
+        threads.append(LeaseKeeper(elector))
+        threads.append(StallWatchdog(
+            lambda: scheduler.last_heartbeat, elector=elector
+        ))
 
     try:
         from nhd_tpu.rpc.server import StatsRpcServer
@@ -179,6 +203,14 @@ def main(argv=None) -> int:
     parser.add_argument("--cfg-type", default="triad",
                         help="config format for --explain files "
                              "(registered cfg_type, e.g. triad or json)")
+    parser.add_argument("--ha", action="store_true",
+                        help="lease-based leader election for 2+ replicas: "
+                             "start as standby, act only while holding the "
+                             "lease, fence every commit with the epoch "
+                             "(docs/RESILIENCE.md 'HA & fencing')")
+    parser.add_argument("--ha-identity", default=None,
+                        help="this replica's holder identity for the lease "
+                             "(default: <hostname>-<pid>)")
     parser.add_argument("--run-seconds", type=float, default=0,
                         help="exit cleanly after N seconds with a summary "
                              "(demo/smoke runs; 0 = run forever)")
@@ -232,9 +264,16 @@ def main(argv=None) -> int:
 
         backend = KubeClusterBackend()
 
+    ha_identity = None
+    if args.ha:
+        import socket
+
+        ha_identity = args.ha_identity or f"{socket.gethostname()}-{os.getpid()}"
+        logger.warning(f"HA mode: competing for the lease as {ha_identity}")
+
     threads, _ = build_threads(
         backend, rpc_port=args.rpc_port, metrics_port=args.metrics_port,
-        trace_dir=args.trace_out,
+        trace_dir=args.trace_out, ha_identity=ha_identity,
     )
     for t in threads:
         t.start()
@@ -248,6 +287,19 @@ def main(argv=None) -> int:
         if rec is not None:
             path = obs.dump_chrome_trace(rec, args.trace_out)
             print(f"trace written to {path}")
+
+    def release_leadership() -> None:
+        """Clean exits hand the lease over NOW: without the voluntary
+        release the standby waits out the full TTL (the handover bound
+        docs/OPERATIONS.md promises is one renew interval)."""
+        if not args.ha:
+            return
+        from nhd_tpu.k8s.lease import LeaseKeeper
+
+        for t in threads:
+            if isinstance(t, LeaseKeeper):
+                t.stop()
+                t.elector.step_down()
 
     # liveness watchdog (reference: bin/nhd:43-56): crash-only — if any
     # thread dies the whole process exits and the Deployment restarts it
@@ -265,12 +317,14 @@ def main(argv=None) -> int:
                     print(f"demo summary: {snap['bound_pods']}/"
                           f"{snap['total_pods']} pods "
                           f"bound across {snap['nodes']} nodes")
+                release_leadership()
                 dump_trace()
                 return 0
     except KeyboardInterrupt:
         # Ctrl-C on a run-forever daemon is the other "clean exit" the
         # --trace-out help text promises a dump for
         logger.warning("interrupted; shutting down")
+        release_leadership()
         dump_trace()
         return 0
 

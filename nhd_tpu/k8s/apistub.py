@@ -7,7 +7,10 @@ K8SMgr.py:468-492); a mocked client module can't catch payload or
 serialization bugs. This stub speaks the actual REST endpoints kube.py
 uses — list/read nodes and pods, ConfigMaps, strategic-merge pod
 patches, pod bindings, events, pod creation, the TriadSet custom
-resource, and line-delimited watch streams — over a real HTTP socket,
+resource, coordination.k8s.io Leases (with real resourceVersion
+optimistic concurrency: a stale replace answers 409, and the
+``fail_lease_puts`` hook forces conflicts for renewal-fault testing),
+and line-delimited watch streams — over a real HTTP socket,
 records every request (method, path, content type, raw body bytes) for
 byte-level assertions, and answers with faithful camelCase JSON shapes
 (a binding POST returns a Status object, exactly the response that trips
@@ -217,6 +220,15 @@ class _Handler(BaseHTTPRequestHandler):
                         "items": list(srv.triadsets.values()),
                     },
                 )
+            # GET /apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}
+            if (
+                parts[:1] == ["apis"] and len(parts) == 7
+                and parts[3] == "namespaces" and parts[5] == "leases"
+            ):
+                lease = srv.leases.get((parts[4], parts[6]))
+                return self._send_json(
+                    200 if lease else 404, lease or _status(404, "NotFound")
+                )
         self._send_json(404, _status(404, "NotFound"))
 
     def _stream_watch(self, path: str) -> None:
@@ -283,6 +295,42 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(200, ts)
         self._send_json(404, _status(404, "NotFound"))
 
+    def do_PUT(self) -> None:  # noqa: N802
+        """Lease replace with the API server's optimistic concurrency:
+        a body whose metadata.resourceVersion is stale answers 409, and
+        the ``fail_lease_puts`` fault hook forces the next N replaces to
+        409 regardless — the conflict-on-renew injection
+        (tests/test_kube_faults.py)."""
+        body = self._body()
+        self._record(body)
+        if self._reject_auth():
+            return
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        srv = self.server.stub
+        payload = json.loads(body or b"{}")
+        with srv.lock:
+            if not (
+                parts[:1] == ["apis"] and len(parts) == 7
+                and parts[3] == "namespaces" and parts[5] == "leases"
+            ):
+                return self._send_json(404, _status(404, "NotFound"))
+            key = (parts[4], parts[6])
+            lease = srv.leases.get(key)
+            if lease is None:
+                return self._send_json(404, _status(404, "NotFound"))
+            if srv.fail_lease_puts > 0:
+                srv.fail_lease_puts -= 1
+                return self._send_json(409, _status(409, "Conflict"))
+            sent_rv = (payload.get("metadata") or {}).get("resourceVersion")
+            cur_rv = lease["metadata"].get("resourceVersion")
+            if sent_rv != cur_rv:
+                return self._send_json(409, _status(409, "Conflict"))
+            payload.setdefault("metadata", {})["resourceVersion"] = str(
+                int(cur_rv) + 1
+            )
+            srv.leases[key] = payload
+            return self._send_json(200, payload)
+
     def do_POST(self) -> None:  # noqa: N802
         body = self._body()
         self._record(body)
@@ -292,6 +340,21 @@ class _Handler(BaseHTTPRequestHandler):
         srv = self.server.stub
         payload = json.loads(body or b"{}")
         with srv.lock:
+            # POST /apis/coordination.k8s.io/v1/namespaces/{ns}/leases
+            if (
+                parts[:1] == ["apis"] and len(parts) == 6
+                and parts[3] == "namespaces" and parts[5] == "leases"
+            ):
+                name = (payload.get("metadata") or {}).get("name")
+                if not name:
+                    return self._send_json(400, _status(400, "BadRequest"))
+                key = (parts[4], name)
+                if key in srv.leases:
+                    return self._send_json(409, _status(409, "Conflict"))
+                payload["metadata"]["resourceVersion"] = "1"
+                payload["metadata"].setdefault("namespace", parts[4])
+                srv.leases[key] = payload
+                return self._send_json(201, payload)
             if parts[:3] != ["api", "v1", "namespaces"]:
                 return self._send_json(404, _status(404, "NotFound"))
             ns = parts[3]
@@ -352,9 +415,12 @@ class StubApiServer:
         self.requests: List[Tuple[str, str, str, bytes]] = []
         self.watch_events: Dict[str, List[dict]] = {}
         self.watch_connects: Dict[str, int] = {}
+        self.leases: Dict[Tuple[str, str], dict] = {}
         self.fail_patches = False
         self.fail_bindings = False
         self.fail_gets = 0      # next N GETs answer 503 (retry testing)
+        self.fail_lease_puts = 0  # next N lease replaces answer 409
+        #                          (conflict-on-renew fault injection)
         self.watch_hang = 0.0   # seconds a watch stream stays open, silent
         self.closing = False
         self.token = token

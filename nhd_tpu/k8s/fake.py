@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -18,12 +19,16 @@ from nhd_tpu.k8s.interface import (
     CFG_TYPE_ANNOTATION,
     GPU_MAP_ANNOTATION_PREFIX,
     GROUPS_ANNOTATION,
+    LEASE_NAME,
     NAD_ANNOTATION,
     SCHEDULER_TAINT,
     ClusterBackend,
+    LeaseView,
     PodEvent,
+    StaleLeaseError,
     WatchEvent,
 )
+from nhd_tpu.k8s.retry import API_COUNTERS
 from nhd_tpu.utils import get_logger
 
 
@@ -37,6 +42,16 @@ class FakeNode:
     ready: bool = True
     unschedulable: bool = False
     taints: List[str] = field(default_factory=lambda: [SCHEDULER_TAINT])
+
+
+@dataclass
+class FakeLease:
+    """One coordination lease record (the API server's Lease object)."""
+
+    name: str
+    holder: str = ""
+    epoch: int = 0          # leaseTransitions: the fencing token
+    expires: float = 0.0    # backend-clock deadline
 
 
 @dataclass
@@ -71,6 +86,16 @@ class FakeClusterBackend(ClusterBackend):
         self._uid = itertools.count(1)
         self.fail_bind_for: set = set()      # (ns, pod) forced bind failures
         self.bind_count = 0
+        # coordination leases (leader election, k8s/lease.py). The clock
+        # is injectable so chaos runs drive lease expiry deterministically
+        # off the sim's step clock instead of wall time.
+        self.clock = time.monotonic
+        self.leases: Dict[str, FakeLease] = {}
+        # the lease fenced writes are checked against (interface.py)
+        self.fence_lease_name = LEASE_NAME
+        # every SUCCESSFUL bind: (ns, pod, uid, node, epoch) — the chaos
+        # harness's "no pod ever bound by two epochs" invariant reads this
+        self.bind_log: List[Tuple[str, str, str, str, Optional[int]]] = []
 
     # ------------------------------------------------------------------
     # simulation controls (test-facing, not part of ClusterBackend)
@@ -280,24 +305,51 @@ class FakeClusterBackend(ClusterBackend):
     # ClusterBackend: writes
     # ------------------------------------------------------------------
 
-    def add_nad_to_pod(self, pod: str, ns: str, nad: str) -> bool:
+    def _check_fence(self, epoch: Optional[int]) -> None:
+        """Reject a fenced write whose epoch a newer lease acquisition
+        has already overtaken. Caller holds ``self._lock``, so the check
+        is atomic with the write itself — the property that makes fencing
+        tokens sound (a deposed leader can't slip a write in between the
+        check and the mutation)."""
+        if epoch is None:
+            return
+        lease = self.leases.get(self.fence_lease_name)
+        if lease is not None and epoch < lease.epoch:
+            API_COUNTERS.inc("ha_stale_writes_rejected_total")
+            raise StaleLeaseError(
+                f"write fenced off: epoch {epoch} is stale "
+                f"(current lease epoch {lease.epoch}, "
+                f"holder {lease.holder!r})"
+            )
+
+    def add_nad_to_pod(
+        self, pod: str, ns: str, nad: str, *, epoch: Optional[int] = None
+    ) -> bool:
         with self._lock:
+            self._check_fence(epoch)
             p = self._pod(pod, ns)
             if p is None:
                 return False
             p.annotations[NAD_ANNOTATION] = nad
             return True
 
-    def annotate_pod_config(self, ns: str, pod: str, cfg: str) -> bool:
+    def annotate_pod_config(
+        self, ns: str, pod: str, cfg: str, *, epoch: Optional[int] = None
+    ) -> bool:
         with self._lock:
+            self._check_fence(epoch)
             p = self._pod(pod, ns)
             if p is None:
                 return False
             p.annotations[CFG_ANNOTATION] = cfg
             return True
 
-    def annotate_pod_gpu_map(self, ns: str, pod: str, gpu_map: Dict[str, int]) -> bool:
+    def annotate_pod_gpu_map(
+        self, ns: str, pod: str, gpu_map: Dict[str, int],
+        *, epoch: Optional[int] = None,
+    ) -> bool:
         with self._lock:
+            self._check_fence(epoch)
             p = self._pod(pod, ns)
             if p is None:
                 return False
@@ -305,14 +357,18 @@ class FakeClusterBackend(ClusterBackend):
                 p.annotations[f"{GPU_MAP_ANNOTATION_PREFIX}.{dev}"] = str(devid)
             return True
 
-    def bind_pod_to_node(self, pod: str, node: str, ns: str) -> bool:
+    def bind_pod_to_node(
+        self, pod: str, node: str, ns: str, *, epoch: Optional[int] = None
+    ) -> bool:
         with self._lock:
+            self._check_fence(epoch)
             p = self._pod(pod, ns)
             if p is None or (ns, pod) in self.fail_bind_for:
                 return False
             p.node = node
             p.phase = "Running"  # kubelet admission, fast-forwarded
             self.bind_count += 1
+            self.bind_log.append((ns, pod, p.uid, node, epoch))
             return True
 
     def generate_pod_event(self, pod, ns, reason, event_type, message) -> None:
@@ -320,6 +376,53 @@ class FakeClusterBackend(ClusterBackend):
             self.events.append(
                 PodEvent(pod, ns, reason, event_type, f"NHD: {message}")
             )
+
+    # ------------------------------------------------------------------
+    # coordination leases (leader election, k8s/lease.py)
+    # ------------------------------------------------------------------
+
+    def _lease_view(self, lease: FakeLease) -> LeaseView:
+        return LeaseView(
+            name=lease.name, holder=lease.holder,
+            epoch=lease.epoch, expires=lease.expires,
+        )
+
+    def lease_try_acquire(self, name: str, holder: str, ttl: float) -> LeaseView:
+        with self._lock:
+            now = self.clock()
+            lease = self.leases.setdefault(name, FakeLease(name=name))
+            taken = lease.holder and lease.expires > now
+            if taken and lease.holder != holder:
+                return self._lease_view(lease)   # held by someone else
+            # unheld, expired, or our own stale incarnation: every
+            # acquisition bumps the epoch — the token must be fresh even
+            # for a same-holder re-acquire after a crash/restart
+            lease.holder = holder
+            lease.epoch += 1
+            lease.expires = now + ttl
+            return self._lease_view(lease)
+
+    def lease_renew(self, name: str, holder: str, epoch: int, ttl: float) -> bool:
+        with self._lock:
+            lease = self.leases.get(name)
+            if lease is None or lease.holder != holder or lease.epoch != epoch:
+                return False
+            lease.expires = self.clock() + ttl
+            return True
+
+    def lease_release(self, name: str, holder: str, epoch: int) -> bool:
+        with self._lock:
+            lease = self.leases.get(name)
+            if lease is None or lease.holder != holder or lease.epoch != epoch:
+                return False
+            lease.holder = ""
+            lease.expires = 0.0      # epoch survives: tokens never rewind
+            return True
+
+    def lease_read(self, name: str) -> Optional[LeaseView]:
+        with self._lock:
+            lease = self.leases.get(name)
+            return self._lease_view(lease) if lease else None
 
     # ------------------------------------------------------------------
     # watch + TriadSets

@@ -33,6 +33,10 @@ GPU_MAP_ANNOTATION_PREFIX = f"{DOMAIN}/nhd_gpu_devices"
 SCHEDULER_TAINT = f"{DOMAIN}/nhd_scheduler"
 NAD_ANNOTATION = "k8s.v1.cni.cncf.io/networks"
 
+#: the election lease every replica competes for, and the lease fenced
+#: writes are checked against (k8s/lease.py, docs/RESILIENCE.md "HA")
+LEASE_NAME = "nhd-scheduler-leader"
+
 
 class EventType(Enum):
     NORMAL = "Normal"
@@ -49,6 +53,31 @@ class TransientBackendError(Exception):
     path; docs/RESILIENCE.md). Raised by KubeClusterBackend when the
     retry policy gives up on a retryable error, and by the fault-injection
     shim (sim/faults.py) to simulate exactly that."""
+
+
+class StaleLeaseError(TransientBackendError):
+    """A fenced write carried an epoch older than the backend's current
+    lease epoch: the caller was deposed mid-commit and a newer leader has
+    already taken over. Subclasses TransientBackendError so the deposed
+    leader's commit path takes the existing unwind+requeue route — the
+    claim rolls back locally and the NEW leader owns the pod's next
+    attempt (docs/RESILIENCE.md "HA & fencing")."""
+
+
+@dataclass(frozen=True)
+class LeaseView:
+    """Point-in-time state of a coordination lease.
+
+    ``epoch`` is the monotonic fencing token: bumped on EVERY acquisition
+    (even a same-holder re-acquisition after expiry), never reused, so a
+    write stamped with epoch N can be rejected the instant any lease
+    acquisition advances past N. ``expires`` is in the backend's own
+    clock domain — callers compare holders and epochs, not clocks."""
+
+    name: str
+    holder: str        # "" = unheld
+    epoch: int
+    expires: float
 
 
 @dataclass
@@ -153,21 +182,38 @@ class ClusterBackend(ABC):
         (K8SMgr.py:328-356)."""
 
     # ---- writes ----
+    #
+    # Every mutating call on the scheduling commit path takes an optional
+    # ``epoch`` fencing token (k8s/lease.py). ``None`` means unfenced —
+    # the single-replica stance, exactly the pre-HA behavior. With an
+    # epoch, the backend MUST reject the write with StaleLeaseError when
+    # a newer lease epoch exists, atomically with the write itself, so a
+    # deposed leader's in-flight commit can never land after a standby's
+    # promotion (docs/RESILIENCE.md "HA & fencing").
 
     @abstractmethod
-    def add_nad_to_pod(self, pod: str, ns: str, nad: str) -> bool:
+    def add_nad_to_pod(
+        self, pod: str, ns: str, nad: str, *, epoch: Optional[int] = None
+    ) -> bool:
         """CNI NetworkAttachmentDefinition annotation (K8SMgr.py:284-298)."""
 
     @abstractmethod
-    def annotate_pod_config(self, ns: str, pod: str, cfg: str) -> bool:
+    def annotate_pod_config(
+        self, ns: str, pod: str, cfg: str, *, epoch: Optional[int] = None
+    ) -> bool:
         """Persist the solved config (K8SMgr.py:379-393)."""
 
     @abstractmethod
-    def annotate_pod_gpu_map(self, ns: str, pod: str, gpu_map: Dict[str, int]) -> bool:
+    def annotate_pod_gpu_map(
+        self, ns: str, pod: str, gpu_map: Dict[str, int],
+        *, epoch: Optional[int] = None,
+    ) -> bool:
         """Per-device GPU annotations (K8SMgr.py:359-376)."""
 
     @abstractmethod
-    def bind_pod_to_node(self, pod: str, node: str, ns: str) -> bool:
+    def bind_pod_to_node(
+        self, pod: str, node: str, ns: str, *, epoch: Optional[int] = None
+    ) -> bool:
         """THE schedule commit point — V1Binding (K8SMgr.py:468-492)."""
 
     @abstractmethod
@@ -175,6 +221,35 @@ class ClusterBackend(ABC):
         self, pod: str, ns: str, reason: str, event_type: EventType, message: str
     ) -> None:
         """Operator-facing audit trail, 'NHD:'-prefixed (K8SMgr.py:518-559)."""
+
+    # ---- coordination leases (leader election, k8s/lease.py) ----
+    #
+    # Lease times live in the BACKEND's clock domain (the fake's
+    # injectable clock for tests/chaos, wall time against a real API
+    # server); callers reason about holders and epochs only.
+
+    @abstractmethod
+    def lease_try_acquire(self, name: str, holder: str, ttl: float) -> LeaseView:
+        """Atomically acquire the lease if it is unheld or expired,
+        bumping the fencing epoch; returns the RESULTING lease state
+        either way (``view.holder == holder`` tells the caller it won).
+        Losing an acquisition race is a normal outcome, not an error."""
+
+    @abstractmethod
+    def lease_renew(self, name: str, holder: str, epoch: int, ttl: float) -> bool:
+        """Extend the lease iff (holder, epoch) still match the current
+        record — a compare-and-swap. False means the lease was lost
+        (expired and re-acquired, or force-taken): step down NOW."""
+
+    @abstractmethod
+    def lease_release(self, name: str, holder: str, epoch: int) -> bool:
+        """Voluntary step-down: clear the holder iff (holder, epoch)
+        still match, so a standby can acquire without waiting out the
+        TTL. The epoch is NOT reset — fencing tokens never go back."""
+
+    @abstractmethod
+    def lease_read(self, name: str) -> Optional[LeaseView]:
+        """Current lease state, or None when no such lease exists."""
 
     # ---- watch plane (consumed by the controller) ----
 

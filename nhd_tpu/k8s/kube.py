@@ -24,9 +24,12 @@ from nhd_tpu.k8s.interface import (
     CFG_TYPE_ANNOTATION,
     GPU_MAP_ANNOTATION_PREFIX,
     GROUPS_ANNOTATION,
+    LEASE_NAME,
     NAD_ANNOTATION,
     SCHEDULER_TAINT,
     ClusterBackend,
+    LeaseView,
+    StaleLeaseError,
     TransientBackendError,
     WatchEvent,
 )
@@ -43,6 +46,45 @@ _RESYNC_DEFAULT_SEC = float(os.environ.get("NHD_RESYNC_SEC", "300"))
 # last-seen pod snapshot: (uid, annotations, scheduler_name, node) — what a
 # synthetic delete event must carry after the object is gone
 _PodSnap = Tuple[str, Dict[str, str], str, str]
+
+# namespace holding the election Lease object (the scheduler Deployment's
+# own namespace in the 2-replica recipe, docs/OPERATIONS.md)
+_LEASE_NS_DEFAULT = os.environ.get("NHD_LEASE_NS", "default")
+
+# fence-check cache window (seconds; 0 = a fresh Lease GET per fenced
+# write). A pod commit runs up to 4 fenced mutators — without the cache
+# that is 4 serial Lease GETs per pod on the hot bind path. Caching only
+# delays noticing a NEWER epoch by at most this window, which is the same
+# order as the check-then-write race the kube fence already has (the
+# atomic rejection lives in the fake backend / chaos harness); keep it
+# well under the lease TTL.
+_FENCE_CACHE_SEC = float(os.environ.get("NHD_FENCE_CACHE_SEC", "1.0"))
+
+# K8s MicroTime wire format (Lease spec.acquireTime/renewTime)
+_MICRO_TIME_FMT = "%Y-%m-%dT%H:%M:%S.%fZ"
+
+
+def _micro_time(ts: float) -> str:
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).strftime(_MICRO_TIME_FMT)
+
+
+def _parse_micro_time(raw: Optional[str]) -> Optional[float]:
+    if not raw:
+        return None
+    import datetime
+
+    for fmt in (_MICRO_TIME_FMT, "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            return datetime.datetime.strptime(raw, fmt).replace(
+                tzinfo=datetime.timezone.utc
+            ).timestamp()
+        except ValueError:
+            continue
+    return None
 
 
 class KubeClusterBackend(ClusterBackend):
@@ -121,6 +163,16 @@ class KubeClusterBackend(ClusterBackend):
         self._resync_interval = (
             _RESYNC_DEFAULT_SEC if resync_interval is None else resync_interval
         )
+        # HA lease plumbing (k8s/lease.py): the namespace the election
+        # Lease lives in, and the lease fenced writes are checked against
+        self._lease_ns = _LEASE_NS_DEFAULT
+        self.fence_lease_name = LEASE_NAME
+        # fence-check cache: (valid-until monotonic stamp, LeaseView or
+        # None); written by commit threads under _fence_lock. Only
+        # _check_fence reads through it — the election itself
+        # (lease_renew/lease_try_acquire) always goes to the server.
+        self._fence_lock = threading.Lock()
+        self._fence_cached: Optional[Tuple[float, Optional[LeaseView]]] = None
         # dead-socket defense on the watch plane: the restclient bakes a
         # finite read timeout into stream requests itself; the real
         # kubernetes client needs it passed per stream() call. Gated on
@@ -310,21 +362,67 @@ class KubeClusterBackend(ClusterBackend):
             self.logger.error(f"annotation patch failed for {ns}/{pod}: {exc}")
             return False
 
-    def add_nad_to_pod(self, pod: str, ns: str, nad: str) -> bool:
+    def _check_fence(self, epoch: Optional[int]) -> None:
+        """Reject a fenced write whose epoch a newer lease acquisition has
+        overtaken. Kubernetes has no conditional bind, so unlike the fake
+        backend this is check-then-write, not atomic — the check (a Lease
+        GET under the retry policy, cached for NHD_FENCE_CACHE_SEC so a
+        pod commit's 4 fenced mutators don't pay 4 serial round trips)
+        narrows the deposed-leader window to one round trip plus the
+        cache window; the atomic form of the rejection is what the
+        split-brain chaos harness proves against the fake
+        (docs/RESILIENCE.md)."""
+        if epoch is None:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        view = None
+        fresh = False
+        if _FENCE_CACHE_SEC > 0:
+            with self._fence_lock:
+                cached = self._fence_cached
+            if cached is not None and now < cached[0]:
+                view, fresh = cached[1], True
+        if not fresh:
+            view = self.lease_read(self.fence_lease_name)
+            with self._fence_lock:
+                self._fence_cached = (now + _FENCE_CACHE_SEC, view)
+        if view is not None and epoch < view.epoch:
+            API_COUNTERS.inc("ha_stale_writes_rejected_total")
+            raise StaleLeaseError(
+                f"write fenced off: epoch {epoch} is stale (current lease "
+                f"epoch {view.epoch}, holder {view.holder!r})"
+            )
+
+    def add_nad_to_pod(
+        self, pod: str, ns: str, nad: str, *, epoch: Optional[int] = None
+    ) -> bool:
+        self._check_fence(epoch)
         return self._patch_annotation(pod, ns, {NAD_ANNOTATION: nad})
 
-    def annotate_pod_config(self, ns: str, pod: str, cfg: str) -> bool:
+    def annotate_pod_config(
+        self, ns: str, pod: str, cfg: str, *, epoch: Optional[int] = None
+    ) -> bool:
+        self._check_fence(epoch)
         return self._patch_annotation(pod, ns, {CFG_ANNOTATION: cfg})
 
-    def annotate_pod_gpu_map(self, ns: str, pod: str, gpu_map: Dict[str, int]) -> bool:
+    def annotate_pod_gpu_map(
+        self, ns: str, pod: str, gpu_map: Dict[str, int],
+        *, epoch: Optional[int] = None,
+    ) -> bool:
+        self._check_fence(epoch)
         return self._patch_annotation(
             pod, ns,
             {f"{GPU_MAP_ANNOTATION_PREFIX}.{d}": str(i) for d, i in gpu_map.items()},
         )
 
-    def bind_pod_to_node(self, pod: str, node: str, ns: str) -> bool:
+    def bind_pod_to_node(
+        self, pod: str, node: str, ns: str, *, epoch: Optional[int] = None
+    ) -> bool:
         """V1Binding; the known kubernetes-client ValueError on the empty
         response is swallowed like the reference does (K8SMgr.py:487-491)."""
+        self._check_fence(epoch)
         client = self._client
         body = client.V1Binding(
             metadata=client.V1ObjectMeta(name=pod),
@@ -741,6 +839,171 @@ class KubeClusterBackend(ClusterBackend):
         except queue.Empty:
             pass
         return out
+
+    # ------------------------------------------------------------------
+    # coordination leases (leader election, k8s/lease.py)
+    #
+    # Implemented over the generic custom-object surface — both client
+    # paths (real kubernetes package and the in-repo restclient) return
+    # plain JSON dicts there, and every call runs under the retry policy.
+    # The CAS is the API server's own optimistic concurrency: replace()
+    # carries metadata.resourceVersion, a stale one answers 409 Conflict.
+    # The fencing epoch is spec.leaseTransitions, bumped on EVERY
+    # acquisition (a same-holder re-acquire after restart still gets a
+    # fresh token).
+    # ------------------------------------------------------------------
+
+    _LEASE_GROUP = "coordination.k8s.io"
+    _LEASE_VERSION = "v1"
+    _LEASE_PLURAL = "leases"
+
+    def _lease_get_raw(self, name: str) -> Optional[dict]:
+        try:
+            return self.crd.get_namespaced_custom_object(
+                self._LEASE_GROUP, self._LEASE_VERSION, self._lease_ns,
+                self._LEASE_PLURAL, name,
+            )
+        except self._client.exceptions.ApiException as exc:
+            if getattr(exc, "status", None) == 404:
+                return None
+            # retry budget spent or a terminal surprise (403, …): either
+            # way the election cannot verify the lease right now — the
+            # elector's grace logic owns that outcome
+            raise TransientBackendError(
+                f"lease read for {name} failed: {exc}"
+            ) from exc
+
+    @staticmethod
+    def _lease_view_of(name: str, obj: dict) -> LeaseView:
+        spec = obj.get("spec") or {}
+        renewed = _parse_micro_time(
+            spec.get("renewTime") or spec.get("acquireTime")
+        )
+        duration = float(spec.get("leaseDurationSeconds") or 0)
+        return LeaseView(
+            name=name,
+            holder=spec.get("holderIdentity") or "",
+            epoch=int(spec.get("leaseTransitions") or 0),
+            expires=(renewed + duration) if renewed is not None else 0.0,
+        )
+
+    @staticmethod
+    def _lease_spec(holder: str, ttl: float, epoch: int, now: float) -> dict:
+        stamp = _micro_time(now)
+        return {
+            "holderIdentity": holder,
+            "leaseDurationSeconds": max(int(round(ttl)), 1),
+            "acquireTime": stamp,
+            "renewTime": stamp,
+            "leaseTransitions": epoch,
+        }
+
+    def _lease_replace(self, name: str, body: dict) -> Optional[dict]:
+        """Conditional replace; None when the CAS lost (409 Conflict)."""
+        try:
+            return self.crd.replace_namespaced_custom_object(
+                self._LEASE_GROUP, self._LEASE_VERSION, self._lease_ns,
+                self._LEASE_PLURAL, name, body,
+            )
+        except self._client.exceptions.ApiException as exc:
+            if getattr(exc, "status", None) in (409, 404):
+                return None   # lost the race / lease deleted under us
+            raise TransientBackendError(
+                f"lease replace for {name} failed: {exc}"
+            ) from exc
+
+    def lease_try_acquire(self, name: str, holder: str, ttl: float) -> LeaseView:
+        import time as _time
+
+        now = _time.time()
+        obj = self._lease_get_raw(name)
+        if obj is None:
+            body = {
+                "apiVersion": f"{self._LEASE_GROUP}/{self._LEASE_VERSION}",
+                "kind": "Lease",
+                "metadata": {"name": name, "namespace": self._lease_ns},
+                "spec": self._lease_spec(holder, ttl, epoch=1, now=now),
+            }
+            try:
+                created = self.crd.create_namespaced_custom_object(
+                    self._LEASE_GROUP, self._LEASE_VERSION, self._lease_ns,
+                    self._LEASE_PLURAL, body,
+                )
+                return self._lease_view_of(name, created)
+            except self._client.exceptions.ApiException as exc:
+                if getattr(exc, "status", None) != 409:
+                    raise TransientBackendError(
+                        f"lease create for {name} failed: {exc}"
+                    ) from exc
+                obj = self._lease_get_raw(name)   # lost the create race
+                if obj is None:
+                    raise TransientBackendError(
+                        f"lease {name} vanished mid-acquisition"
+                    ) from exc
+        view = self._lease_view_of(name, obj)
+        if view.holder and view.expires > now and view.holder != holder:
+            return view   # held and live: the caller stays a follower
+        body = dict(obj)
+        body["spec"] = self._lease_spec(
+            holder, ttl, epoch=view.epoch + 1, now=now
+        )
+        replaced = self._lease_replace(name, body)
+        if replaced is not None:
+            return self._lease_view_of(name, replaced)
+        # CAS lost: someone else took it between our read and write —
+        # report THEIR state so the caller correctly stays a follower
+        obj = self._lease_get_raw(name)
+        return (
+            self._lease_view_of(name, obj) if obj is not None
+            else LeaseView(name=name, holder="", epoch=view.epoch, expires=0.0)
+        )
+
+    def lease_renew(self, name: str, holder: str, epoch: int, ttl: float) -> bool:
+        import time as _time
+
+        obj = self._lease_get_raw(name)
+        if obj is None:
+            return False
+        view = self._lease_view_of(name, obj)
+        if view.holder != holder or view.epoch != epoch:
+            return False
+        body = dict(obj)
+        spec = dict(obj.get("spec") or {})
+        spec["renewTime"] = _micro_time(_time.time())
+        spec["leaseDurationSeconds"] = max(int(round(ttl)), 1)
+        body["spec"] = spec
+        if self._lease_replace(name, body) is not None:
+            return True
+        # CAS lost — but to WHOM? A renew PUT whose response was lost is
+        # resent by the retry layer and answers 409 to its own landed
+        # first send. If the lease still shows (holder, epoch) == ours,
+        # the only writer that can have advanced the resourceVersion
+        # while preserving both is ourselves: the renewal landed. Only a
+        # rival's acquisition (holder or epoch moved) is a real loss —
+        # demoting a healthy leader on every response blip would bounce
+        # leadership (and the epoch) once per network hiccup.
+        obj = self._lease_get_raw(name)
+        if obj is None:
+            return False
+        cur = self._lease_view_of(name, obj)
+        return cur.holder == holder and cur.epoch == epoch
+
+    def lease_release(self, name: str, holder: str, epoch: int) -> bool:
+        obj = self._lease_get_raw(name)
+        if obj is None:
+            return False
+        view = self._lease_view_of(name, obj)
+        if view.holder != holder or view.epoch != epoch:
+            return False
+        body = dict(obj)
+        spec = dict(obj.get("spec") or {})
+        spec["holderIdentity"] = ""   # epoch survives: tokens never rewind
+        body["spec"] = spec
+        return self._lease_replace(name, body) is not None
+
+    def lease_read(self, name: str) -> Optional[LeaseView]:
+        obj = self._lease_get_raw(name)
+        return self._lease_view_of(name, obj) if obj is not None else None
 
     # ------------------------------------------------------------------
     # TriadSets (CRD group/version per deploy/triad-crd.1.16.yaml)

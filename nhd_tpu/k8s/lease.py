@@ -1,0 +1,309 @@
+"""Lease-based leader election with monotonic fencing epochs.
+
+The reference NHD is a single replica whose whole availability story is
+"crash-only + Deployment restart" (bin/nhd:43-56): a wedged or restarting
+scheduler means NO scheduler until the kubelet notices. This module lets
+two or more replicas run safely:
+
+* :class:`LeaderElector` — acquire/renew/step-down over the
+  ``ClusterBackend`` lease seam (interface.py). Backed by
+  ``FakeClusterBackend`` state for tests and chaos, by
+  coordination.k8s.io/v1 Lease objects through ``kube.py`` (under the
+  retry layer) on a real cluster. Every acquisition bumps a monotonic
+  **fencing epoch**; the scheduler stamps it onto every mutating commit
+  (scheduler/core.py ``_commit_write``) and backends reject stale epochs
+  atomically, so a deposed leader's in-flight batch cannot land.
+* :class:`LeaseKeeper` — the daemon thread that ticks an elector at the
+  renew cadence (the production driver; tests tick by hand).
+* :class:`StallWatchdog` — observes the scheduling loop's heartbeat
+  (``Scheduler.last_heartbeat``, the same loop the flight-recorder spans
+  are emitted from). A loop wedged past the stall budget voluntarily
+  releases the lease and exits crash-only, so a standby replica takes
+  over in one renew interval instead of a liveness-probe eternity.
+
+Renewal semantics (the client-go shape): a renewal that *errors*
+(TransientBackendError — the API server is unreachable) is tolerated
+while the last successful renewal is younger than the TTL — the lease
+can't have expired yet, so leadership is still provably ours. Past the
+TTL the elector demotes itself WITHOUT waiting for proof: it can no
+longer distinguish "server down" from "deposed", and acting without a
+live lease is exactly the split-brain this module exists to prevent. A
+renewal that *returns False* (the compare-and-swap lost: someone else
+holds the lease, or the epoch moved) demotes immediately.
+
+Everything is injectable (clock, counters) so election is unit-tested
+without a single real sleep (tests/test_ha.py, same pattern as
+tests/test_retry.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from nhd_tpu.k8s.interface import LEASE_NAME, ClusterBackend, TransientBackendError
+from nhd_tpu.k8s.retry import API_COUNTERS, ApiCounters
+from nhd_tpu.utils import get_logger
+
+# production cadence knobs (docs/OPERATIONS.md "High availability"):
+# renew several times per TTL so one flaky renewal never costs leadership
+LEASE_TTL_SEC = float(os.environ.get("NHD_LEASE_TTL", "15"))
+LEASE_RENEW_SEC = float(os.environ.get("NHD_LEASE_RENEW_SEC", "4"))
+# the stall budget: how long the scheduling loop may go without a
+# heartbeat before the watchdog releases the lease and exits crash-only.
+# The loop beats at least every Q_BLOCK_TIME_SEC (0.5 s) when healthy,
+# plus however long one batch solve+commit legitimately takes — size the
+# budget for the worst legitimate batch, not the idle cadence.
+WATCHDOG_STALL_SEC = float(os.environ.get("NHD_WATCHDOG_STALL_SEC", "120"))
+WATCHDOG_POLL_SEC = float(os.environ.get("NHD_WATCHDOG_POLL_SEC", "5"))
+
+
+class LeaderElector:
+    """One replica's view of the election: FOLLOWER until an acquisition
+    wins, LEADER until a renewal proves otherwise.
+
+    ``tick()`` is the whole protocol — call it every ``renew_interval``
+    (LeaseKeeper does, chaos/tests do it by hand). ``is_leader`` /
+    ``fencing_epoch()`` are thread-safe snapshots for the scheduler and
+    its commit-pool threads; state only CHANGES inside ``tick()`` and
+    ``step_down()``, so a replica that believes it leads keeps believing
+    so between ticks — which is precisely the split-brain window the
+    fencing epochs exist to make harmless.
+    """
+
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        *,
+        identity: str,
+        lease_name: str = LEASE_NAME,
+        ttl: float = LEASE_TTL_SEC,
+        clock: Callable[[], float] = time.monotonic,
+        counters: ApiCounters = API_COUNTERS,
+    ):
+        if ttl <= 0:
+            raise ValueError(f"lease ttl must be > 0, got {ttl}")
+        self.backend = backend
+        self.identity = identity
+        self.lease_name = lease_name
+        self.ttl = ttl
+        self.logger = get_logger(__name__)
+        self._clock = clock
+        self._counters = counters
+        self._lock = threading.Lock()
+        self._leader = False
+        self._epoch = 0           # last epoch we led under (never rewinds)
+        self._last_renew_ok = 0.0
+
+    # -- thread-safe snapshots -----------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._leader
+
+    @property
+    def epoch(self) -> int:
+        """The last epoch this replica led under (0 = never led)."""
+        with self._lock:
+            return self._epoch
+
+    def fencing_epoch(self) -> Optional[int]:
+        """The epoch to stamp on a fenced write, or None when this
+        replica is not (or no longer) the leader."""
+        with self._lock:
+            return self._epoch if self._leader else None
+
+    # -- the protocol ---------------------------------------------------
+
+    def tick(self) -> bool:
+        """One election step: leaders renew, followers try to acquire.
+        Returns the post-tick leadership. Backend faults never escape —
+        an unreachable API server is an election outcome (grace, then
+        demotion), not an exception for the caller."""
+        if self.is_leader:
+            self._tick_leader()
+        else:
+            self._tick_follower()
+        return self.is_leader
+
+    def _tick_leader(self) -> None:
+        now = self._clock()
+        try:
+            ok = self.backend.lease_renew(
+                self.lease_name, self.identity, self._epoch, self.ttl
+            )
+        except TransientBackendError as exc:
+            # server health, not a verdict: leadership is provably ours
+            # while the lease we last renewed cannot have expired yet
+            self._counters.inc("ha_renewal_failures_total")
+            with self._lock:
+                grace_spent = now - self._last_renew_ok > self.ttl
+            if grace_spent:
+                self._demote(f"renew grace expired ({exc})")
+            else:
+                self.logger.warning(
+                    f"lease renew errored (within grace): {exc}"
+                )
+            return
+        if ok:
+            self._counters.inc("ha_renewals_total")
+            with self._lock:
+                self._last_renew_ok = now
+        else:
+            # CAS lost: the lease is no longer ours — no grace applies
+            self._counters.inc("ha_renewal_failures_total")
+            self._demote("lease lost (renew CAS failed)")
+
+    def _tick_follower(self) -> None:
+        try:
+            view = self.backend.lease_try_acquire(
+                self.lease_name, self.identity, self.ttl
+            )
+        except TransientBackendError as exc:
+            self.logger.warning(f"lease acquire errored: {exc}")
+            return
+        if view.holder == self.identity:
+            self._promote(view.epoch)
+
+    def step_down(self) -> None:
+        """Voluntary release (watchdog demotion, clean shutdown): clears
+        the holder so a standby acquires on its next tick instead of
+        waiting out the TTL."""
+        with self._lock:
+            if not self._leader:
+                return
+            epoch = self._epoch
+        try:
+            self.backend.lease_release(self.lease_name, self.identity, epoch)
+        except TransientBackendError as exc:
+            # the release is an optimization (faster handover); expiry
+            # still bounds the gap if it never lands
+            self.logger.warning(f"lease release failed: {exc}")
+        self._demote("voluntary step-down")
+
+    # -- transitions ----------------------------------------------------
+
+    def _promote(self, epoch: int) -> None:
+        with self._lock:
+            self._leader = True
+            self._epoch = epoch
+            self._last_renew_ok = self._clock()
+        self._counters.inc("ha_transitions_total")
+        self._counters.set("ha_is_leader", 1)
+        self._counters.set("ha_epoch", epoch)
+        self.logger.warning(
+            f"{self.identity}: elected leader (epoch {epoch})"
+        )
+
+    def _demote(self, why: str) -> None:
+        with self._lock:
+            if not self._leader:
+                return
+            self._leader = False
+        self._counters.inc("ha_transitions_total")
+        self._counters.set("ha_is_leader", 0)
+        self.logger.warning(f"{self.identity}: stepping down — {why}")
+
+
+class LeaseKeeper(threading.Thread):
+    """Daemon thread ticking an elector at the renew cadence (the
+    production driver behind ``nhd-tpu --ha``)."""
+
+    def __init__(
+        self, elector: LeaderElector, *, interval: float = LEASE_RENEW_SEC
+    ):
+        super().__init__(name="nhd-lease-keeper", daemon=True)
+        self.elector = elector
+        self.interval = interval
+        self.logger = get_logger(__name__)
+        self._stop_event = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self.elector.tick()
+            except Exception:
+                # tick() absorbs backend faults itself; anything else is
+                # a bug worth logging, but the keeper dying would freeze
+                # the election at whatever state it last reached
+                self.logger.exception("election tick failed")
+            self._stop_event.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop_event.set()
+
+
+class StallWatchdog(threading.Thread):
+    """Crash-only stall detection for the scheduling loop.
+
+    ``beat`` returns the loop's last-heartbeat stamp (monotonic; the
+    scheduler refreshes it at the top of every ``run_once``, the same
+    turn of the loop the flight-recorder spans and histograms are fed
+    from). When the heartbeat goes stale past ``stall_after``, the
+    watchdog releases the lease (so a standby promotes within one renew
+    interval) and invokes ``exit_fn`` — ``os._exit`` by default, the
+    same crash-only exit the cli liveness loop uses for a *dead* thread.
+    This covers the case that loop cannot: a thread that is alive but
+    wedged (stuck solve, hung uninstrumented call) still holds the lease
+    and silently stalls the queue.
+    """
+
+    def __init__(
+        self,
+        beat: Callable[[], float],
+        *,
+        stall_after: float = WATCHDOG_STALL_SEC,
+        interval: float = WATCHDOG_POLL_SEC,
+        elector: Optional[LeaderElector] = None,
+        exit_fn: Callable[[int], None] = os._exit,
+        clock: Callable[[], float] = time.monotonic,
+        counters: ApiCounters = API_COUNTERS,
+    ):
+        super().__init__(name="nhd-stall-watchdog", daemon=True)
+        if stall_after <= 0:
+            raise ValueError(f"stall_after must be > 0, got {stall_after}")
+        self.logger = get_logger(__name__)
+        self._beat = beat
+        self.stall_after = stall_after
+        self.interval = interval
+        self.elector = elector
+        self._exit_fn = exit_fn
+        self._clock = clock
+        self._counters = counters
+        self._stop_event = threading.Event()
+        self.fired = False
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """One watchdog pass; returns True when the stall tripped.
+        Public so tests drive it with an injected clock, no thread."""
+        now = self._clock() if now is None else now
+        age = max(now - self._beat(), 0.0)
+        self._counters.set("ha_watchdog_loop_age_seconds", age)
+        if age <= self.stall_after or self.fired:
+            return self.fired
+        self.fired = True
+        self._counters.inc("ha_watchdog_stalls_total")
+        self.logger.error(
+            f"scheduling loop stalled ({age:.1f}s since last heartbeat, "
+            f"budget {self.stall_after:.1f}s); releasing lease and "
+            "exiting crash-only"
+        )
+        if self.elector is not None:
+            self.elector.step_down()
+        self._exit_fn(2)
+        return True
+
+    def run(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self.check()
+            except Exception:
+                # a broken beat source must not kill the watchdog quietly
+                self.logger.exception("watchdog check failed")
+            self._stop_event.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop_event.set()

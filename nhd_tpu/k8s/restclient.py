@@ -516,6 +516,41 @@ class CustomObjectsApi:
             content_type="application/merge-patch+json",
         )
 
+    # namespaced get/create/replace — the real client's generic custom-
+    # object surface, which kube.py also uses for coordination.k8s.io
+    # Lease objects (leader election, k8s/lease.py): plain-JSON shapes on
+    # both client paths, and replace() carries metadata.resourceVersion so
+    # the API server's optimistic concurrency (409 Conflict) is the CAS.
+
+    def get_namespaced_custom_object(
+        self, group: str, version: str, namespace: str, plural: str,
+        name: str,
+    ) -> dict:
+        return self._http.request(
+            "GET",
+            f"/apis/{group}/{version}/namespaces/{namespace}/{plural}/{name}",
+        )
+
+    def create_namespaced_custom_object(
+        self, group: str, version: str, namespace: str, plural: str,
+        body: Any,
+    ) -> dict:
+        return self._http.request(
+            "POST",
+            f"/apis/{group}/{version}/namespaces/{namespace}/{plural}",
+            body=_serialize(body),
+        )
+
+    def replace_namespaced_custom_object(
+        self, group: str, version: str, namespace: str, plural: str,
+        name: str, body: Any,
+    ) -> dict:
+        return self._http.request(
+            "PUT",
+            f"/apis/{group}/{version}/namespaces/{namespace}/{plural}/{name}",
+            body=_serialize(body),
+        )
+
 
 # ---------------------------------------------------------------------------
 # watch

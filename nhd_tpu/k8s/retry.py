@@ -97,6 +97,25 @@ class ApiCounters:
              "Scheduler run-loop passes isolated (mirror rebuilt after)"),
         "bind_requeues_total":
             ("counter", "Pods requeued after a transient commit failure"),
+        # HA plane (k8s/lease.py, docs/RESILIENCE.md "HA & fencing")
+        "ha_is_leader":
+            ("gauge", "This replica currently holds the scheduler lease"),
+        "ha_epoch":
+            ("gauge", "Fencing epoch of this replica's last leadership"),
+        "ha_transitions_total":
+            ("counter", "Leadership transitions (promotions + demotions)"),
+        "ha_renewals_total":
+            ("counter", "Successful lease renewals"),
+        "ha_renewal_failures_total":
+            ("counter", "Lease renewals that errored or lost the CAS"),
+        "ha_promotions_total":
+            ("counter", "Standby -> leader promotion replays completed"),
+        "ha_stale_writes_rejected_total":
+            ("counter", "Fenced writes rejected for a stale epoch"),
+        "ha_watchdog_stalls_total":
+            ("counter", "Stall-watchdog firings (lease released, exiting)"),
+        "ha_watchdog_loop_age_seconds":
+            ("gauge", "Age of the scheduling loop's last heartbeat"),
     }
 
     def __init__(self) -> None:

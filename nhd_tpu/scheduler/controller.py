@@ -47,6 +47,7 @@ class Controller(threading.Thread):
         sched_name: str = "nhd-scheduler",
         poll_interval: float = 0.1,
         isolate_events: bool = True,
+        elector=None,
     ):
         super().__init__(name="nhd-controller", daemon=True)
         self.logger = get_logger(__name__)
@@ -54,6 +55,12 @@ class Controller(threading.Thread):
         self.queue = watch_queue
         self.sched_name = sched_name
         self.poll_interval = poll_interval
+        # HA standby mode (k8s/lease.py): watch translation always runs
+        # (the scheduler's standby path keeps its node mirror warm from
+        # it), but TriadSet reconciliation MUTATES the cluster (pod
+        # creation, status patches) and is gated on holding the lease —
+        # two replicas racing the same ordinal would double-create
+        self.elector = elector
         # per-event exception isolation: one poisoned event (truncated
         # object off a cut stream, a shape the translators never met) is
         # logged and counted instead of killing the run loop. False
@@ -199,6 +206,8 @@ class Controller(threading.Thread):
                 self.logger.exception(
                     f"poisoned watch event dropped ({ev.kind} {ev.name!r})"
                 )
+        if self.elector is not None and not self.elector.is_leader:
+            return  # standby: watch, don't act (leader owns TriadSets)
         t = time.monotonic() if now is None else now
         if t - self._last_triadset >= TRIADSET_PERIOD_SEC:
             self._last_triadset = t

@@ -22,7 +22,13 @@ from nhd_tpu import NHD_SCHED_NAME
 from nhd_tpu.config.parser import CfgParser, get_cfg_parser
 from nhd_tpu.core.node import HostNode
 from nhd_tpu.core.request import PodRequest
-from nhd_tpu.k8s.interface import ClusterBackend, EventType, TransientBackendError
+from nhd_tpu.k8s.interface import (
+    ClusterBackend,
+    EventType,
+    StaleLeaseError,
+    TransientBackendError,
+)
+from nhd_tpu.k8s.lease import LeaderElector
 from nhd_tpu.k8s.retry import API_COUNTERS
 from nhd_tpu.obs import histo as obs_histo
 from nhd_tpu.obs.recorder import correlate, get_recorder, new_corr_id
@@ -200,10 +206,22 @@ class Scheduler(threading.Thread):
         *,
         sched_name: str = NHD_SCHED_NAME,
         respect_busy: bool = True,
+        elector: Optional[LeaderElector] = None,
     ):
         super().__init__(name="nhd-scheduler", daemon=True)
         self.logger = get_logger(__name__)
         self.backend = backend
+        # HA mode (k8s/lease.py): with an elector wired, this replica
+        # acts (schedules, commits, scans) only while it holds the
+        # lease; without one it is the reference's single-replica
+        # stance — always acting, writes unfenced
+        self.elector = elector
+        self._acting = elector is None
+        # loop-liveness heartbeat, observed by the stall watchdog
+        # (k8s/lease.py StallWatchdog): refreshed at the top of every
+        # run_once turn — the same turn the flight-recorder spans and
+        # histograms are fed from, so a wedged loop goes silent on both
+        self.last_heartbeat = time.monotonic()
         self.nqueue = watch_queue or WatchQueue()
         self.rpcq = rpc_queue or queue.Queue(maxsize=128)
         self.sched_name = sched_name
@@ -406,6 +424,7 @@ class Scheduler(threading.Thread):
         receipt, controller.py) threads through every span this batch
         records. Scan-path pods get a fresh ID at admission.
         """
+        self._beat()
         t_adm = time.monotonic()
         rec = get_recorder()
         uids = {(ns, pod): uid for pod, ns, uid in pods}
@@ -465,6 +484,7 @@ class Scheduler(threading.Thread):
         results, bstats = solver.schedule(
             self.nodes, [item for _, item in prepared]
         )
+        self._beat()   # one solve finished: loop progress, not a wedge
         self.perf["batches_total"] += 1
         self.perf["solve_seconds_total"] += bstats.solve_seconds
         self.perf["select_seconds_total"] += bstats.select_seconds
@@ -550,6 +570,7 @@ class Scheduler(threading.Thread):
 
         scheduled = 0
         for (parser, item, result), (outcome, t_done) in zip(winners, outcomes):
+            self._beat()   # one commit outcome processed: progress
             ns, pod = item.key
             corr = corrs.get(item.key)
             if outcome is CommitOutcome.OK:
@@ -734,6 +755,37 @@ class Scheduler(threading.Thread):
             )
             return CommitOutcome.FAILED
 
+    def _fence_epoch(self) -> Optional[int]:
+        """The epoch to stamp on a fenced write. None in single-replica
+        mode (no elector: unfenced, the pre-HA behavior). With an elector,
+        a replica that is no longer leader raises StaleLeaseError — the
+        local half of fencing, catching a deposition this replica already
+        KNOWS about before a single API call is spent; the backend's
+        epoch check catches the depositions it doesn't."""
+        if self.elector is None:
+            return None
+        epoch = self.elector.fencing_epoch()
+        if epoch is None:
+            raise StaleLeaseError(
+                "this replica is not the leader (deposed mid-commit)"
+            )
+        return epoch
+
+    def _commit_write(self, fn, *args):
+        """THE fenced-commit chokepoint: every mutating backend call on
+        the commit path routes through here (nhdlint NHD501 flags any
+        that doesn't) so the current fencing epoch is stamped onto the
+        write and a stale epoch is rejected BY THE BACKEND — a deposed
+        leader's in-flight batch cannot land. StaleLeaseError subclasses
+        TransientBackendError, so rejection unwinds onto the existing
+        requeue path and the new leader owns the pod's next attempt."""
+        epoch = self._fence_epoch()
+        if epoch is None:
+            # keep duck-typed test backends without the epoch kwarg
+            # working in single-replica mode
+            return fn(*args)
+        return fn(*args, epoch=epoch)
+
     def _commit_pod_calls_inner(self, parser: CfgParser, item: BatchItem, result) -> bool:
         ns, pod = item.key
         node = self.nodes[result.node]
@@ -744,21 +796,27 @@ class Scheduler(threading.Thread):
 
         nic_indices = sorted({x[0] for x in (result.nic_list or [])})
         nad = ",".join(f"{x}@{x}" for x in node.nad_names_from_indices(nic_indices))
-        if nad and not self.backend.add_nad_to_pod(pod, ns, nad):
+        if nad and not self._commit_write(
+            self.backend.add_nad_to_pod, pod, ns, nad
+        ):
             self.logger.error(f"NAD annotation failed for {ns}/{pod}")
             return False
 
         solved = parser.to_config()
         gpu_map = parser.to_gpu_map()
 
-        if gpu_map and not self.backend.annotate_pod_gpu_map(ns, pod, gpu_map):
+        if gpu_map and not self._commit_write(
+            self.backend.annotate_pod_gpu_map, ns, pod, gpu_map
+        ):
             self.backend.generate_pod_event(
                 pod, ns, "PodCfgFailed", EventType.WARNING,
                 "Failed to annotate pod's GPU configuration",
             )
             return False
 
-        if not self.backend.annotate_pod_config(ns, pod, solved):
+        if not self._commit_write(
+            self.backend.annotate_pod_config, ns, pod, solved
+        ):
             self.backend.generate_pod_event(
                 pod, ns, "PodCfgFailed", EventType.WARNING,
                 "Failed to annotate pod's configuration",
@@ -769,7 +827,9 @@ class Scheduler(threading.Thread):
             "Successfully added pod's configuration to annotations",
         )
 
-        if not self.backend.bind_pod_to_node(pod, result.node, ns):
+        if not self._commit_write(
+            self.backend.bind_pod_to_node, pod, result.node, ns
+        ):
             self.backend.generate_pod_event(
                 pod, ns, "FailedScheduling", EventType.WARNING,
                 f"Failed to schedule {ns}/{pod} to {result.node}",
@@ -804,6 +864,7 @@ class Scheduler(threading.Thread):
         """Full-cluster scan: batch-schedule Pending pods, release Failed
         ones (reference: NHDScheduler.py:425-441), and reconcile the host
         mirror against the live pod list."""
+        self._beat()
         podlist = self.backend.service_pods(self.sched_name)
         self.reconcile_deleted_pods(
             {(ns, pod): uid for (ns, pod, uid) in podlist}
@@ -1050,17 +1111,109 @@ class Scheduler(threading.Thread):
     # main loop
     # ------------------------------------------------------------------
 
+    def _beat(self) -> None:
+        """Refresh the loop-liveness heartbeat. Called at every run_once
+        turn AND at intra-turn progress points (batch admission, solve
+        completion, each commit outcome, replay phases), so the stall
+        watchdog measures 'no progress', not 'one long turn' — a
+        legitimate big batch never trips it, a wedged solve still does."""
+        self.last_heartbeat = time.monotonic()
+
     def startup(self) -> None:
-        """Initialization sequence (reference: NHDScheduler.py:443-464)."""
+        """Initialization sequence (reference: NHDScheduler.py:443-464).
+        A standby replica builds its mirror but does NOT scan: acting
+        starts at election. A replica whose keeper already WON the
+        election by now skips poll_leadership's promotion replay —
+        startup itself just ran the same crash-only replay, and paying
+        it twice would double every node read and config load against
+        the API server."""
         self.build_initial_node_list()
         self.load_deployed_configs()
-        self.check_pending_pods()
+        if self.elector is not None:
+            self._acting = self.elector.is_leader
+        if self._acting:
+            self.check_pending_pods()
         # flush any watch events raised while we replayed existing pods
         try:
             while True:
                 self.nqueue.get(block=False)
         except queue.Empty:
             pass
+
+    def poll_leadership(self) -> bool:
+        """Reconcile this replica's acting state with the election;
+        returns True when it may mutate cluster state.
+
+        A standby→leader flip runs the **promotion replay**: the same
+        crash-only recovery path a restart takes (wipe the mirror,
+        re-claim every bound pod from its solved-config annotation, scan
+        for pending pods) — the standby's possibly-stale mirror is never
+        trusted, the cluster's annotations are the durable truth. A
+        leader→standby flip just stops acting; in-flight commits are
+        fenced off by their stale epoch at the backend."""
+        if self.elector is None:
+            return True
+        lead = self.elector.is_leader
+        if lead and not self._acting:
+            self.logger.warning(
+                f"promoted to leader (epoch {self.elector.epoch}); "
+                "replaying cluster state from annotations"
+            )
+            if not self._guarded("promotion replay", self._promotion_replay):
+                # the crash-only contract holds for promotions too:
+                # without replayed state, LEADING is wrong — release the
+                # lease so a healthy replica can take over instead of
+                # this one holding it with an empty/partial mirror (the
+                # loop is alive, so the watchdog would never fire)
+                self.logger.error(
+                    "promotion replay failed; releasing the lease"
+                )
+                self.elector.step_down()
+                self._acting = False
+                return False
+            API_COUNTERS.inc("ha_promotions_total")
+        elif not lead and self._acting:
+            self.logger.warning(
+                "demoted to standby; suspending scheduling "
+                "(in-flight commits are fenced off by epoch)"
+            )
+        self._acting = lead
+        return self._acting
+
+    def _promotion_replay(self) -> None:
+        # the crash-only restart path reused (startup minus the queue
+        # flush): rebuild the node inventory from the cluster — standby
+        # watch coverage is best-effort, a cordon it never saw must not
+        # survive into leadership — then re-claim every bound pod from
+        # its solved-config annotation and scan for pending pods. The
+        # heartbeat advances per phase: on a large cluster a legitimate
+        # replay can outlast the watchdog's whole-turn budget, and a
+        # crash mid-promotion would hand the NEXT replica the same wall
+        self.nodes.clear()
+        self.build_initial_node_list()
+        self._beat()
+        self.pod_state.clear()
+        self._missing_once.clear()
+        self._requeue_attempts.clear()
+        self.load_deployed_configs()
+        self._beat()
+        self.check_pending_pods()
+
+    def _handle_standby_item(self, item: WatchItem) -> None:
+        """Standby replicas keep their NODE mirror warm (cordons, groups,
+        maintenance — cheap, read-only-against-the-cluster updates) so a
+        promotion starts from a current node view, but never act on pod
+        events: the promotion replay rebuilds claims from the cluster,
+        which owns that information."""
+        if item.type in (
+            WatchType.NODE_CORDON, WatchType.NODE_UNCORDON,
+            WatchType.NODE_MAINT_START, WatchType.NODE_MAINT_END,
+            WatchType.GROUP_UPDATE,
+        ):
+            self._guarded(
+                f"standby watch item {item.type.name}",
+                self.handle_watch_item, item,
+            )
 
     def run_once(self, *, idle_count: int = 0) -> int:
         """One loop iteration; returns the updated idle counter.
@@ -1074,6 +1227,8 @@ class Scheduler(threading.Thread):
         queue (binds wake immediately) and the stats RPC queue is
         drained non-blocking each iteration — a stats call waits at
         most one loop turn, bind latency drops to solver time."""
+        self._beat()
+        acting = self.poll_leadership()
         try:
             rpc = self.rpcq.get(block=False)
             self._parse_rpc_req(*rpc)
@@ -1086,13 +1241,20 @@ class Scheduler(threading.Thread):
             idle_count += 1
             if idle_count >= IDLE_CNT_THRESH:
                 idle_count = 0
-                self._guarded("periodic scan", self.check_pending_pods)
+                if acting:
+                    self._guarded("periodic scan", self.check_pending_pods)
             return idle_count
-        self._guarded(f"watch item {item.type.name}", self.handle_watch_item, item)
+        if acting:
+            self._guarded(
+                f"watch item {item.type.name}", self.handle_watch_item, item
+            )
+        else:
+            self._handle_standby_item(item)
         return idle_count
 
-    def _guarded(self, what: str, fn, *args) -> None:
-        """Backend-fault isolation for the run loop.
+    def _guarded(self, what: str, fn, *args) -> bool:
+        """Backend-fault isolation for the run loop; returns True when
+        the pass completed.
 
         An ApiException that survives the retry layer — outage past the
         per-call deadline, open circuit — escaping ``service_pods`` or a
@@ -1103,13 +1265,16 @@ class Scheduler(threading.Thread):
         that gets through rebuilds the mirror from the cluster first
         (``reset_resources``, the reference's own drift repair), so
         nothing is trusted after a half-completed pass. Startup stays
-        crash-only — without initial state a process restart is right.
+        crash-only — without initial state a process restart is right —
+        and so does the promotion replay (poll_leadership steps down on
+        a False return rather than lead without state).
         """
         try:
             if self._mirror_dirty:
                 self.reset_resources()
                 self._mirror_dirty = False
             fn(*args)
+            return True
         except Exception:
             API_COUNTERS.inc("scheduler_loop_errors_total")
             self._mirror_dirty = True
@@ -1117,6 +1282,7 @@ class Scheduler(threading.Thread):
                 f"{what} failed (backend unavailable?); mirror will be "
                 "rebuilt from the cluster on the next successful pass"
             )
+            return False
 
     def run(self) -> None:
         self.startup()

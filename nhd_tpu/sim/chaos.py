@@ -12,6 +12,20 @@ With ``api_faults`` set, the same storm also hits the API layer
 annotate failures. ``quiesce()`` then proves crash-only recovery: faults
 stop, the control loops drain, and the run must end with zero invariant
 violations and no pod stranded by an API fault (``stuck_pods()``).
+
+With ``ha=True`` the sim becomes a **split-brain harness**: TWO complete
+scheduler replicas (each with its own elector, controller and watch
+queue) share one fake cluster, lease-renewal faults (the ``ha-*``
+profiles) force leadership churn, and every replica that *believes* it
+leads is driven every step — including deposed leaders that haven't
+noticed yet, which is exactly the overlap window fencing must make
+harmless. Two invariants join the standing set: **no pod is ever bound
+by two epochs** (the backend's bind log proves every landed write came
+from exactly one leadership), and **leadership gaps are bounded** (the
+cluster is never headless for longer than lease expiry + a few ticks).
+Restarts additionally assert **state equivalence**: the re-replayed
+claims must equal the pre-restart claims (and the cluster's own bound
+set), not merely satisfy the invariants.
 """
 
 from __future__ import annotations
@@ -20,14 +34,21 @@ import json
 import queue
 import random
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from nhd_tpu.k8s.fake import FakeClusterBackend
+from nhd_tpu.k8s.interface import LEASE_NAME
+from nhd_tpu.k8s.lease import LeaderElector
+from nhd_tpu.k8s.retry import ApiCounters
 from nhd_tpu.scheduler.controller import Controller
 from nhd_tpu.scheduler.core import Scheduler
 from nhd_tpu.scheduler.events import WatchQueue
 from nhd_tpu.sim.faults import FaultProfile, FaultyBackend
 from nhd_tpu.sim.synth import SynthNodeSpec, make_node_labels, make_triad_config
+
+# one chaos step advances the sim clock this much (the controller's
+# TriadSet cadence and, in HA mode, lease expiry both run off it)
+STEP_SEC = 10.0
 
 
 @dataclass
@@ -41,7 +62,44 @@ class ChaosStats:
     restarts: int = 0
     group_moves: int = 0
     silent_deletes: int = 0
+    # HA mode: lease epoch high-water mark (== total acquisitions) and
+    # the longest stretch of steps with no replica believing it leads
+    lease_epoch: int = 0
+    max_leader_gap: int = 0
     violations: List[str] = field(default_factory=list)
+
+
+class _Replica:
+    """One complete scheduler replica: elector + scheduler + controller,
+    with its own watch queue — what one pod of the 2-replica Deployment
+    recipe runs (docs/OPERATIONS.md)."""
+
+    def __init__(self, sim: "ChaosSim", ident: str):
+        self.ident = ident
+        # per-replica counters: two replicas in one process must not
+        # fight over the process-wide ha_is_leader/ha_epoch gauges
+        self.elector = LeaderElector(
+            sim.backend, identity=ident, ttl=sim.lease_ttl,
+            clock=sim.sim_clock, counters=ApiCounters(),
+        )
+        self.sched = Scheduler(
+            sim.backend, WatchQueue(), queue.Queue(),
+            respect_busy=False, elector=self.elector,
+        )
+        self.controller = Controller(
+            sim.backend, self.sched.nqueue,
+            isolate_events=sim.hardened, elector=self.elector,
+        )
+        self.sched.build_initial_node_list()
+        self.sched.load_deployed_configs()
+
+    def is_true_leader(self, sim: "ChaosSim") -> bool:
+        """Believes it leads AND the lease agrees (not a stale believer)."""
+        epoch = self.elector.fencing_epoch()
+        if epoch is None:
+            return False
+        view = sim.backend.lease_read(LEASE_NAME)
+        return view is not None and view.epoch == epoch
 
 
 class ChaosSim:
@@ -51,6 +109,8 @@ class ChaosSim:
     the cluster churn; ``hardened=False`` strips the controller's
     per-event isolation, restoring the reference's crash-only stance so
     tests can demonstrate that the same storm kills an unhardened stack.
+    ``ha=True`` runs TWO replicas against the shared backend under
+    leader election (split-brain mode; see the module docstring).
     """
 
     def __init__(
@@ -60,10 +120,18 @@ class ChaosSim:
         *,
         api_faults: Optional[FaultProfile] = None,
         hardened: bool = True,
+        ha: bool = False,
+        lease_ttl: float = 3 * STEP_SEC,
     ):
         self.rng = random.Random(seed)
         self.hardened = hardened
+        self.ha = ha
+        self.lease_ttl = lease_ttl
+        self._now = 0.0
         base = FakeClusterBackend()
+        # lease expiry runs off the sim's step clock, not wall time —
+        # a failing seed replays exactly
+        base.clock = self.sim_clock
         if api_faults is not None:
             # the fault RNG is its own seeded stream: fault timing stays
             # reproducible without perturbing the churn sequence
@@ -79,7 +147,16 @@ class ChaosSim:
             )
         self.stats = ChaosStats()
         self._pod_seq = 0
-        self._fresh_scheduler()
+        self._leader_gap = 0
+        if self.ha:
+            self.replicas = [
+                _Replica(self, "sched-a"), _Replica(self, "sched-b")
+            ]
+        else:
+            self._fresh_scheduler()
+
+    def sim_clock(self) -> float:
+        return self._now
 
     def _fresh_scheduler(self) -> None:
         self.sched = Scheduler(
@@ -179,15 +256,109 @@ class ChaosSim:
             self.backend.fail_bind_for.add((victim.namespace, victim.name))
             self.stats.bind_failures += 1
 
+    # -- restart + state-equivalence ------------------------------------
+
+    def _claims_map(self, sched: Scheduler) -> Dict[Tuple[str, str], str]:
+        return {
+            (ns, pod): name
+            for name, node in sched.nodes.items()
+            for (pod, ns) in node.pod_info
+        }
+
+    def _mirror_snapshot(self, sched: Scheduler) -> Dict[str, tuple]:
+        """Per-node resource accounting, for claim-replay equivalence:
+        which pods, how many hugepages free, how many non-reserved cores
+        in use."""
+        out = {}
+        for name, node in sched.nodes.items():
+            used = sum(
+                1 for c in node.cores
+                if c.used and c.core not in node.reserved_cores
+            )
+            out[name] = (
+                frozenset((ns, pod) for (pod, ns) in node.pod_info),
+                node.mem.free_hugepages_gb,
+                used,
+            )
+        return out
+
+    def _backend_bound(self) -> Dict[Tuple[str, str], str]:
+        return {
+            (p.namespace, p.name): p.node
+            for p in self.backend.pods.values() if p.node
+        }
+
+    def _check_restart_equivalence(
+        self,
+        pre_claims: Optional[Dict[Tuple[str, str], str]],
+        pre_snapshot: Optional[Dict[str, tuple]],
+        sched: Scheduler,
+    ) -> None:
+        """A restarted replica's replay must reconstruct the SAME state,
+        not merely an invariant-satisfying one: its claims equal the
+        cluster's bound set, and — when the pre-restart mirror was itself
+        current — the full per-node accounting matches too (pods that
+        silently vanished from the cluster are excluded: the old mirror
+        legitimately still carries them until the reconcile net runs)."""
+        expected = self._backend_bound()
+        post = self._claims_map(sched)
+        if post != expected:
+            self.stats.violations.append(
+                f"step {self.stats.steps}: restart replay diverged from "
+                f"cluster (replayed {sorted(post)} != bound "
+                f"{sorted(expected)})"
+            )
+            return
+        if pre_claims is None:
+            return
+        filtered = {k: v for k, v in pre_claims.items() if k in expected}
+        if filtered != post:
+            self.stats.violations.append(
+                f"step {self.stats.steps}: post-restart claims differ "
+                f"from pre-restart claims ({sorted(filtered)} -> "
+                f"{sorted(post)})"
+            )
+        elif pre_claims == expected and pre_snapshot is not None:
+            if self._mirror_snapshot(sched) != pre_snapshot:
+                self.stats.violations.append(
+                    f"step {self.stats.steps}: post-restart resource "
+                    "accounting differs from pre-restart accounting"
+                )
+
     def _act_restart(self) -> None:
-        """Scheduler crash + restart: state must replay from annotations."""
-        self._fresh_scheduler()
+        """Scheduler crash + restart: state must replay from annotations
+        to EQUIVALENT claims (not just invariant-clean ones)."""
+        if self.ha:
+            idx = self.rng.randrange(len(self.replicas))
+            old = self.replicas[idx]
+            # the pre-restart mirror is only a sound comparison baseline
+            # when this replica was the TRUE leader (a stale believer's
+            # mirror legitimately lags the cluster)
+            sound = old.is_true_leader(self)
+            pre_claims = self._claims_map(old.sched) if sound else None
+            pre_snap = self._mirror_snapshot(old.sched) if sound else None
+            self.replicas[idx] = _Replica(self, old.ident)
+            self._check_restart_equivalence(
+                pre_claims, pre_snap, self.replicas[idx].sched
+            )
+        else:
+            pre_claims = self._claims_map(self.sched)
+            pre_snap = self._mirror_snapshot(self.sched)
+            self._fresh_scheduler()
+            self._check_restart_equivalence(pre_claims, pre_snap, self.sched)
         self.stats.restarts += 1
 
     # ------------------------------------------------------------------
 
     def step(self) -> None:
         self.stats.steps += 1
+        self._now += STEP_SEC
+        if self.ha:
+            # jittered tick order: sometimes a standby acquires an
+            # expired lease BEFORE the stale leader's tick notices —
+            # the split-brain overlap fencing exists for
+            for r in self.rng.sample(self.replicas, len(self.replicas)):
+                r.elector.tick()
         action = self.rng.choices(
             [self._act_create, self._act_delete, self._act_cordon,
              self._act_maintenance, self._act_bind_failure, self._act_restart,
@@ -195,21 +366,76 @@ class ChaosSim:
             weights=[40, 15, 10, 10, 10, 5, 8, 8],
         )[0]
         action()
-        # let the control plane catch up
-        self.controller.run_once(now=float(self.stats.steps * 10))
-        for _ in range(8):
-            if self.sched.nqueue.empty():
-                break
-            self.sched.run_once()
-        self.sched.check_pending_pods()
+        self._drive_control_plane()
         # clear one-shot bind failures so pods eventually land
         self.backend.fail_bind_for.clear()
+        if self.ha:
+            self._track_leadership()
         self.check_invariants()
 
-    def check_invariants(self) -> None:
-        """Conservation laws that must hold after every step."""
+    def _drive_control_plane(self, extra_drain: bool = False) -> None:
+        """Let the control plane catch up on this step's churn."""
+        if not self.ha:
+            self.controller.run_once(now=self._now)
+            for _ in range(8):
+                if self.sched.nqueue.empty():
+                    break
+                self.sched.run_once()
+            self.sched.check_pending_pods()
+            if extra_drain:
+                # drain requeues raised by the reconcile pass itself
+                while not self.sched.nqueue.empty():
+                    self.sched.run_once()
+            return
+        # HA: every believer translates nothing — watch events are a
+        # single drained stream on the fake backend, so ONE believer
+        # (rng-picked under split-brain) polls them, like one replica
+        # owning a watch connection; the others' periodic scans repair
+        # whatever they never saw
+        believers = [r for r in self.replicas if r.elector.is_leader]
+        if believers:
+            self.rng.choice(believers).controller.run_once(now=self._now)
+        for r in self.replicas:
+            acting = r.sched.poll_leadership()
+            for _ in range(8):
+                if r.sched.nqueue.empty():
+                    break
+                r.sched.run_once()
+            if acting:
+                r.sched.check_pending_pods()
+                if extra_drain:
+                    while not r.sched.nqueue.empty():
+                        r.sched.run_once()
+
+    def _track_leadership(self) -> None:
+        """The bounded-leadership-gap invariant: the cluster must never
+        be headless for longer than lease expiry plus a few ticks (a
+        fault can delay an election, but not indefinitely)."""
+        if any(r.elector.is_leader for r in self.replicas):
+            self._leader_gap = 0
+        else:
+            self._leader_gap += 1
+            self.stats.max_leader_gap = max(
+                self.stats.max_leader_gap, self._leader_gap
+            )
+            bound = int(self.lease_ttl / STEP_SEC) + 8
+            if self._leader_gap > bound:
+                self.stats.violations.append(
+                    f"step {self.stats.steps}: no leader for "
+                    f"{self._leader_gap} steps (bound {bound})"
+                )
+        view = self.backend.lease_read(LEASE_NAME)
+        if view is not None:
+            self.stats.lease_epoch = view.epoch
+
+    # ------------------------------------------------------------------
+    # invariants
+    # ------------------------------------------------------------------
+
+    def _check_scheduler_invariants(self, sched: Scheduler) -> None:
+        """Conservation laws for one scheduler's mirror."""
         v = self.stats.violations
-        for name, node in self.sched.nodes.items():
+        for name, node in sched.nodes.items():
             if node.mem.free_hugepages_gb < 0:
                 v.append(f"step {self.stats.steps}: {name} negative hugepages")
             for nic in node.nics:
@@ -231,25 +457,46 @@ class ChaosSim:
                 )
 
         # backend and mirror agree on placements
-        bound = {
-            (p.namespace, p.name): p.node
-            for p in self.backend.pods.values() if p.node
-        }
-        mirrored = {
-            (ns, pod): name
-            for name, node in self.sched.nodes.items()
-            for (pod, ns) in node.pod_info
-        }
-        for key, node_name in mirrored.items():
+        bound = self._backend_bound()
+        for key, node_name in self._claims_map(sched).items():
             if key not in bound:
                 # a vanished pod is released only after missing on two
                 # consecutive scans (reconcile_deleted_pods); a claim in
                 # the suspect set is awaiting its confirmation, not leaked
-                if key in self.sched._missing_once:
+                if key in sched._missing_once:
                     continue
                 v.append(f"step {self.stats.steps}: mirror has unbound {key}")
             elif bound[key] != node_name:
                 v.append(f"step {self.stats.steps}: {key} mirror/backend differ")
+
+    def check_invariants(self) -> None:
+        """Conservation laws that must hold after every step."""
+        if self.ha:
+            # a stale believer's mirror legitimately lags (its writes are
+            # fenced off; its view repairs at the next promotion replay) —
+            # the TRUE leader's mirror is the one that must agree with the
+            # cluster
+            for r in self.replicas:
+                if r.is_true_leader(self):
+                    self._check_scheduler_invariants(r.sched)
+        else:
+            self._check_scheduler_invariants(self.sched)
+        self._check_single_epoch_binds()
+
+    def _check_single_epoch_binds(self) -> None:
+        """The split-brain acceptance invariant: every pod incarnation is
+        bound by AT MOST one leadership. Two successful binds for one uid
+        — same epoch or different — mean a deposed leader's write landed
+        past the fence."""
+        per_uid: Dict[str, List] = {}
+        for ns, pod, uid, node, epoch in self.backend.bind_log:
+            per_uid.setdefault(uid, []).append((ns, pod, node, epoch))
+        for uid, binds in per_uid.items():
+            if len(binds) > 1:
+                self.stats.violations.append(
+                    f"step {self.stats.steps}: pod uid {uid} bound "
+                    f"{len(binds)} times: {binds}"
+                )
 
     def run(self, steps: int) -> ChaosStats:
         for _ in range(steps):
@@ -267,19 +514,19 @@ class ChaosSim:
         This is the crash-only recovery claim made testable: after the
         fault storm ends, the retry/requeue/reconcile nets must converge
         the cluster — every invariant holds and nothing stays stranded
-        because of an API fault (``stuck_pods()`` empty)."""
+        because of an API fault (``stuck_pods()`` empty). In HA mode the
+        election must also converge: one replica ends up leading and its
+        scans place whatever the churn left pending."""
         if isinstance(self.backend, FaultyBackend):
             self.backend.enabled = False
-        for i in range(rounds):
-            self.controller.run_once(
-                now=float((self.stats.steps + i + 1) * 10)
-            )
-            while not self.sched.nqueue.empty():
-                self.sched.run_once()
-            self.sched.check_pending_pods()
-            # drain requeues raised by the reconcile pass itself
-            while not self.sched.nqueue.empty():
-                self.sched.run_once()
+        for _ in range(rounds):
+            self._now += STEP_SEC
+            if self.ha:
+                for r in self.rng.sample(self.replicas, len(self.replicas)):
+                    r.elector.tick()
+            self._drive_control_plane(extra_drain=True)
+            if self.ha:
+                self._track_leadership()
             self.check_invariants()
         return self.unplaced_pods()
 

@@ -30,6 +30,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from nhd_tpu.k8s.interface import (
     ClusterBackend,
     EventType,
+    LeaseView,
     TransientBackendError,
     WatchEvent,
 )
@@ -47,6 +48,13 @@ class FaultProfile:
     poison_watch_event: float = 0.0    # inject a malformed event per poll
     transient_bind: float = 0.0        # bind raises TransientBackendError
     transient_annotate: float = 0.0    # annotate raises TransientBackendError
+    # lease-level (FaultyBackend; leader-election storms, k8s/lease.py)
+    lease_renew_error: float = 0.0     # renew raises TransientBackendError
+    #                                    (API unreachable: grace, then demote)
+    lease_renew_conflict: float = 0.0  # renew returns False (CAS lost:
+    #                                    demote immediately)
+    lease_acquire_error: float = 0.0   # acquire raises TransientBackendError
+    #                                    (follower stays follower this tick)
     # HTTP-level (FaultyHttpClient)
     http_error: float = 0.0            # injected HTTP error status
     http_statuses: Tuple[int, ...] = (500, 503, 429)
@@ -72,6 +80,18 @@ PROFILES: Dict[str, FaultProfile] = {
     "heavy": FaultProfile(
         name="heavy", drop_watch_event=0.30, transient_bind=0.40,
         transient_annotate=0.30, poison_watch_event=0.25,
+    ),
+    # split-brain storms (ChaosSim ha=True, `make ha-chaos`): lease
+    # renewal faults force leadership churn — ha-storm adds the API-layer
+    # faults on top so fencing is exercised mid-outage
+    "ha-light": FaultProfile(
+        name="ha-light", lease_renew_error=0.25, lease_acquire_error=0.10,
+    ),
+    "ha-storm": FaultProfile(
+        name="ha-storm", lease_renew_error=0.40, lease_renew_conflict=0.10,
+        lease_acquire_error=0.15, drop_watch_event=0.10,
+        transient_bind=0.15, transient_annotate=0.10,
+        poison_watch_event=0.05,
     ),
 }
 
@@ -226,6 +246,8 @@ class FaultyBackend(ClusterBackend):
         self.fault_stats: Dict[str, int] = {
             "dropped_events": 0, "poisoned_events": 0,
             "transient_binds": 0, "transient_annotates": 0,
+            "lease_renew_errors": 0, "lease_renew_conflicts": 0,
+            "lease_acquire_errors": 0,
         }
         self._bind_faulted: set = set()
         self._annotate_faulted: set = set()
@@ -285,12 +307,16 @@ class FaultyBackend(ClusterBackend):
     def get_cfg_map(self, pod: str, ns: str):
         return self.inner.get_cfg_map(pod, ns)
 
-    # ---- writes (fault points) ----
+    # ---- writes (fault points; the fencing epoch passes through) ----
 
-    def add_nad_to_pod(self, pod: str, ns: str, nad: str) -> bool:
-        return self.inner.add_nad_to_pod(pod, ns, nad)
+    def add_nad_to_pod(
+        self, pod: str, ns: str, nad: str, *, epoch=None
+    ) -> bool:
+        return self.inner.add_nad_to_pod(pod, ns, nad, epoch=epoch)
 
-    def annotate_pod_config(self, ns: str, pod: str, cfg: str) -> bool:
+    def annotate_pod_config(
+        self, ns: str, pod: str, cfg: str, *, epoch=None
+    ) -> bool:
         key = (ns, pod)
         if key not in self._annotate_faulted and self._roll(
             self.profile.transient_annotate
@@ -300,12 +326,16 @@ class FaultyBackend(ClusterBackend):
             raise TransientBackendError(
                 f"injected transient annotate failure for {ns}/{pod}"
             )
-        return self.inner.annotate_pod_config(ns, pod, cfg)
+        return self.inner.annotate_pod_config(ns, pod, cfg, epoch=epoch)
 
-    def annotate_pod_gpu_map(self, ns: str, pod: str, gpu_map: Dict[str, int]) -> bool:
-        return self.inner.annotate_pod_gpu_map(ns, pod, gpu_map)
+    def annotate_pod_gpu_map(
+        self, ns: str, pod: str, gpu_map: Dict[str, int], *, epoch=None
+    ) -> bool:
+        return self.inner.annotate_pod_gpu_map(ns, pod, gpu_map, epoch=epoch)
 
-    def bind_pod_to_node(self, pod: str, node: str, ns: str) -> bool:
+    def bind_pod_to_node(
+        self, pod: str, node: str, ns: str, *, epoch=None
+    ) -> bool:
         key = (ns, pod)
         if key not in self._bind_faulted and self._roll(
             self.profile.transient_bind
@@ -315,7 +345,7 @@ class FaultyBackend(ClusterBackend):
             raise TransientBackendError(
                 f"injected transient bind failure for {ns}/{pod}"
             )
-        return self.inner.bind_pod_to_node(pod, node, ns)
+        return self.inner.bind_pod_to_node(pod, node, ns, epoch=epoch)
 
     def generate_pod_event(
         self, pod: str, ns: str, reason: str, event_type: EventType,
@@ -346,6 +376,38 @@ class FaultyBackend(ClusterBackend):
                 taints=None, old_taints=None,          # type: ignore[arg-type]
             ))
         return out
+
+    # ---- coordination leases (fault points; k8s/lease.py) ----
+    #
+    # Renewal faults are NOT once-per-key like the bind/annotate ones:
+    # leadership flapping is the behavior under test, and the elector's
+    # grace/expiry logic (not a converged end state per pod) bounds it.
+
+    def lease_try_acquire(self, name: str, holder: str, ttl: float) -> LeaseView:
+        if self._roll(self.profile.lease_acquire_error):
+            self.fault_stats["lease_acquire_errors"] += 1
+            raise TransientBackendError(
+                f"injected lease acquire failure for {holder}"
+            )
+        return self.inner.lease_try_acquire(name, holder, ttl)
+
+    def lease_renew(self, name: str, holder: str, epoch: int, ttl: float) -> bool:
+        if self._roll(self.profile.lease_renew_error):
+            self.fault_stats["lease_renew_errors"] += 1
+            raise TransientBackendError(
+                f"injected lease renew failure for {holder}"
+            )
+        if self._roll(self.profile.lease_renew_conflict):
+            # as if the CAS lost: the holder must step down immediately
+            self.fault_stats["lease_renew_conflicts"] += 1
+            return False
+        return self.inner.lease_renew(name, holder, epoch, ttl)
+
+    def lease_release(self, name: str, holder: str, epoch: int) -> bool:
+        return self.inner.lease_release(name, holder, epoch)
+
+    def lease_read(self, name: str):
+        return self.inner.lease_read(name)
 
     # ---- TriadSets (pass-through) ----
 

@@ -1,0 +1,12 @@
+"""Outside nhd_tpu/scheduler/ the fencing pack stays silent: backends,
+sims and tests call the raw mutators legitimately (the fake backend IS
+the mutator; chaos drives it directly)."""
+
+
+class SimDriver:
+    def __init__(self, backend):
+        self.backend = backend
+
+    def force_bind(self, pod, ns, node):
+        # raw mutator call, but this file is not scheduler-scoped
+        return self.backend.bind_pod_to_node(pod, node, ns)

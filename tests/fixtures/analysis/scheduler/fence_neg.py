@@ -1,0 +1,30 @@
+"""NHD501 negatives: the sanctioned fenced-commit shapes stay clean."""
+
+
+class FencedScheduler:
+    def __init__(self, backend, elector=None):
+        self.backend = backend
+        self.elector = elector
+
+    def _fence_epoch(self):
+        return None if self.elector is None else self.elector.fencing_epoch()
+
+    def _commit_write(self, fn, *args):
+        # THE chokepoint: direct mutator calls are allowed only here
+        epoch = self._fence_epoch()
+        if epoch is None:
+            return fn(*args)
+        return fn(*args, epoch=epoch)
+
+    def commit(self, pod, ns, node, cfg):
+        # bound-method ARGUMENTS are not call expressions — sanctioned
+        ok = self._commit_write(self.backend.annotate_pod_config, ns, pod, cfg)
+        if not ok:
+            return False
+        return self._commit_write(self.backend.bind_pod_to_node, pod, node, ns)
+
+    def observe(self, pod, ns):
+        # reads and the idempotent audit trail are out of the rule's scope
+        self.backend.generate_pod_event(pod, ns, "Scheduling", None, "msg")
+        self.backend.pod_exists(pod, ns)
+        return self.backend.get_pod_node(pod, ns)

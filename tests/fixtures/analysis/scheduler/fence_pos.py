@@ -1,0 +1,31 @@
+"""NHD501 positives: raw commit-path mutators in scheduler-scoped code.
+
+Each flagged line calls one of the four fenced mutators directly on a
+``*.backend`` attribute outside the ``_commit_write`` helper — the hole
+a deposed leader's in-flight batch could land through.
+"""
+
+
+class LeakyScheduler:
+    def __init__(self, backend):
+        self.backend = backend
+
+    def commit(self, pod, ns, node, cfg, gpu_map, nad):
+        self.backend.add_nad_to_pod(pod, ns, nad)            # EXPECT[NHD501]
+        self.backend.annotate_pod_gpu_map(ns, pod, gpu_map)  # EXPECT[NHD501]
+        self.backend.annotate_pod_config(ns, pod, cfg)       # EXPECT[NHD501]
+        return self.backend.bind_pod_to_node(pod, node, ns)  # EXPECT[NHD501]
+
+    def helper_named_wrong(self, pod, ns, node):
+        # a helper by any other name is not THE fenced chokepoint
+        return self.backend.bind_pod_to_node(pod, node, ns)  # EXPECT[NHD501]
+
+
+def free_function(sched, pod, ns, node):
+    # module-level code in scheduler scope is just as unfenced
+    return sched.backend.bind_pod_to_node(pod, node, ns)     # EXPECT[NHD501]
+
+
+def bare_backend_param(backend, pod, ns, node):
+    # a helper taking the backend directly must not evade the rule
+    return backend.bind_pod_to_node(pod, node, ns)           # EXPECT[NHD501]

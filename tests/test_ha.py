@@ -1,0 +1,510 @@
+"""HA layer (k8s/lease.py + the fenced commit path): leader election with
+an injected clock (the tests/test_retry.py pattern — zero real waiting),
+fenced-commit stale-epoch rejection incl. an epoch bumped mid-commit,
+the stall watchdog, standby→promotion replay equivalence, restart
+state equivalence, and the split-brain chaos matrix (two schedulers, one
+cluster, lease faults on)."""
+
+import queue
+
+import pytest
+
+from nhd_tpu.k8s.fake import FakeClusterBackend
+from nhd_tpu.k8s.interface import (
+    CFG_ANNOTATION,
+    LEASE_NAME,
+    StaleLeaseError,
+)
+from nhd_tpu.k8s.lease import LeaderElector, StallWatchdog
+from nhd_tpu.k8s.retry import API_COUNTERS, ApiCounters
+from nhd_tpu.rpc.metrics import render_metrics
+from nhd_tpu.scheduler.core import PodStatus, Scheduler
+from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
+from nhd_tpu.sim.chaos import ChaosSim
+from nhd_tpu.sim.faults import PROFILES, FaultProfile, FaultyBackend
+from nhd_tpu.sim.synth import SynthNodeSpec, make_node_labels, make_triad_config
+
+
+class StepClock:
+    """Injected clock shared by backend + electors (no real sleeps)."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def _cluster(n_nodes=2):
+    clock = StepClock()
+    backend = FakeClusterBackend()
+    backend.clock = clock
+    for i in range(n_nodes):
+        spec = SynthNodeSpec(name=f"node{i}")
+        backend.add_node(
+            spec.name, make_node_labels(spec), hugepages_gb=spec.hugepages_gb
+        )
+    return backend, clock
+
+
+def _elector(backend, clock, ident, ttl=30.0):
+    return LeaderElector(
+        backend, identity=ident, ttl=ttl, clock=clock, counters=ApiCounters()
+    )
+
+
+def _scheduler(backend, elector=None):
+    sched = Scheduler(
+        backend, WatchQueue(), queue.Queue(), respect_busy=False,
+        elector=elector,
+    )
+    sched.build_initial_node_list()
+    sched.load_deployed_configs()
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# election (acquire / renew / step-down / expiry, injected clock)
+# ---------------------------------------------------------------------------
+
+
+def test_first_tick_acquires_with_epoch_one():
+    backend, clock = _cluster(0)
+    a = _elector(backend, clock, "a")
+    assert a.tick() is True
+    assert a.is_leader and a.epoch == 1
+    assert a.fencing_epoch() == 1
+    view = backend.lease_read(LEASE_NAME)
+    assert view.holder == "a" and view.epoch == 1
+
+
+def test_follower_stays_follower_while_lease_live():
+    backend, clock = _cluster(0)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    assert b.tick() is False
+    assert b.fencing_epoch() is None
+
+
+def test_renew_extends_and_keeps_epoch():
+    backend, clock = _cluster(0)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    for _ in range(5):
+        clock.advance(20)        # ttl is 30: renewals must keep it alive
+        assert a.tick() is True
+        assert b.tick() is False
+    assert a.epoch == 1          # renewals never bump the fencing token
+
+
+def test_expired_lease_hands_over_with_higher_epoch():
+    backend, clock = _cluster(0)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    clock.advance(31)            # a never renews: expiry
+    assert b.tick() is True
+    assert b.epoch == 2          # acquisition bumped the token
+    assert a.tick() is False     # a's renew CAS fails: demoted
+
+
+def test_step_down_hands_over_without_waiting_out_ttl():
+    backend, clock = _cluster(0)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    a.step_down()
+    assert not a.is_leader
+    assert b.tick() is True      # no clock advance needed
+    assert b.epoch == 2
+
+
+def test_renew_error_tolerated_within_grace_then_demotes():
+    backend, clock = _cluster(0)
+    faulty = FaultyBackend(
+        backend, FaultProfile(name="t", lease_renew_error=1.0)
+    )
+    a = LeaderElector(
+        faulty, identity="a", ttl=30.0, clock=clock, counters=ApiCounters()
+    )
+    a.tick()
+    clock.advance(10)
+    assert a.tick() is True      # renew errored, but grace holds
+    clock.advance(25)            # 35s since the last SUCCESSFUL renewal
+    assert a.tick() is False     # grace spent: voluntary demotion
+    # and leadership is reacquirable once the fault clears
+    faulty.enabled = False
+    clock.advance(1)
+    assert a.tick() is True and a.epoch == 2
+
+
+def test_renew_conflict_demotes_immediately():
+    backend, clock = _cluster(0)
+    faulty = FaultyBackend(
+        backend, FaultProfile(name="t", lease_renew_conflict=1.0)
+    )
+    a = LeaderElector(
+        faulty, identity="a", ttl=30.0, clock=clock, counters=ApiCounters()
+    )
+    a.tick()
+    assert a.tick() is False     # CAS lost: no grace applies
+
+
+def test_reacquire_after_restart_gets_fresh_epoch():
+    """A replica that crashed while leading and came back under the same
+    identity must NOT resume the old epoch: its pre-crash in-flight
+    writes have to be fenceable against its own new leadership."""
+    backend, clock = _cluster(0)
+    a = _elector(backend, clock, "a")
+    a.tick()
+    a2 = _elector(backend, clock, "a")     # the restarted incarnation
+    assert a2.tick() is True
+    assert a2.epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# fencing at the backend seam
+# ---------------------------------------------------------------------------
+
+
+def test_stale_epoch_write_rejected_atomically():
+    backend, clock = _cluster(1)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    clock.advance(31)
+    b.tick()                     # epoch 2 now rules
+    with pytest.raises(StaleLeaseError):
+        backend.bind_pod_to_node("p1", "node0", "default", epoch=1)
+    with pytest.raises(StaleLeaseError):
+        backend.annotate_pod_config("default", "p1", "cfg", epoch=1)
+    with pytest.raises(StaleLeaseError):
+        backend.annotate_pod_gpu_map("default", "p1", {"nvidia0": 0}, epoch=1)
+    with pytest.raises(StaleLeaseError):
+        backend.add_nad_to_pod("p1", "default", "n@n", epoch=1)
+    assert backend.pods[("default", "p1")].node is None
+    assert backend.bind_log == []
+    # the live epoch still lands
+    assert backend.bind_pod_to_node("p1", "node0", "default", epoch=2)
+    assert backend.bind_log[0][4] == 2
+
+
+def test_deposed_leader_batch_rejected_mid_commit():
+    """THE split-brain acceptance case: the epoch is bumped between a
+    batch's annotate and its bind — the deposed leader's bind must be
+    rejected by the backend and the pod must take the requeue path
+    (unwound claim, no terminal failure), never land."""
+    backend, clock = _cluster(2)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    sched = _scheduler(backend, elector=a)
+    assert sched.poll_leadership() is True
+    backend.create_pod("p1", cfg_text=make_triad_config())
+
+    orig = backend.annotate_pod_config
+
+    def bump_after_annotate(ns, pod, cfg, *, epoch=None):
+        ok = orig(ns, pod, cfg, epoch=epoch)
+        clock.advance(31)        # a's lease expires mid-commit...
+        b.tick()                 # ...and b acquires epoch 2
+        return ok
+
+    backend.annotate_pod_config = bump_after_annotate
+    before = API_COUNTERS.get("ha_stale_writes_rejected_total")
+    sched.check_pending_pods()
+    backend.annotate_pod_config = orig
+
+    pod = backend.pods[("default", "p1")]
+    assert pod.node is None                      # the bind never landed
+    assert backend.bind_log == []                # provably rejected
+    assert API_COUNTERS.get("ha_stale_writes_rejected_total") > before
+    # requeue path, not terminal failure: state popped, claim unwound,
+    # pod back on the queue for the NEW leader's tenure
+    assert sched.pod_state.get(("default", "p1")) is None
+    assert sched.failed_schedule_count == 0
+    assert not sched.nqueue.empty()
+    assert all(not n.pod_info for n in sched.nodes.values())
+
+
+def test_locally_known_deposition_spends_no_api_calls():
+    """A replica that already KNOWS it lost the lease fails the commit
+    locally (fencing_epoch is None -> StaleLeaseError before any backend
+    write)."""
+    backend, clock = _cluster(2)
+    a = _elector(backend, clock, "a")
+    a.tick()
+    sched = _scheduler(backend, elector=a)
+    sched.poll_leadership()
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    a.step_down()                # demoted, but _acting not yet synced
+    sched.check_pending_pods()
+    assert backend.pods[("default", "p1")].node is None
+    assert backend.bind_log == []
+
+
+# ---------------------------------------------------------------------------
+# standby / promotion replay
+# ---------------------------------------------------------------------------
+
+
+def _claims(sched):
+    return {
+        (ns, pod): name
+        for name, node in sched.nodes.items()
+        for (pod, ns) in node.pod_info
+    }
+
+
+def test_standby_watches_but_does_not_act_until_elected():
+    backend, clock = _cluster(2)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    leader = _scheduler(backend, elector=a)
+    assert leader.poll_leadership() is True
+    standby = _scheduler(backend, elector=b)
+    assert standby.poll_leadership() is False
+
+    # leader binds the workload
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    backend.create_pod("p2", cfg_text=make_triad_config())
+    leader.check_pending_pods()
+    leader_claims = _claims(leader)
+    assert len(leader_claims) == 2
+
+    # a pod event reaching the STANDBY is not acted on
+    backend.create_pod("p3", cfg_text=make_triad_config(), emit_watch=False)
+    standby.nqueue.put(WatchItem(
+        WatchType.TRIAD_POD_CREATE,
+        pod={"ns": "default", "name": "p3", "uid": "u3", "cfg": "", "node": ""},
+    ))
+    standby.run_once()
+    assert backend.pods[("default", "p3")].node is None
+
+    # but a node event keeps the standby's mirror warm
+    standby.nqueue.put(WatchItem(WatchType.NODE_CORDON, node="node0"))
+    standby.run_once()
+    assert standby.nodes["node0"].active is False
+    backend.cordon_node("node0", False)
+    standby.nqueue.put(WatchItem(WatchType.NODE_UNCORDON, node="node0"))
+    standby.run_once()
+
+    # watchdog-style demotion -> standby promotion: the promoted replica
+    # replays annotations to the SAME claim state, then schedules what
+    # the old leader left pending
+    a.step_down()
+    assert b.tick() is True
+    assert standby.poll_leadership() is True
+    promoted_claims = _claims(standby)
+    assert {
+        k: v for k, v in promoted_claims.items() if k != ("default", "p3")
+    } == leader_claims
+    assert backend.pods[("default", "p3")].node is not None  # scan caught it
+    # resource accounting equivalence on the shared claims
+    for name in leader.nodes:
+        assert (
+            standby.nodes[name].mem.free_hugepages_gb
+            <= leader.nodes[name].mem.free_hugepages_gb
+        )
+
+
+def test_failed_promotion_replay_releases_the_lease():
+    """Promotion keeps the crash-only contract: a replica whose replay
+    fails (API outage mid-promotion) must NOT lead with an empty or
+    partial mirror — it releases the lease so a healthy replica can win,
+    instead of holding it with a live-but-stateless loop the watchdog
+    would never catch."""
+    from nhd_tpu.k8s.interface import TransientBackendError
+
+    backend, clock = _cluster(2)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    sched = _scheduler(backend, elector=a)
+
+    real_get_nodes = backend.get_nodes
+    backend.get_nodes = lambda: (_ for _ in ()).throw(
+        TransientBackendError("outage mid-promotion")
+    )
+    assert sched.poll_leadership() is False   # replay failed: stepped down
+    assert a.is_leader is False
+    assert sched._acting is False
+    backend.get_nodes = real_get_nodes
+
+    # the healthy standby wins and schedules; the failed replica can
+    # also recover on a later, successful promotion
+    assert b.tick() is True and b.epoch == 2
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    other = _scheduler(backend, elector=b)
+    assert other.poll_leadership() is True
+    assert backend.pods[("default", "p1")].node is not None
+
+
+def test_demoted_leader_stops_scanning():
+    backend, clock = _cluster(2)
+    a = _elector(backend, clock, "a")
+    a.tick()
+    sched = _scheduler(backend, elector=a)
+    sched.poll_leadership()
+    a.step_down()
+    assert sched.poll_leadership() is False
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    # idle path reaching the periodic-scan threshold must not scan
+    from nhd_tpu.scheduler.core import IDLE_CNT_THRESH
+
+    idle = sched.run_once(idle_count=IDLE_CNT_THRESH - 1)
+    assert idle == 0
+    assert backend.pods[("default", "p1")].node is None
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_on_wedged_loop_and_releases_lease():
+    backend, clock = _cluster(0)
+    a, b = _elector(backend, clock, "a"), _elector(backend, clock, "b")
+    a.tick()
+    exits = []
+    beat = [0.0]
+    wd = StallWatchdog(
+        lambda: beat[0], stall_after=120.0, elector=a,
+        exit_fn=exits.append, clock=clock, counters=ApiCounters(),
+    )
+    clock.advance(100)
+    assert wd.check() is False        # within budget
+    beat[0] = 100.0                   # a healthy heartbeat resets the age
+    clock.advance(100)
+    assert wd.check() is False
+    clock.advance(121)                # loop wedged: no beat for 121s
+    assert wd.check() is True
+    assert exits == [2]               # crash-only exit requested
+    assert not a.is_leader            # lease released...
+    assert b.tick() is True           # ...so the standby takes over NOW
+    assert b.epoch == 2
+    assert wd.check() is True and exits == [2]   # fires once
+
+
+def test_watchdog_quiet_on_healthy_loop():
+    backend, clock = _cluster(0)
+    exits = []
+    wd = StallWatchdog(
+        clock, stall_after=10.0, exit_fn=exits.append, clock=clock,
+        counters=ApiCounters(),
+    )
+    for _ in range(5):
+        clock.advance(5)
+        assert wd.check() is False
+    assert exits == []
+
+
+# ---------------------------------------------------------------------------
+# restart state equivalence (the ChaosSim.stats.restarts fix, pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_restart_replay_reconstructs_equivalent_state():
+    sim = ChaosSim(seed=3, n_nodes=3)
+    sim.run(steps=30)
+    sim._act_restart()               # force one regardless of the dice
+    assert sim.stats.restarts >= 1
+    assert sim.stats.violations == []
+
+
+def test_restart_equivalence_detects_divergence():
+    """The equivalence check must actually bite: corrupt one bound pod's
+    solved-config annotation and the replayed state no longer matches
+    the cluster."""
+    sim = ChaosSim(seed=0, n_nodes=3)
+    for _ in range(6):
+        sim._act_create()
+    sim._drive_control_plane()
+    bound = [p for p in sim.backend.pods.values() if p.node]
+    assert bound
+    bound[0].annotations[CFG_ANNOTATION] = "garbage {"
+    sim._act_restart()
+    assert any("restart replay diverged" in v for v in sim.stats.violations)
+
+
+# ---------------------------------------------------------------------------
+# split-brain chaos: two schedulers, one cluster, lease faults on
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_split_brain_chaos_storm(seed):
+    """The acceptance matrix cell: lease-renewal faults force leadership
+    churn across two replicas; the run must end with zero double-epoch
+    binds, zero invariant violations, zero stuck pods, and bounded
+    leadership gaps."""
+    sim = ChaosSim(
+        seed=seed, n_nodes=4, ha=True, api_faults=PROFILES["ha-storm"]
+    )
+    stats = sim.run(steps=40)
+    assert stats.violations == []
+    # the storm actually churned leadership
+    assert stats.lease_epoch >= 2
+    fs = sim.backend.fault_stats
+    assert fs["lease_renew_errors"] + fs["lease_renew_conflicts"] > 0
+    # faults off -> the election and the cluster must both converge
+    sim.quiesce()
+    assert stats.violations == []
+    assert sim.stuck_pods() == []
+    assert any(r.elector.is_leader for r in sim.replicas)
+    # every landed bind carries exactly one epoch per pod incarnation
+    per_uid = {}
+    for ns, pod, uid, node, epoch in sim.backend.bind_log:
+        per_uid.setdefault(uid, set()).add(epoch)
+    assert all(len(eps) == 1 for eps in per_uid.values())
+
+
+def test_split_brain_exercises_fencing():
+    """At least one seed of the matrix must drive an actual stale-epoch
+    rejection (a deposed leader tried to commit and was fenced off) —
+    otherwise the invariant above is vacuous."""
+    API_COUNTERS.reset()
+    sim = ChaosSim(seed=0, n_nodes=4, ha=True, api_faults=PROFILES["ha-storm"])
+    stats = sim.run(steps=40)
+    assert stats.violations == []
+    assert API_COUNTERS.get("ha_stale_writes_rejected_total") > 0
+
+
+def test_ha_light_profile_bounded_gaps():
+    sim = ChaosSim(seed=1, n_nodes=4, ha=True, api_faults=PROFILES["ha-light"])
+    stats = sim.run(steps=40)
+    sim.quiesce()
+    assert stats.violations == []
+    assert sim.stuck_pods() == []
+    assert stats.max_leader_gap <= int(sim.lease_ttl / 10.0) + 8
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_ha_metrics_exported():
+    out = render_metrics([], failed_count=0)
+    for name, kind in (
+        ("nhd_ha_is_leader", "gauge"),
+        ("nhd_ha_epoch", "gauge"),
+        ("nhd_ha_transitions_total", "counter"),
+        ("nhd_ha_renewals_total", "counter"),
+        ("nhd_ha_stale_writes_rejected_total", "counter"),
+        ("nhd_ha_watchdog_stalls_total", "counter"),
+        ("nhd_ha_watchdog_loop_age_seconds", "gauge"),
+    ):
+        assert f"# TYPE {name} {kind}" in out
+
+
+def test_commit_path_unfenced_without_elector():
+    """Single-replica mode is byte-identical to pre-HA behavior: no
+    elector, no epoch on the wire, pods bind."""
+    backend, _ = _cluster(2)
+    sched = _scheduler(backend)
+    assert sched.poll_leadership() is True
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    sched.check_pending_pods()
+    assert backend.pods[("default", "p1")].node is not None
+    assert backend.bind_log[0][4] is None     # unfenced write
+    assert sched.pod_state[("default", "p1")]["state"] is PodStatus.SCHEDULED

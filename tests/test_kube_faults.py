@@ -368,3 +368,118 @@ def test_watch_cut_recovers_via_resync(stub):
         assert shim.stats["watch_cuts"] >= 1
     finally:
         b.stop_watches()
+
+
+# ---------------------------------------------------------------------------
+# leader election + fencing over the real HTTP path (coordination.k8s.io
+# Lease objects on the stub, with resourceVersion optimistic concurrency)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_election_over_http(stub):
+    from nhd_tpu.k8s.interface import LEASE_NAME
+    from nhd_tpu.k8s.lease import LeaderElector
+    from nhd_tpu.k8s.retry import ApiCounters
+
+    b = _backend()
+    el = LeaderElector(b, identity="replica-1", ttl=30, counters=ApiCounters())
+    assert el.tick() is True
+    assert el.epoch == 1
+    lease = stub.leases[("default", LEASE_NAME)]
+    assert lease["spec"]["holderIdentity"] == "replica-1"
+    assert lease["spec"]["leaseTransitions"] == 1
+    assert el.tick() is True          # renew over the wire (PUT + new rv)
+    assert int(lease_rv := stub.leases[("default", LEASE_NAME)]["metadata"]
+               ["resourceVersion"]) >= 2
+    # a rival sees the live lease and stays a follower
+    el2 = LeaderElector(b, identity="replica-2", ttl=30,
+                        counters=ApiCounters())
+    assert el2.tick() is False
+
+
+def test_renew_lost_to_rival_demotes_over_http(stub):
+    """A rival acquisition landing on the server (holder and epoch
+    moved) makes the next renewal report a genuine CAS loss — the
+    elector must step down immediately, no grace."""
+    from nhd_tpu.k8s.interface import LEASE_NAME
+    from nhd_tpu.k8s.lease import LeaderElector
+    from nhd_tpu.k8s.retry import ApiCounters
+
+    b = _backend()
+    el = LeaderElector(b, identity="replica-1", ttl=30, counters=ApiCounters())
+    assert el.tick() is True
+    lease = stub.leases[("default", LEASE_NAME)]
+    lease["spec"]["holderIdentity"] = "rival"
+    lease["spec"]["leaseTransitions"] = 2
+    assert el.tick() is False
+    assert el.is_leader is False
+    assert el.fencing_epoch() is None
+
+
+def test_self_conflict_on_renew_does_not_bounce_leadership(stub):
+    """The stub's fail_lease_puts hook answers the renew replace with
+    409 while the lease still shows (holder, epoch) == ours — the shape
+    a retried PUT produces after its first send landed. The renew path
+    must re-read and keep leading instead of demoting a healthy leader
+    (and bumping the epoch) once per network blip."""
+    from nhd_tpu.k8s.lease import LeaderElector
+    from nhd_tpu.k8s.retry import ApiCounters
+
+    b = _backend()
+    el = LeaderElector(b, identity="replica-1", ttl=30, counters=ApiCounters())
+    assert el.tick() is True
+    stub.fail_lease_puts = 1
+    assert el.tick() is True          # 409, re-read: still ours
+    assert el.is_leader is True
+    assert el.epoch == 1              # no spurious re-acquisition
+
+
+def test_acquire_race_lost_over_http_stays_follower(stub):
+    """409 on the acquisition replace (another replica won between our
+    read and write) is a normal election outcome, not an error."""
+    from nhd_tpu.k8s.lease import LeaderElector
+    from nhd_tpu.k8s.retry import ApiCounters
+
+    b = _backend()
+    winner = LeaderElector(b, identity="winner", ttl=30,
+                           counters=ApiCounters())
+    assert winner.tick() is True
+    # expire the winner's lease on the server so the loser's acquire
+    # path takes the replace branch — then force that replace to 409
+    from nhd_tpu.k8s.interface import LEASE_NAME
+    stub.leases[("default", LEASE_NAME)]["spec"]["renewTime"] = (
+        "2000-01-01T00:00:00.000000Z"
+    )
+    stub.fail_lease_puts = 1
+    loser = LeaderElector(b, identity="loser", ttl=30, counters=ApiCounters())
+    assert loser.tick() is False
+    assert loser.is_leader is False
+
+
+def test_fenced_write_rejected_over_http(stub):
+    """kube.py's fence check reads the Lease before every fenced mutator:
+    once the server-side epoch moves past the caller's, binds and
+    annotates raise StaleLeaseError instead of landing."""
+    import pytest as _pytest
+
+    from nhd_tpu.k8s.interface import LEASE_NAME, StaleLeaseError
+    from nhd_tpu.k8s.lease import LeaderElector
+    from nhd_tpu.k8s.retry import ApiCounters
+
+    stub.add_node("n1")
+    stub.add_pod("p1")
+    b = _backend()
+    el = LeaderElector(b, identity="replica-1", ttl=30, counters=ApiCounters())
+    assert el.tick() is True and el.epoch == 1
+    # a rival leadership lands on the server (epoch 2)
+    lease = stub.leases[("default", LEASE_NAME)]
+    lease["spec"]["holderIdentity"] = "replica-2"
+    lease["spec"]["leaseTransitions"] = 2
+    with _pytest.raises(StaleLeaseError):
+        b.bind_pod_to_node("p1", "n1", "default", epoch=1)
+    with _pytest.raises(StaleLeaseError):
+        b.annotate_pod_config("default", "p1", "cfg", epoch=1)
+    assert stub.bindings == []            # nothing reached the bind route
+    # the CURRENT epoch still lands over the wire
+    assert b.bind_pod_to_node("p1", "n1", "default", epoch=2) is True
+    assert len(stub.bindings) == 1

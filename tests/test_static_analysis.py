@@ -67,6 +67,9 @@ def found_of(path: Path, packs=None) -> set:
     ("solver/det_pos.py", ["determinism"]),
     ("solver/det_neg.py", ["determinism"]),
     ("det_out_of_scope.py", ["determinism"]),
+    ("scheduler/fence_pos.py", ["fencing"]),
+    ("scheduler/fence_neg.py", ["fencing"]),
+    ("fence_out_of_scope.py", ["fencing"]),
     ("lockgraph_pos.py", ["lockgraph"]),
     ("lockgraph_neg.py", ["lockgraph"]),
 ])
@@ -76,7 +79,8 @@ def test_fixture_exact_findings(name, packs):
 
 
 _POS_FIXTURES = ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
-                 "solver/det_pos.py", "lockgraph_pos.py")
+                 "solver/det_pos.py", "scheduler/fence_pos.py",
+                 "lockgraph_pos.py")
 
 
 def test_fixtures_have_positive_coverage_for_every_pack():

@@ -46,6 +46,11 @@ def main() -> int:
     ap.add_argument("--profiles", default="light,storm,heavy",
                     help="comma-separated profile names (sim/faults.py "
                          "PROFILES; default light,storm,heavy)")
+    ap.add_argument("--ha", action="store_true",
+                    help="split-brain mode: two scheduler replicas under "
+                         "leader election share each cell's cluster; adds "
+                         "the double-epoch-bind and bounded-leadership-gap "
+                         "invariants (pair with the ha-* profiles)")
     ap.add_argument("--start-seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -61,31 +66,42 @@ def main() -> int:
     t0 = time.time()
     cells = 0
     for profile in profiles:
-        totals = {"dropped_events": 0, "poisoned_events": 0,
-                  "transient_binds": 0, "transient_annotates": 0}
+        totals: dict = {}
+        epochs, gaps = 0, 0
         for seed in range(args.start_seed, args.start_seed + args.seeds):
             faults = PROFILES[profile] if profile != "none" else None
-            sim = ChaosSim(seed=seed, n_nodes=args.nodes, api_faults=faults)
+            sim = ChaosSim(
+                seed=seed, n_nodes=args.nodes, api_faults=faults,
+                ha=args.ha,
+            )
             stats = sim.run(steps=args.steps)
             sim.quiesce()
             stuck = sim.stuck_pods()
             if stats.violations or stuck:
                 print(f"CHAOS FAIL profile={profile} seed={seed} "
-                      f"nodes={args.nodes} steps={args.steps}:")
+                      f"nodes={args.nodes} steps={args.steps}"
+                      f"{' ha' if args.ha else ''}:")
                 for v in stats.violations:
                     print(f"  violation: {v}")
                 for key in stuck:
                     print(f"  stuck pod: {key}")
                 return 1
             if faults is not None:
-                for k in totals:
-                    totals[k] += sim.backend.fault_stats[k]
+                for k, n in sim.backend.fault_stats.items():
+                    totals[k] = totals.get(k, 0) + n
+            epochs = max(epochs, stats.lease_epoch)
+            gaps = max(gaps, stats.max_leader_gap)
             cells += 1
-        print(f"profile {profile:>6}: {args.seeds} seeds clean "
-              f"(faults injected: {totals})")
+        extra = (
+            f", max lease epoch {epochs}, max leader gap {gaps}"
+            if args.ha else ""
+        )
+        print(f"profile {profile:>8}: {args.seeds} seeds clean "
+              f"(faults injected: {totals}{extra})")
     print(f"chaos matrix OK: {cells} cells "
           f"({len(profiles)} profiles x {args.seeds} seeds, "
-          f"{args.steps} steps) in {time.time() - t0:.1f}s")
+          f"{args.steps} steps{', split-brain' if args.ha else ''}) "
+          f"in {time.time() - t0:.1f}s")
     return 0
 
 
