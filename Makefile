@@ -10,8 +10,12 @@ CHAOS_SEEDS ?= 6
 CHAOS_STEPS ?= 60
 HA_SEEDS ?= 6
 HA_STEPS ?= 50
+FED_SEEDS ?= 6
+FED_STEPS ?= 50
+FED_SHARDS ?= 3
+FED_REPLICAS ?= 3
 
-.PHONY: test lint sanitize proto bench wheel clean native soak chaos ha-chaos trace-demo docker docker-smoke release
+.PHONY: test lint sanitize proto bench wheel clean native soak chaos ha-chaos fed-chaos trace-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -42,7 +46,9 @@ lint:
 # runtime deadlock sanitizer (nhdsan, nhd_tpu/sanitizer/): the
 # concurrency-heavy suites under instrumented locks — a wait-for-graph
 # cycle fails loud with a witness instead of hanging the run
-# (docs/OBSERVABILITY.md; NHD_SAN_REPORT holds the dump path)
+# (docs/OBSERVABILITY.md; NHD_SAN_REPORT holds the dump path).
+# test_ha.py includes the fastest federation cell (fed-light storm),
+# so the shard-lease/handoff/spillover lock surfaces run instrumented.
 sanitize:
 	NHD_SAN=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
 		tests/test_streaming.py tests/test_faults.py tests/test_ha.py -q
@@ -81,7 +87,22 @@ chaos:
 # tests/test_ha.py)
 ha-chaos:
 	python tools/chaos_storm.py --ha --profiles ha-light,ha-storm \
-		--seeds $(HA_SEEDS) --steps $(HA_STEPS)
+		--seeds $(HA_SEEDS) --steps $(HA_STEPS) \
+		--json-out artifacts/chaos/ha_chaos.json
+
+# shard-federation matrix: FED_REPLICAS full replicas over FED_SHARDS
+# shard leases share each cell's cluster, under per-shard lease faults,
+# asymmetric partitions and kill/restart waves; zero double-shard-epoch
+# binds, bounded per-shard leadership gaps, bounded spillover orphan
+# windows, converged end state (docs/RESILIENCE.md "Federation"; CI runs
+# the fast subset in tests/test_ha.py, which `make sanitize` also covers
+# under NHD_SAN=1 via the fed-light fast cell). The JSON artifact makes
+# runs diffable in CI instead of log-scrape-only.
+fed-chaos:
+	python tools/chaos_storm.py --federation $(FED_SHARDS) \
+		--replicas $(FED_REPLICAS) --profiles fed-light,fed-storm \
+		--seeds $(FED_SEEDS) --steps $(FED_STEPS) --nodes 6 \
+		--json-out artifacts/chaos/fed_chaos.json
 
 # flight-recorder demo: run the sim with tracing on, dump the Chrome
 # trace, validate its schema + per-pod span pipeline (docs/OBSERVABILITY.md)

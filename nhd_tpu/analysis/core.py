@@ -196,11 +196,12 @@ RULES: Dict[str, Tuple[str, str]] = {
                "wall-clock read (time.time/datetime.now) in a solver/encode "
                "path: use the caller-passed 'now' or time.monotonic"),
     "NHD501": ("fencing",
-               "mutating ClusterBackend call (bind/annotate/NAD) in "
-               "nhd_tpu/scheduler/ outside the fenced-commit helper "
-               "Scheduler._commit_write: the write would not carry the "
-               "fencing epoch, so a deposed leader's in-flight commit "
-               "could land after a standby's promotion"),
+               "mutating ClusterBackend call in nhd_tpu/scheduler/ outside "
+               "its chokepoint: commit-path mutators (bind/annotate/NAD/"
+               "spillover) belong in Scheduler._commit_write (the write "
+               "must carry the owning shard's fencing epoch), TriadSet "
+               "mutators in Controller._coordinator_write (coordinatorship "
+               "re-checked at the write, not the pass)"),
 }
 
 

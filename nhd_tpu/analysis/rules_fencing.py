@@ -13,9 +13,14 @@ contract: inside ``nhd_tpu/scheduler/``, the four commit-path mutators
   (``self._commit_write(self.backend.bind_pod_to_node, ...)``) is the
   sanctioned form and is not a call expression, so it never flags.
 
-Reads, ``generate_pod_event`` (idempotent audit trail), and the
-controller's TriadSet reconciliation (gated on leadership at the loop
-level, and create-idempotent: a double-create answers 409) are out of
+The CONTROLLER's cluster mutators (``create_pod_for_triadset``,
+``update_triadset_status`` — the TriadSet reconciliation writes) are in
+scope too: they must route through ``Controller._coordinator_write``,
+which re-checks coordinatorship at the write instead of only at the top
+of the reconcile pass (a replica deposed — or whose coordinator shard
+handed off under federation — mid-pass must not keep writing).
+
+Reads and ``generate_pod_event`` (idempotent audit trail) are out of
 scope — the rule guards exactly the writes whose double application
 corrupts cluster state.
 """
@@ -36,10 +41,24 @@ FENCED_MUTATORS = frozenset({
     "annotate_pod_config",
     "annotate_pod_gpu_map",
     "add_nad_to_pod",
+    "annotate_pod_meta",
+    "claim_spillover_pod",
 })
 
-#: the one function allowed to issue them
+#: the controller's cluster mutators (TriadSet reconciliation) — gated
+#: on coordinatorship per write, not per pass
+COORDINATOR_MUTATORS = frozenset({
+    "create_pod_for_triadset",
+    "update_triadset_status",
+})
+
+#: mutator → the one function allowed to issue it
 FENCE_HELPER = "_commit_write"
+COORDINATOR_HELPER = "_coordinator_write"
+_HELPER_FOR = {
+    **{m: FENCE_HELPER for m in FENCED_MUTATORS},
+    **{m: COORDINATOR_HELPER for m in COORDINATOR_MUTATORS},
+}
 
 
 def _in_scope(path: str) -> bool:
@@ -73,17 +92,31 @@ class _Visitor(ast.NodeVisitor):
             # taking the backend directly must not evade the rule
             if (
                 len(parts) >= 2
-                and parts[-1] in FENCED_MUTATORS
+                and parts[-1] in _HELPER_FOR
                 and parts[-2] == "backend"
-                and self._enclosing() != FENCE_HELPER
+                and self._enclosing() != _HELPER_FOR[parts[-1]]
             ):
+                helper = _HELPER_FOR[parts[-1]]
+                if helper == FENCE_HELPER:
+                    why = (
+                        f"{d}() mutates cluster state outside the "
+                        f"fenced-commit helper: without the fencing epoch "
+                        f"a deposed leader's in-flight write can land "
+                        f"after a standby's promotion — route it through "
+                        f"Scheduler.{FENCE_HELPER}() "
+                        "(docs/RESILIENCE.md 'HA & fencing')"
+                    )
+                else:
+                    why = (
+                        f"{d}() mutates cluster state outside the "
+                        f"coordinator-write helper: a replica deposed "
+                        f"mid-reconcile keeps writing against the new "
+                        f"coordinator — route it through "
+                        f"Controller.{COORDINATOR_HELPER}() "
+                        "(docs/RESILIENCE.md 'Federation')"
+                    )
                 self.findings.append(Finding(
-                    "NHD501", self.path, node.lineno, node.col_offset,
-                    f"{d}() mutates cluster state outside the fenced-commit "
-                    f"helper: without the fencing epoch a deposed leader's "
-                    f"in-flight write can land after a standby's promotion "
-                    f"— route it through Scheduler.{FENCE_HELPER}() "
-                    "(docs/RESILIENCE.md 'HA & fencing')",
+                    "NHD501", self.path, node.lineno, node.col_offset, why,
                 ))
         self.generic_visit(node)
 

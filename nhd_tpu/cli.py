@@ -33,6 +33,8 @@ def build_threads(
     respect_busy: bool = True,
     trace_dir=None,
     ha_identity=None,
+    shards: int = 1,
+    shard_peers=None,
 ):
     """Wire up the thread set for a backend; returns (threads, rpc_queue).
 
@@ -41,28 +43,48 @@ def build_threads(
     not acting — until the lease keeper wins the election; every commit
     is then stamped with the fencing epoch, and the stall watchdog
     releases the lease + exits crash-only if the scheduling loop wedges,
-    so the other replica takes over within one renew interval."""
+    so the other replica takes over within one renew interval.
+
+    With ``shards`` > 1 the replica joins a SHARDED FEDERATION instead
+    (k8s/lease.py ShardedElector): the node-group set is partitioned
+    across ``shards`` leases, this replica rendezvous-leases a subset
+    (handing shards over as peers in ``shard_peers`` come and go), every
+    commit is fenced by the epoch of the shard owning the target node,
+    and pods no owned shard can place spill to the untried shards
+    (docs/RESILIENCE.md "Federation")."""
     watch_q = WatchQueue()
     rpc_q: queue.Queue = queue.Queue(maxsize=128)  # reference: bin/nhd:21
 
     elector = None
-    if ha_identity:
+    sharded = None
+    if shards > 1:
+        from nhd_tpu.k8s.lease import ShardedElector
+
+        sharded = ShardedElector(
+            backend, identity=ha_identity,
+            peers=shard_peers or [ha_identity], n_shards=shards,
+        )
+    elif ha_identity:
         from nhd_tpu.k8s.lease import LeaderElector
 
         elector = LeaderElector(backend, identity=ha_identity)
 
     scheduler = Scheduler(
-        backend, watch_q, rpc_q, respect_busy=respect_busy, elector=elector
+        backend, watch_q, rpc_q, respect_busy=respect_busy,
+        elector=elector, sharded=sharded,
     )
-    controller = Controller(backend, watch_q, elector=elector)
+    controller = Controller(backend, watch_q, elector=sharded or elector)
     threads = [controller, scheduler]
 
-    if elector is not None:
+    if sharded is not None or elector is not None:
         from nhd_tpu.k8s.lease import LeaseKeeper, StallWatchdog
 
-        threads.append(LeaseKeeper(elector))
+        # the keeper ticks either elector flavor (same tick()/step_down()
+        # protocol); the watchdog's release covers EVERY held shard
+        active = sharded or elector
+        threads.append(LeaseKeeper(active))
         threads.append(StallWatchdog(
-            lambda: scheduler.last_heartbeat, elector=elector
+            lambda: scheduler.last_heartbeat, elector=active
         ))
 
     try:
@@ -211,6 +233,19 @@ def main(argv=None) -> int:
     parser.add_argument("--ha-identity", default=None,
                         help="this replica's holder identity for the lease "
                              "(default: <hostname>-<pid>)")
+    parser.add_argument("--shards", type=int,
+                        default=int(os.environ.get("NHD_SHARDS", "1")),
+                        help="shard the node-group set across S federated "
+                             "leases; this replica rendezvous-leases a "
+                             "subset and fences every commit with the "
+                             "owning shard's epoch. 1 = no federation "
+                             "(docs/RESILIENCE.md 'Federation')")
+    parser.add_argument("--shard-replicas", default=None,
+                        help="comma-separated identities of ALL federation "
+                             "replicas (including this one) — the peer set "
+                             "the deterministic rendezvous shard assignment "
+                             "and handoff protocol run over; requires "
+                             "--shards > 1 and a stable --ha-identity")
     parser.add_argument("--run-seconds", type=float, default=0,
                         help="exit cleanly after N seconds with a summary "
                              "(demo/smoke runs; 0 = run forever)")
@@ -265,15 +300,34 @@ def main(argv=None) -> int:
         backend = KubeClusterBackend()
 
     ha_identity = None
-    if args.ha:
+    shard_peers = None
+    if args.ha or args.shards > 1:
         import socket
 
         ha_identity = args.ha_identity or f"{socket.gethostname()}-{os.getpid()}"
+    if args.shards > 1:
+        shard_peers = sorted(
+            {p.strip() for p in (args.shard_replicas or "").split(",")
+             if p.strip()} | {ha_identity}
+        )
+        if not args.ha_identity:
+            # a pid-derived identity changes every restart, which would
+            # churn the rendezvous assignment for the whole federation
+            logger.warning(
+                "federation without --ha-identity: using the volatile "
+                f"{ha_identity}; set a stable identity per replica"
+            )
+        logger.warning(
+            f"federation mode: {args.shards} shard leases over replicas "
+            f"{shard_peers}, joining as {ha_identity}"
+        )
+    elif args.ha:
         logger.warning(f"HA mode: competing for the lease as {ha_identity}")
 
     threads, _ = build_threads(
         backend, rpc_port=args.rpc_port, metrics_port=args.metrics_port,
         trace_dir=args.trace_out, ha_identity=ha_identity,
+        shards=args.shards, shard_peers=shard_peers,
     )
     for t in threads:
         t.start()
@@ -291,8 +345,10 @@ def main(argv=None) -> int:
     def release_leadership() -> None:
         """Clean exits hand the lease over NOW: without the voluntary
         release the standby waits out the full TTL (the handover bound
-        docs/OPERATIONS.md promises is one renew interval)."""
-        if not args.ha:
+        docs/OPERATIONS.md promises is one renew interval). In
+        federation mode this releases every held shard AND the presence
+        beacon, so peers rebalance in one tick."""
+        if not args.ha and args.shards <= 1:
             return
         from nhd_tpu.k8s.lease import LeaseKeeper
 

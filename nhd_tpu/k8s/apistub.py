@@ -9,7 +9,9 @@ uses — list/read nodes and pods, ConfigMaps, strategic-merge pod
 patches, pod bindings, events, pod creation, the TriadSet custom
 resource, coordination.k8s.io Leases (with real resourceVersion
 optimistic concurrency: a stale replace answers 409, and the
-``fail_lease_puts`` hook forces conflicts for renewal-fault testing),
+``fail_lease_puts`` hook forces conflicts for renewal-fault testing and
+``fail_lease_gets`` fails reads for election/federation-liveness fault
+testing),
 and line-delimited watch streams — over a real HTTP socket,
 records every request (method, path, content type, raw body bytes) for
 byte-level assertions, and answers with faithful camelCase JSON shapes
@@ -225,6 +227,15 @@ class _Handler(BaseHTTPRequestHandler):
                 parts[:1] == ["apis"] and len(parts) == 7
                 and parts[3] == "namespaces" and parts[5] == "leases"
             ):
+                with srv.lock:
+                    if srv.fail_lease_gets > 0:
+                        srv.fail_lease_gets -= 1
+                        # lease reads feed the election AND federation
+                        # liveness (lease_live): a 500 here exercises the
+                        # unverifiable-peer / unverifiable-shard paths
+                        return self._send_json(
+                            500, _status(500, "InternalError")
+                        )
                 lease = srv.leases.get((parts[4], parts[6]))
                 return self._send_json(
                     200 if lease else 404, lease or _status(404, "NotFound")
@@ -421,6 +432,8 @@ class StubApiServer:
         self.fail_gets = 0      # next N GETs answer 503 (retry testing)
         self.fail_lease_puts = 0  # next N lease replaces answer 409
         #                          (conflict-on-renew fault injection)
+        self.fail_lease_gets = 0  # next N lease GETs answer 500 (election
+        #                          + federation-liveness fault injection)
         self.watch_hang = 0.0   # seconds a watch stream stays open, silent
         self.closing = False
         self.token = token

@@ -22,11 +22,14 @@ from nhd_tpu.k8s.interface import (
     LEASE_NAME,
     NAD_ANNOTATION,
     SCHEDULER_TAINT,
+    SPILLOVER_ANNOTATION,
     ClusterBackend,
     LeaseView,
     PodEvent,
     StaleLeaseError,
     WatchEvent,
+    parse_spill_record,
+    render_spill_record,
 )
 from nhd_tpu.k8s.retry import API_COUNTERS
 from nhd_tpu.utils import get_logger
@@ -91,11 +94,16 @@ class FakeClusterBackend(ClusterBackend):
         # off the sim's step clock instead of wall time.
         self.clock = time.monotonic
         self.leases: Dict[str, FakeLease] = {}
-        # the lease fenced writes are checked against (interface.py)
+        # the DEFAULT lease fenced writes are checked against when the
+        # caller names none (interface.py); federated writes name the
+        # shard lease per call via ``fence_lease``
         self.fence_lease_name = LEASE_NAME
-        # every SUCCESSFUL bind: (ns, pod, uid, node, epoch) — the chaos
-        # harness's "no pod ever bound by two epochs" invariant reads this
-        self.bind_log: List[Tuple[str, str, str, str, Optional[int]]] = []
+        # every SUCCESSFUL bind: (ns, pod, uid, node, epoch, lease) — the
+        # chaos harness's "no pod ever bound under two shard epochs"
+        # invariant reads this
+        self.bind_log: List[
+            Tuple[str, str, str, str, Optional[int], Optional[str]]
+        ] = []
 
     # ------------------------------------------------------------------
     # simulation controls (test-facing, not part of ClusterBackend)
@@ -305,15 +313,18 @@ class FakeClusterBackend(ClusterBackend):
     # ClusterBackend: writes
     # ------------------------------------------------------------------
 
-    def _check_fence(self, epoch: Optional[int]) -> None:
-        """Reject a fenced write whose epoch a newer lease acquisition
-        has already overtaken. Caller holds ``self._lock``, so the check
-        is atomic with the write itself — the property that makes fencing
-        tokens sound (a deposed leader can't slip a write in between the
-        check and the mutation)."""
+    def _check_fence(
+        self, epoch: Optional[int], lease_name: Optional[str] = None
+    ) -> None:
+        """Reject a fenced write whose epoch a newer acquisition of the
+        named lease has already overtaken. Caller holds ``self._lock``,
+        so the check is atomic with the write itself — the property that
+        makes fencing tokens sound (a deposed leader can't slip a write
+        in between the check and the mutation). ``lease_name`` selects
+        the shard lease under federation; None = the default lease."""
         if epoch is None:
             return
-        lease = self.leases.get(self.fence_lease_name)
+        lease = self.leases.get(lease_name or self.fence_lease_name)
         if lease is not None and epoch < lease.epoch:
             API_COUNTERS.inc("ha_stale_writes_rejected_total")
             raise StaleLeaseError(
@@ -323,10 +334,11 @@ class FakeClusterBackend(ClusterBackend):
             )
 
     def add_nad_to_pod(
-        self, pod: str, ns: str, nad: str, *, epoch: Optional[int] = None
+        self, pod: str, ns: str, nad: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         with self._lock:
-            self._check_fence(epoch)
+            self._check_fence(epoch, fence_lease)
             p = self._pod(pod, ns)
             if p is None:
                 return False
@@ -334,10 +346,11 @@ class FakeClusterBackend(ClusterBackend):
             return True
 
     def annotate_pod_config(
-        self, ns: str, pod: str, cfg: str, *, epoch: Optional[int] = None
+        self, ns: str, pod: str, cfg: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         with self._lock:
-            self._check_fence(epoch)
+            self._check_fence(epoch, fence_lease)
             p = self._pod(pod, ns)
             if p is None:
                 return False
@@ -346,10 +359,10 @@ class FakeClusterBackend(ClusterBackend):
 
     def annotate_pod_gpu_map(
         self, ns: str, pod: str, gpu_map: Dict[str, int],
-        *, epoch: Optional[int] = None,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         with self._lock:
-            self._check_fence(epoch)
+            self._check_fence(epoch, fence_lease)
             p = self._pod(pod, ns)
             if p is None:
                 return False
@@ -357,18 +370,62 @@ class FakeClusterBackend(ClusterBackend):
                 p.annotations[f"{GPU_MAP_ANNOTATION_PREFIX}.{dev}"] = str(devid)
             return True
 
-    def bind_pod_to_node(
-        self, pod: str, node: str, ns: str, *, epoch: Optional[int] = None
+    def annotate_pod_meta(
+        self, ns: str, pod: str, key: str, value: str,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         with self._lock:
-            self._check_fence(epoch)
+            self._check_fence(epoch, fence_lease)
+            p = self._pod(pod, ns)
+            if p is None:
+                return False
+            p.annotations[key] = value
+            return True
+
+    def claim_spillover_pod(
+        self, ns: str, pod: str, claim_lease: str, claim_epoch: int,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        with self._lock:
+            self._check_fence(epoch, fence_lease)
+            p = self._pod(pod, ns)
+            if p is None:
+                return False
+            rec = parse_spill_record(p.annotations.get(SPILLOVER_ANNOTATION))
+            cur = rec.get("claim")
+            if cur is not None and cur != (claim_lease, claim_epoch):
+                # a foreign claim blocks us only while it is LIVE: its
+                # lease still held under the claimed epoch. A crashed or
+                # deposed claimant's shard lease re-acquires with a
+                # higher epoch, so its claim goes stale by itself.
+                lease = self.leases.get(cur[0])
+                if (
+                    lease is not None and lease.holder
+                    and lease.expires > self.clock()
+                    and lease.epoch == cur[1]
+                ):
+                    return False
+            rec["claim"] = (claim_lease, claim_epoch)
+            p.annotations[SPILLOVER_ANNOTATION] = render_spill_record(rec)
+            return True
+
+    def bind_pod_to_node(
+        self, pod: str, node: str, ns: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        with self._lock:
+            self._check_fence(epoch, fence_lease)
             p = self._pod(pod, ns)
             if p is None or (ns, pod) in self.fail_bind_for:
                 return False
             p.node = node
             p.phase = "Running"  # kubelet admission, fast-forwarded
             self.bind_count += 1
-            self.bind_log.append((ns, pod, p.uid, node, epoch))
+            self.bind_log.append((
+                ns, pod, p.uid, node, epoch,
+                (fence_lease or self.fence_lease_name)
+                if epoch is not None else None,
+            ))
             return True
 
     def generate_pod_event(self, pod, ns, reason, event_type, message) -> None:
@@ -423,6 +480,13 @@ class FakeClusterBackend(ClusterBackend):
         with self._lock:
             lease = self.leases.get(name)
             return self._lease_view(lease) if lease else None
+
+    def lease_live(self, name: str) -> str:
+        with self._lock:
+            lease = self.leases.get(name)
+            if lease is None or not lease.holder:
+                return ""
+            return lease.holder if lease.expires > self.clock() else ""
 
     # ------------------------------------------------------------------
     # watch + TriadSets

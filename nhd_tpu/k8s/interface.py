@@ -34,8 +34,50 @@ SCHEDULER_TAINT = f"{DOMAIN}/nhd_scheduler"
 NAD_ANNOTATION = "k8s.v1.cni.cncf.io/networks"
 
 #: the election lease every replica competes for, and the lease fenced
-#: writes are checked against (k8s/lease.py, docs/RESILIENCE.md "HA")
+#: writes are checked against (k8s/lease.py, docs/RESILIENCE.md "HA").
+#: Under a sharded federation (k8s/lease.py shard_lease_name) each shard
+#: gets its own lease derived from this name; S=1 degenerates to exactly
+#: this single lease.
 LEASE_NAME = "nhd-scheduler-leader"
+
+#: cross-shard spillover record (docs/RESILIENCE.md "Federation"): one
+#: JSON annotation carrying which shards already failed to place the pod
+#: ("tried"), the current claim ([lease, epoch] of the shard attempting
+#: it now — claims go stale the moment that lease's epoch advances), and
+#: the first-spill stamp ("since", for the orphan-age metrics)
+SPILLOVER_ANNOTATION = f"{DOMAIN}/nhd_spillover"
+
+
+def parse_spill_record(raw: Optional[str]) -> dict:
+    """Decode a spillover annotation; tolerant of absence and garbage
+    (a malformed record reads as 'never spilled' — the pod just re-enters
+    the cycle at its home shard)."""
+    out: dict = {"tried": set(), "claim": None, "since": None}
+    if not raw:
+        return out
+    import json
+
+    try:
+        data = json.loads(raw)
+        out["tried"] = {int(s) for s in data.get("tried", [])}
+        claim = data.get("claim")
+        if claim:
+            out["claim"] = (str(claim[0]), int(claim[1]))
+        if data.get("since") is not None:
+            out["since"] = float(data["since"])
+    except (ValueError, TypeError, KeyError, IndexError):
+        return {"tried": set(), "claim": None, "since": None}
+    return out
+
+
+def render_spill_record(rec: dict) -> str:
+    import json
+
+    return json.dumps({
+        "tried": sorted(int(s) for s in rec.get("tried", ())),
+        "claim": list(rec["claim"]) if rec.get("claim") else None,
+        "since": rec.get("since"),
+    }, sort_keys=True)
 
 
 class EventType(Enum):
@@ -190,29 +232,63 @@ class ClusterBackend(ABC):
     # a newer lease epoch exists, atomically with the write itself, so a
     # deposed leader's in-flight commit can never land after a standby's
     # promotion (docs/RESILIENCE.md "HA & fencing").
+    #
+    # ``fence_lease`` names WHICH lease the epoch is checked against —
+    # under the sharded federation every write is fenced by the lease of
+    # the shard owning the target node, not one global lease. ``None``
+    # keeps the PR 5 single-lease behavior (the backend's default fence
+    # lease).
 
     @abstractmethod
     def add_nad_to_pod(
-        self, pod: str, ns: str, nad: str, *, epoch: Optional[int] = None
+        self, pod: str, ns: str, nad: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         """CNI NetworkAttachmentDefinition annotation (K8SMgr.py:284-298)."""
 
     @abstractmethod
     def annotate_pod_config(
-        self, ns: str, pod: str, cfg: str, *, epoch: Optional[int] = None
+        self, ns: str, pod: str, cfg: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         """Persist the solved config (K8SMgr.py:379-393)."""
 
     @abstractmethod
     def annotate_pod_gpu_map(
         self, ns: str, pod: str, gpu_map: Dict[str, int],
-        *, epoch: Optional[int] = None,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         """Per-device GPU annotations (K8SMgr.py:359-376)."""
 
     @abstractmethod
+    def annotate_pod_meta(
+        self, ns: str, pod: str, key: str, value: str,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        """One arbitrary pod annotation (rebuild addition: the spillover
+        record SPILLOVER_ANNOTATION rides this). Fenced like every other
+        commit-path mutator."""
+
+    @abstractmethod
+    def claim_spillover_pod(
+        self, ns: str, pod: str, claim_lease: str, claim_epoch: int,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        """Atomically claim a spilled pod for one shard's attempt: write
+        ``claim = (claim_lease, claim_epoch)`` into the spillover record
+        UNLESS a live foreign claim exists (a claim is live while its
+        lease's current epoch still equals the claim's — a crashed or
+        deposed claimant's claim goes stale the moment its shard lease
+        is re-acquired, which bounds the orphan window). Returns False
+        when another shard's live claim blocks us, True when the claim
+        is ours (re-claiming our own claim is idempotent). Two shards
+        racing the same spilled pod is the cross-shard double-bind hole;
+        this is the gate that closes it."""
+
+    @abstractmethod
     def bind_pod_to_node(
-        self, pod: str, node: str, ns: str, *, epoch: Optional[int] = None
+        self, pod: str, node: str, ns: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         """THE schedule commit point — V1Binding (K8SMgr.py:468-492)."""
 
@@ -250,6 +326,14 @@ class ClusterBackend(ABC):
     @abstractmethod
     def lease_read(self, name: str) -> Optional[LeaseView]:
         """Current lease state, or None when no such lease exists."""
+
+    @abstractmethod
+    def lease_live(self, name: str) -> str:
+        """The holder iff the lease exists AND is unexpired, else "".
+        Expiry is evaluated in the BACKEND's own clock domain — this is
+        the one liveness question callers cannot answer from a LeaseView
+        alone (federation membership + shard-orphan patience need it,
+        k8s/lease.py ShardedElector)."""
 
     # ---- watch plane (consumed by the controller) ----
 

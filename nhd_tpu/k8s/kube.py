@@ -27,11 +27,14 @@ from nhd_tpu.k8s.interface import (
     LEASE_NAME,
     NAD_ANNOTATION,
     SCHEDULER_TAINT,
+    SPILLOVER_ANNOTATION,
     ClusterBackend,
     LeaseView,
     StaleLeaseError,
     TransientBackendError,
     WatchEvent,
+    parse_spill_record,
+    render_spill_record,
 )
 from nhd_tpu.k8s.retry import API_COUNTERS, RetryPolicy, RetryingApi, retryable
 from nhd_tpu.utils import get_logger
@@ -164,15 +167,23 @@ class KubeClusterBackend(ClusterBackend):
             _RESYNC_DEFAULT_SEC if resync_interval is None else resync_interval
         )
         # HA lease plumbing (k8s/lease.py): the namespace the election
-        # Lease lives in, and the lease fenced writes are checked against
+        # Lease lives in, and the DEFAULT lease fenced writes are checked
+        # against when the caller names none (shard leases arrive per
+        # call via the fence_lease kwarg)
         self._lease_ns = _LEASE_NS_DEFAULT
         self.fence_lease_name = LEASE_NAME
-        # fence-check cache: (valid-until monotonic stamp, LeaseView or
-        # None); written by commit threads under _fence_lock. Only
-        # _check_fence reads through it — the election itself
-        # (lease_renew/lease_try_acquire) always goes to the server.
+        # fence-check cache, per lease name: (valid-until monotonic
+        # stamp, LeaseView or None); written by commit threads under
+        # _fence_lock. Only _check_fence reads through it — the election
+        # itself (lease_renew/lease_try_acquire) always goes to the
+        # server. _lease_epoch_hwm is the per-lease epoch high-water
+        # mark: EVERY lease state this process observes (acquire, renew,
+        # read) advances it, so a rival acquisition seen through any
+        # lease operation fences stale writes immediately — ahead of the
+        # cache window (tests/test_kube_faults.py pins this).
         self._fence_lock = threading.Lock()
-        self._fence_cached: Optional[Tuple[float, Optional[LeaseView]]] = None
+        self._fence_cached: Dict[str, Tuple[float, Optional[LeaseView]]] = {}
+        self._lease_epoch_hwm: Dict[str, int] = {}
         # dead-socket defense on the watch plane: the restclient bakes a
         # finite read timeout into stream requests itself; the real
         # kubernetes client needs it passed per stream() call. Gated on
@@ -362,32 +373,58 @@ class KubeClusterBackend(ClusterBackend):
             self.logger.error(f"annotation patch failed for {ns}/{pod}: {exc}")
             return False
 
-    def _check_fence(self, epoch: Optional[int]) -> None:
+    def _note_lease_epoch(self, name: str, view: Optional[LeaseView]) -> None:
+        """Advance the per-lease epoch high-water mark with an observed
+        lease state. Called from every lease-reading path, so any rival
+        acquisition this process sees — its own elector's CAS loss, a
+        federation peer's shard acquisition through the same backend, a
+        fence-check read — immediately fences writes stamped with older
+        epochs, without waiting out the fence cache window."""
+        if view is None:
+            return
+        with self._fence_lock:
+            if view.epoch > self._lease_epoch_hwm.get(name, 0):
+                self._lease_epoch_hwm[name] = view.epoch
+
+    def _check_fence(
+        self, epoch: Optional[int], lease_name: Optional[str] = None
+    ) -> None:
         """Reject a fenced write whose epoch a newer lease acquisition has
         overtaken. Kubernetes has no conditional bind, so unlike the fake
         backend this is check-then-write, not atomic — the check (a Lease
-        GET under the retry policy, cached for NHD_FENCE_CACHE_SEC so a
-        pod commit's 4 fenced mutators don't pay 4 serial round trips)
-        narrows the deposed-leader window to one round trip plus the
-        cache window; the atomic form of the rejection is what the
-        split-brain chaos harness proves against the fake
-        (docs/RESILIENCE.md)."""
+        GET under the retry policy, cached per lease for
+        NHD_FENCE_CACHE_SEC so a pod commit's fenced mutators don't pay
+        serial round trips) narrows the deposed-leader window to one
+        round trip plus the cache window, and the epoch high-water mark
+        (_note_lease_epoch) closes the cache window entirely for any
+        rival leadership this process has already observed; the atomic
+        form of the rejection is what the split-brain chaos harness
+        proves against the fake (docs/RESILIENCE.md)."""
         if epoch is None:
             return
+        name = lease_name or self.fence_lease_name
         import time as _time
 
         now = _time.monotonic()
+        with self._fence_lock:
+            hwm = self._lease_epoch_hwm.get(name, 0)
+        if epoch < hwm:
+            API_COUNTERS.inc("ha_stale_writes_rejected_total")
+            raise StaleLeaseError(
+                f"write fenced off: epoch {epoch} is stale (epoch {hwm} "
+                f"already observed for lease {name!r})"
+            )
         view = None
         fresh = False
         if _FENCE_CACHE_SEC > 0:
             with self._fence_lock:
-                cached = self._fence_cached
+                cached = self._fence_cached.get(name)
             if cached is not None and now < cached[0]:
                 view, fresh = cached[1], True
         if not fresh:
-            view = self.lease_read(self.fence_lease_name)
+            view = self.lease_read(name)
             with self._fence_lock:
-                self._fence_cached = (now + _FENCE_CACHE_SEC, view)
+                self._fence_cached[name] = (now + _FENCE_CACHE_SEC, view)
         if view is not None and epoch < view.epoch:
             API_COUNTERS.inc("ha_stale_writes_rejected_total")
             raise StaleLeaseError(
@@ -396,33 +433,72 @@ class KubeClusterBackend(ClusterBackend):
             )
 
     def add_nad_to_pod(
-        self, pod: str, ns: str, nad: str, *, epoch: Optional[int] = None
+        self, pod: str, ns: str, nad: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
-        self._check_fence(epoch)
+        self._check_fence(epoch, fence_lease)
         return self._patch_annotation(pod, ns, {NAD_ANNOTATION: nad})
 
     def annotate_pod_config(
-        self, ns: str, pod: str, cfg: str, *, epoch: Optional[int] = None
+        self, ns: str, pod: str, cfg: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
-        self._check_fence(epoch)
+        self._check_fence(epoch, fence_lease)
         return self._patch_annotation(pod, ns, {CFG_ANNOTATION: cfg})
 
     def annotate_pod_gpu_map(
         self, ns: str, pod: str, gpu_map: Dict[str, int],
-        *, epoch: Optional[int] = None,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
-        self._check_fence(epoch)
+        self._check_fence(epoch, fence_lease)
         return self._patch_annotation(
             pod, ns,
             {f"{GPU_MAP_ANNOTATION_PREFIX}.{d}": str(i) for d, i in gpu_map.items()},
         )
 
+    def annotate_pod_meta(
+        self, ns: str, pod: str, key: str, value: str,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        self._check_fence(epoch, fence_lease)
+        return self._patch_annotation(pod, ns, {key: value})
+
+    def claim_spillover_pod(
+        self, ns: str, pod: str, claim_lease: str, claim_epoch: int,
+        *, epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        """Check-then-write like every kube fence (no conditional patch
+        on the annotation surface): read the spillover record, honor a
+        live foreign claim, else write ours. The window is one RTT — the
+        atomic form is what the fake backend provides the chaos proofs."""
+        self._check_fence(epoch, fence_lease)
+        annots = self.get_pod_annotations(pod, ns)
+        if annots is None:
+            return False
+        rec = parse_spill_record(annots.get(SPILLOVER_ANNOTATION))
+        cur = rec.get("claim")
+        if cur is not None and cur != (claim_lease, claim_epoch):
+            view = self.lease_read(cur[0])
+            import time as _time
+
+            if (
+                view is not None and view.holder
+                and view.expires > _time.time()
+                and view.epoch == cur[1]
+            ):
+                return False  # live foreign claim
+        rec["claim"] = (claim_lease, claim_epoch)
+        return self._patch_annotation(
+            pod, ns, {SPILLOVER_ANNOTATION: render_spill_record(rec)}
+        )
+
     def bind_pod_to_node(
-        self, pod: str, node: str, ns: str, *, epoch: Optional[int] = None
+        self, pod: str, node: str, ns: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         """V1Binding; the known kubernetes-client ValueError on the empty
         response is swallowed like the reference does (K8SMgr.py:487-491)."""
-        self._check_fence(epoch)
+        self._check_fence(epoch, fence_lease)
         client = self._client
         body = client.V1Binding(
             metadata=client.V1ObjectMeta(name=pod),
@@ -912,6 +988,13 @@ class KubeClusterBackend(ClusterBackend):
                 f"lease replace for {name} failed: {exc}"
             ) from exc
 
+    def _viewed(self, name: str, obj: dict) -> LeaseView:
+        """_lease_view_of plus the epoch high-water-mark note — every
+        lease state returned to a caller also tightens the fence."""
+        view = self._lease_view_of(name, obj)
+        self._note_lease_epoch(name, view)
+        return view
+
     def lease_try_acquire(self, name: str, holder: str, ttl: float) -> LeaseView:
         import time as _time
 
@@ -929,7 +1012,7 @@ class KubeClusterBackend(ClusterBackend):
                     self._LEASE_GROUP, self._LEASE_VERSION, self._lease_ns,
                     self._LEASE_PLURAL, body,
                 )
-                return self._lease_view_of(name, created)
+                return self._viewed(name, created)
             except self._client.exceptions.ApiException as exc:
                 if getattr(exc, "status", None) != 409:
                     raise TransientBackendError(
@@ -940,7 +1023,7 @@ class KubeClusterBackend(ClusterBackend):
                     raise TransientBackendError(
                         f"lease {name} vanished mid-acquisition"
                     ) from exc
-        view = self._lease_view_of(name, obj)
+        view = self._viewed(name, obj)
         if view.holder and view.expires > now and view.holder != holder:
             return view   # held and live: the caller stays a follower
         body = dict(obj)
@@ -949,12 +1032,12 @@ class KubeClusterBackend(ClusterBackend):
         )
         replaced = self._lease_replace(name, body)
         if replaced is not None:
-            return self._lease_view_of(name, replaced)
+            return self._viewed(name, replaced)
         # CAS lost: someone else took it between our read and write —
         # report THEIR state so the caller correctly stays a follower
         obj = self._lease_get_raw(name)
         return (
-            self._lease_view_of(name, obj) if obj is not None
+            self._viewed(name, obj) if obj is not None
             else LeaseView(name=name, holder="", epoch=view.epoch, expires=0.0)
         )
 
@@ -964,7 +1047,7 @@ class KubeClusterBackend(ClusterBackend):
         obj = self._lease_get_raw(name)
         if obj is None:
             return False
-        view = self._lease_view_of(name, obj)
+        view = self._viewed(name, obj)
         if view.holder != holder or view.epoch != epoch:
             return False
         body = dict(obj)
@@ -985,14 +1068,14 @@ class KubeClusterBackend(ClusterBackend):
         obj = self._lease_get_raw(name)
         if obj is None:
             return False
-        cur = self._lease_view_of(name, obj)
+        cur = self._viewed(name, obj)
         return cur.holder == holder and cur.epoch == epoch
 
     def lease_release(self, name: str, holder: str, epoch: int) -> bool:
         obj = self._lease_get_raw(name)
         if obj is None:
             return False
-        view = self._lease_view_of(name, obj)
+        view = self._viewed(name, obj)
         if view.holder != holder or view.epoch != epoch:
             return False
         body = dict(obj)
@@ -1003,7 +1086,15 @@ class KubeClusterBackend(ClusterBackend):
 
     def lease_read(self, name: str) -> Optional[LeaseView]:
         obj = self._lease_get_raw(name)
-        return self._lease_view_of(name, obj) if obj is not None else None
+        return self._viewed(name, obj) if obj is not None else None
+
+    def lease_live(self, name: str) -> str:
+        import time as _time
+
+        view = self.lease_read(name)
+        if view is None or not view.holder:
+            return ""
+        return view.holder if view.expires > _time.time() else ""
 
     # ------------------------------------------------------------------
     # TriadSets (CRD group/version per deploy/triad-crd.1.16.yaml)

@@ -38,10 +38,11 @@ tests/test_retry.py).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Set
 
 from nhd_tpu.k8s.interface import LEASE_NAME, ClusterBackend, TransientBackendError
 from nhd_tpu.k8s.retry import API_COUNTERS, ApiCounters
@@ -307,3 +308,337 @@ class StallWatchdog(threading.Thread):
 
     def stop(self) -> None:
         self._stop_event.set()
+
+
+# ---------------------------------------------------------------------------
+# Sharded federation: the single LEASE_NAME generalized to a shard table
+# ---------------------------------------------------------------------------
+#
+# PR 5's machinery supports exactly one active leader for the whole fleet:
+# one wedged or partitioned replica stalls every node group at once. The
+# federation splits the node-group set into S **shards**, each backed by
+# its own coordination Lease with an independent fencing epoch, so N
+# replicas share the control plane: a replica may hold several shards,
+# every fenced write carries the epoch OF THE SHARD OWNING THE TARGET
+# NODE, and losing one replica costs only its shards' node groups for one
+# handoff — not the fleet (docs/RESILIENCE.md "Federation").
+
+#: how many ticks a non-preferred replica waits on an unheld shard lease
+#: before acquiring it anyway (the rendezvous-preferred owner is wedged,
+#: partitioned, or gone); bounds the per-shard leadership gap at
+#: TTL + patience ticks
+SHARD_PATIENCE_TICKS = int(os.environ.get("NHD_SHARD_PATIENCE_TICKS", "2"))
+
+
+def shard_lease_name(shard: int, n_shards: int) -> str:
+    """The coordination Lease backing one shard. S=1 degenerates to the
+    PR 5 single lease — a one-shard federation is byte-identical on the
+    wire to `--ha` (the regression pin in tests/test_ha.py)."""
+    if n_shards == 1:
+        return LEASE_NAME
+    return f"{LEASE_NAME}-s{shard}"
+
+
+def presence_lease_name(identity: str) -> str:
+    """Per-replica liveness beacon: each federation member renews its own
+    presence lease every tick, and peers treat a member as live while the
+    beacon is unexpired. This is what lets the current holder of a shard
+    notice a freshly joined preferred owner and hand the shard over —
+    a replica that holds no shard yet would otherwise be invisible."""
+    return f"nhd-scheduler-presence-{identity}"
+
+
+def _hrw(*parts: object) -> int:
+    """Deterministic 64-bit weight for rendezvous hashing — hashlib, not
+    hash(): assignments must agree across processes and PYTHONHASHSEED."""
+    h = hashlib.blake2s(
+        "|".join(str(p) for p in parts).encode(), digest_size=8
+    )
+    return int.from_bytes(h.digest(), "big")
+
+
+def shard_for_group(group: str, n_shards: int) -> int:
+    """group → shard via highest-random-weight over shard ids: resizing
+    the federation moves only ~1/S of the groups."""
+    if n_shards <= 1:
+        return 0
+    return max(range(n_shards), key=lambda s: (_hrw("grp", group, s), s))
+
+
+def shard_for_groups(groups: Iterable[str], n_shards: int) -> int:
+    """A node's (or pod request's) home shard. Nodes can carry several
+    groups and a pod can request several; the lexicographic minimum is
+    the deterministic tiebreak both sides agree on — a pod whose groups
+    straddle shards lands in ONE home shard and reaches the others
+    through the spillover queue."""
+    groups = sorted(groups)
+    return shard_for_group(groups[0] if groups else "default", n_shards)
+
+
+def rendezvous_owner(shard: int, identities: Iterable[str]) -> Optional[str]:
+    """The replica that SHOULD hold this shard among the live members —
+    highest-random-weight, so membership changes reassign only the dead
+    member's shards and every replica computes the same answer with no
+    coordinator."""
+    ids = sorted(set(identities))
+    if not ids:
+        return None
+    return max(ids, key=lambda i: (_hrw("own", shard, i), i))
+
+
+# replica-local shard ownership snapshot for the metrics plane
+# (rpc/metrics.py renders nhd_shard_epoch{shard=...}); one process runs
+# one replica in production, so module state is the right scope
+_SHARD_STATUS_LOCK = threading.Lock()
+_SHARD_STATUS: Dict[str, object] = {"identity": "", "n_shards": 0, "owned": {}}
+
+
+def publish_shard_status(
+    identity: str, n_shards: int, owned: Dict[int, int]
+) -> None:
+    with _SHARD_STATUS_LOCK:
+        _SHARD_STATUS["identity"] = identity
+        _SHARD_STATUS["n_shards"] = n_shards
+        _SHARD_STATUS["owned"] = dict(owned)
+
+
+def shard_status_snapshot() -> Dict[str, object]:
+    with _SHARD_STATUS_LOCK:
+        return {
+            "identity": _SHARD_STATUS["identity"],
+            "n_shards": _SHARD_STATUS["n_shards"],
+            "owned": dict(_SHARD_STATUS["owned"]),  # type: ignore[arg-type]
+        }
+
+
+class _MonotonicOnly:
+    """Counter surface handed to a :class:`ShardedElector`'s inner
+    electors: monotonic renewal counters forward to the replica's shared
+    registry (S leases' renewals/failures sum meaningfully on /metrics),
+    everything else is dropped — S electors would thrash the
+    ha_is_leader/ha_epoch gauges, and per-lease acquire/step-down
+    transitions would double-count against the replica-level
+    ha_transitions_total that ``_publish()`` maintains."""
+
+    _FORWARD = frozenset({"ha_renewals_total", "ha_renewal_failures_total"})
+
+    def __init__(self, registry: ApiCounters):
+        self._registry = registry
+
+    def inc(self, name: str, by: float = 1) -> None:
+        if name in self._FORWARD:
+            self._registry.inc(name, by)
+
+    def set(self, name: str, value: float) -> None:
+        pass
+
+    def get(self, name: str) -> float:
+        return self._registry.get(name)
+
+
+class ShardedElector:
+    """One replica's membership in the shard federation: a presence
+    beacon plus one :class:`LeaderElector` per shard lease.
+
+    ``tick()`` runs the whole protocol:
+
+    1. renew the presence beacon (peer-visible liveness);
+    2. compute the live member set from the peers' beacons;
+    3. per shard — owners renew (the PR 5 grace/CAS semantics,
+       unchanged, via the inner elector); the rendezvous-preferred
+       member acquires unheld/expired shards immediately; everyone else
+       waits out a small **patience** budget before grabbing an orphaned
+       shard (so the preferred owner wins the common case but a wedged
+       one never strands a shard past TTL + patience ticks);
+    4. **bounded handoff**: a holder that sees a live better-ranked
+       member releases AT MOST ONE shard per tick to it — rebalance
+       converges in a few ticks without a thundering mass-release, and
+       each handed-off shard goes through the new owner's scoped
+       promotion replay before any write (scheduler/core.py).
+
+    Fencing is per shard: ``fencing_epoch_for(shard)`` is the token a
+    write targeting that shard's nodes must carry, and a replica holds
+    several tokens at once. ``is_leader`` reports shard 0 — the
+    federation's **coordinator shard**, which owns the cluster-scoped
+    duties exactly one replica may run (TriadSet reconciliation).
+    """
+
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        *,
+        identity: str,
+        peers: Iterable[str],
+        n_shards: int,
+        ttl: float = LEASE_TTL_SEC,
+        clock: Callable[[], float] = time.monotonic,
+        counters: ApiCounters = API_COUNTERS,
+        patience: int = SHARD_PATIENCE_TICKS,
+    ):
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        self.backend = backend
+        self.identity = identity
+        self.peers: List[str] = sorted(set(peers) | {identity})
+        self.n_shards = n_shards
+        self.ttl = ttl
+        self.patience = patience
+        self.logger = get_logger(__name__)
+        self._counters = counters
+        # inner electors write through a forwarding surface: monotonic
+        # inc()s (renewals, renewal failures, transitions) land in the
+        # replica's shared registry — S leases' renewal traffic SUMS
+        # meaningfully, and operators alerting on
+        # nhd_ha_renewal_failures_total keep their signal under
+        # federation — while gauge set()s are dropped (S electors would
+        # thrash ha_is_leader/ha_epoch; _publish() writes the
+        # replica-level truth for those instead)
+        inner_counters = _MonotonicOnly(counters)
+        self._electors: Dict[int, LeaderElector] = {
+            s: LeaderElector(
+                backend, identity=identity,
+                lease_name=shard_lease_name(s, n_shards),
+                ttl=ttl, clock=clock, counters=inner_counters,
+            )
+            for s in range(n_shards)
+        }
+        self._presence = LeaderElector(
+            backend, identity=identity,
+            lease_name=presence_lease_name(identity),
+            ttl=ttl, clock=clock, counters=inner_counters,
+        )
+        self._patience_count: Dict[int, int] = {s: 0 for s in range(n_shards)}
+        self._last_live: Set[str] = {identity}
+
+    # -- thread-safe snapshots (inner electors own the locking) ---------
+
+    def owned_shards(self) -> Dict[int, int]:
+        """{shard: fencing epoch} for every shard this replica holds."""
+        out: Dict[int, int] = {}
+        for s, el in self._electors.items():
+            epoch = el.fencing_epoch()
+            if epoch is not None:
+                out[s] = epoch
+        return out
+
+    def fencing_epoch_for(self, shard: int) -> Optional[int]:
+        return self._electors[shard].fencing_epoch()
+
+    def fencing_epoch(self) -> Optional[int]:
+        """Single-lease compatibility surface (S=1 callers)."""
+        return self._electors[0].fencing_epoch()
+
+    def lease_name_of(self, shard: int) -> str:
+        return shard_lease_name(shard, self.n_shards)
+
+    @property
+    def is_leader(self) -> bool:
+        """Coordinator duties (TriadSet reconciliation) follow shard 0:
+        cluster-scoped writes still need exactly one author."""
+        return self._electors[0].is_leader
+
+    @property
+    def epoch(self) -> int:
+        """Highest epoch among owned shards (logging/metrics figure; the
+        per-shard tokens are what fencing actually uses)."""
+        return max(self.owned_shards().values(), default=0)
+
+    # -- the protocol ---------------------------------------------------
+
+    def tick(self) -> bool:
+        """One federation step; returns True when any shard is held.
+        Backend faults never escape — an unreachable API server degrades
+        to the inner electors' grace/expiry outcomes."""
+        owned_before = set(self.owned_shards())
+        self._presence.tick()
+        live = self._live_members()
+        handed_off = False
+        for s in range(self.n_shards):
+            el = self._electors[s]
+            preferred = rendezvous_owner(s, live)
+            if el.is_leader:
+                el.tick()
+                if (
+                    el.is_leader
+                    and preferred != self.identity
+                    and not handed_off
+                ):
+                    # bounded handoff: a live better-ranked member exists;
+                    # release at most one shard per tick so rebalance
+                    # never dumps a replica's whole shard set at once
+                    self.logger.warning(
+                        f"{self.identity}: handing shard {s} to {preferred}"
+                    )
+                    el.step_down()
+                    handed_off = True
+                    self._counters.inc("shard_handoffs_total")
+                self._patience_count[s] = 0
+                continue
+            if preferred == self.identity:
+                if el.tick():
+                    self._counters.inc("shard_acquisitions_total")
+                self._patience_count[s] = 0
+                continue
+            # not ours by preference: grab it only once it has sat
+            # unheld past the patience budget (the preferred member is
+            # wedged, partitioned, or its beacon hasn't expired yet)
+            try:
+                held = bool(
+                    self.backend.lease_live(self.lease_name_of(s))
+                )
+            except TransientBackendError:
+                held = True  # unverifiable: don't spend patience on it
+            if held:
+                self._patience_count[s] = 0
+            else:
+                self._patience_count[s] += 1
+                if self._patience_count[s] > self.patience and el.tick():
+                    self._counters.inc("shard_acquisitions_total")
+                    self._patience_count[s] = 0
+        self._publish(owned_before)
+        return bool(self.owned_shards())
+
+    def _live_members(self) -> Set[str]:
+        """Members with an unexpired presence beacon (plus ourselves).
+        An unverifiable peer counts as absent: wrongly absent costs a
+        bounded patience delay, wrongly live could strand a shard on a
+        dead member forever."""
+        live: Set[str] = {self.identity}
+        for peer in self.peers:
+            if peer == self.identity:
+                continue
+            try:
+                if self.backend.lease_live(presence_lease_name(peer)) == peer:
+                    live.add(peer)
+            except TransientBackendError:
+                pass
+        self._last_live = live
+        return live
+
+    def release_shard(self, shard: int) -> None:
+        """Give one shard back (failed scoped promotion replay: leading a
+        shard without replayed state violates the crash-only contract)."""
+        self._electors[shard].step_down()
+        self._publish(set(self.owned_shards()) | {shard})
+
+    def step_down(self) -> None:
+        """Clean exit: release every shard and the presence beacon so
+        peers rebalance in one tick instead of waiting out the TTL."""
+        owned_before = set(self.owned_shards())
+        for el in self._electors.values():
+            el.step_down()
+        self._presence.step_down()
+        self._publish(owned_before)
+
+    def _publish(self, owned_before: Set[int]) -> None:
+        owned = self.owned_shards()
+        if set(owned) != owned_before:
+            self._counters.inc("ha_transitions_total")
+        # the replica-level generalization of the single-leader gauges:
+        # "leader" now means "holds at least one shard", and the epoch
+        # gauge reports the highest held token (per-shard epochs are on
+        # nhd_shard_epoch{shard=...})
+        self._counters.set("shard_owned_count", len(owned))
+        self._counters.set("ha_is_leader", 1 if owned else 0)
+        self._counters.set("ha_epoch", max(owned.values(), default=0))
+        publish_shard_status(self.identity, self.n_shards, owned)

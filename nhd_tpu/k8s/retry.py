@@ -97,11 +97,17 @@ class ApiCounters:
              "Scheduler run-loop passes isolated (mirror rebuilt after)"),
         "bind_requeues_total":
             ("counter", "Pods requeued after a transient commit failure"),
-        # HA plane (k8s/lease.py, docs/RESILIENCE.md "HA & fencing")
+        # HA plane (k8s/lease.py, docs/RESILIENCE.md "HA & fencing").
+        # Under the sharded federation the single-leader gauges
+        # generalize: ha_is_leader means "holds at least one shard" and
+        # ha_epoch reports the highest held shard token — the per-shard
+        # truth lives on the nhd_shard_* families below.
         "ha_is_leader":
-            ("gauge", "This replica currently holds the scheduler lease"),
+            ("gauge", "This replica holds the scheduler lease "
+                      "(federation: at least one shard lease)"),
         "ha_epoch":
-            ("gauge", "Fencing epoch of this replica's last leadership"),
+            ("gauge", "Fencing epoch of this replica's last leadership "
+                      "(federation: highest held shard epoch)"),
         "ha_transitions_total":
             ("counter", "Leadership transitions (promotions + demotions)"),
         "ha_renewals_total":
@@ -116,6 +122,34 @@ class ApiCounters:
             ("counter", "Stall-watchdog firings (lease released, exiting)"),
         "ha_watchdog_loop_age_seconds":
             ("gauge", "Age of the scheduling loop's last heartbeat"),
+        # shard federation plane (k8s/lease.py ShardedElector +
+        # scheduler/core.py spillover, docs/RESILIENCE.md "Federation");
+        # the per-shard epoch gauge nhd_shard_epoch{shard=...} is
+        # rendered from lease.shard_status_snapshot() in rpc/metrics.py
+        "shard_owned_count":
+            ("gauge", "Shard leases this replica currently holds"),
+        "shard_acquisitions_total":
+            ("counter", "Shard lease acquisitions (rendezvous-preferred "
+                        "or patience-expired takeovers)"),
+        "shard_handoffs_total":
+            ("counter", "Shards voluntarily handed to a better-ranked "
+                        "live member (bounded rebalance releases)"),
+        "shard_spillover_claims_total":
+            ("counter", "Cross-shard spillover pods claimed for a local "
+                        "placement attempt"),
+        "shard_spillover_spilled_total":
+            ("counter", "Pods spilled to the untried shards after no "
+                        "owned shard could place them"),
+        "shard_spillover_exhausted_total":
+            ("counter", "Spilled pods declared explicitly unschedulable "
+                        "(every shard tried, or the record aged out)"),
+        "shard_spillover_depth":
+            ("gauge", "Pending pods carrying a live spillover record"),
+        "shard_spillover_oldest_age_seconds":
+            ("gauge", "Age of the oldest live spillover record"),
+        "shard_spillover_orphan_age_max_seconds":
+            ("gauge", "High-water mark of spillover record age (the "
+                      "bounded-orphan-window observable)"),
     }
 
     def __init__(self) -> None:

@@ -87,6 +87,21 @@ def render_metrics(
             f"nhd_{name} {perf[name]}",
         ]
 
+    # shard federation: per-shard fencing epochs from the replica's
+    # ownership snapshot (k8s/lease.py publish_shard_status) — the
+    # labeled complement of the scalar nhd_shard_* families above
+    from nhd_tpu.k8s.lease import shard_status_snapshot
+
+    shard_status = shard_status_snapshot()
+    if shard_status["n_shards"]:
+        lines += [
+            "# HELP nhd_shard_epoch Fencing epoch of each shard lease "
+            "this replica holds (absent shards are not held)",
+            "# TYPE nhd_shard_epoch gauge",
+        ]
+        for shard, epoch in sorted(shard_status["owned"].items()):
+            lines.append(f'nhd_shard_epoch{{shard="{shard}"}} {epoch}')
+
     # latency distributions (obs/histo.py) — the last_* gauge replacement
     lines += render_histograms()
 

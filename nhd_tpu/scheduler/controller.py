@@ -59,7 +59,12 @@ class Controller(threading.Thread):
         # (the scheduler's standby path keeps its node mirror warm from
         # it), but TriadSet reconciliation MUTATES the cluster (pod
         # creation, status patches) and is gated on holding the lease —
-        # two replicas racing the same ordinal would double-create
+        # two replicas racing the same ordinal would double-create.
+        # Under federation the same gate takes a ShardedElector, whose
+        # ``is_leader`` reports the COORDINATOR shard (shard 0): TriadSet
+        # pods are cluster-scoped, so exactly one federation member owns
+        # their reconciliation regardless of how the node-group shards
+        # are spread (docs/RESILIENCE.md "Federation").
         self.elector = elector
         # per-event exception isolation: one poisoned event (truncated
         # object off a cut stream, a shape the translators never met) is
@@ -151,6 +156,24 @@ class Controller(threading.Thread):
             )
         )
 
+    def _coordinator_write(self, fn, *args) -> bool:
+        """THE coordinator-write chokepoint: every cluster-mutating call
+        the controller issues routes through here (nhdlint NHD501 flags
+        any that doesn't), re-checking coordinatorship AT the write —
+        not just at the top of the reconcile pass. A replica deposed (or
+        whose shard-0 lease handed off) mid-pass answers False for the
+        rest of its writes instead of racing the new coordinator's
+        reconciliation; the double-create that can still slip through
+        the check-to-write window is absorbed by the create's 409
+        idempotence, and status patches are last-writer-wins on a value
+        both coordinators compute identically."""
+        if self.elector is not None and not self.elector.is_leader:
+            self.logger.warning(
+                "coordinatorship lost mid-reconcile; dropping the write"
+            )
+            return False
+        return bool(fn(*args))
+
     def reconcile_triadsets(self) -> None:
         """Create any missing '{service}-{ordinal}' pods
         (reference: TriadController.py:87-120)."""
@@ -168,7 +191,9 @@ class Controller(threading.Thread):
                 name = f"{ts['service_name']}-{ordinal}"
                 if name not in existing:
                     self.logger.info(f"TriadSet {ts['name']}: creating pod {name}")
-                    if self.backend.create_pod_for_triadset(ts, ordinal):
+                    if self._coordinator_write(
+                        self.backend.create_pod_for_triadset, ts, ordinal
+                    ):
                         created += 1
             # scale-subresource status: observed count incl. this pass's
             # creations; skip no-op patches (each would bump the object's
@@ -178,7 +203,9 @@ class Controller(threading.Thread):
             if self._last_status.get(key) != observed:
                 # cache only acknowledged writes so a transient API failure
                 # retries next pass
-                if self.backend.update_triadset_status(ts, observed):
+                if self._coordinator_write(
+                    self.backend.update_triadset_status, ts, observed
+                ):
                     self._last_status[key] = observed
 
     # ------------------------------------------------------------------
@@ -207,7 +234,9 @@ class Controller(threading.Thread):
                     f"poisoned watch event dropped ({ev.kind} {ev.name!r})"
                 )
         if self.elector is not None and not self.elector.is_leader:
-            return  # standby: watch, don't act (leader owns TriadSets)
+            # standby: watch, don't act. Single-lease mode: the leader
+            # owns TriadSets; federation: the shard-0 coordinator does.
+            return
         t = time.monotonic() if now is None else now
         if t - self._last_triadset >= TRIADSET_PERIOD_SEC:
             self._last_triadset = t

@@ -16,19 +16,22 @@ import queue
 import threading
 import time
 from enum import Enum
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from nhd_tpu import NHD_SCHED_NAME
 from nhd_tpu.config.parser import CfgParser, get_cfg_parser
 from nhd_tpu.core.node import HostNode
 from nhd_tpu.core.request import PodRequest
 from nhd_tpu.k8s.interface import (
+    SPILLOVER_ANNOTATION,
     ClusterBackend,
     EventType,
     StaleLeaseError,
     TransientBackendError,
+    parse_spill_record,
+    render_spill_record,
 )
-from nhd_tpu.k8s.lease import LeaderElector
+from nhd_tpu.k8s.lease import LeaderElector, ShardedElector, shard_for_groups
 from nhd_tpu.k8s.retry import API_COUNTERS
 from nhd_tpu.obs import histo as obs_histo
 from nhd_tpu.obs.recorder import correlate, get_recorder, new_corr_id
@@ -95,6 +98,19 @@ COMMIT_WORKERS = int(os.environ.get("NHD_COMMIT_WORKERS", "1"))
 # outage degrades to the periodic-reconcile cadence instead of a hot
 # requeue loop against a down API server
 REQUEUE_MAX = int(os.environ.get("NHD_BIND_REQUEUE_MAX", "8"))
+
+# cross-shard spillover orphan bound (docs/RESILIENCE.md "Federation"):
+# a pod's spill record older than this is force-exhausted by its
+# home-shard owner — the pod gets its explicit unschedulable verdict and
+# a fresh cycle even when the shards that never tried it sit orphaned
+# mid-rebalance, so no spilled pod waits past a bounded window
+SPILLOVER_MAX_AGE_SEC = float(
+    os.environ.get("NHD_SPILLOVER_MAX_AGE_SEC", "120")
+)
+
+# _gate_pod sentinel: "spill record not read yet" — distinct from None,
+# which means the pod was unreadable (gone or API down)
+_SPILL_UNREAD = object()
 
 # unschedulable-pod explain budget for the flight recorder: with tracing
 # on, batches at or below EXPLAIN_MAX pods on clusters at or below
@@ -207,6 +223,8 @@ class Scheduler(threading.Thread):
         sched_name: str = NHD_SCHED_NAME,
         respect_busy: bool = True,
         elector: Optional[LeaderElector] = None,
+        sharded: Optional[ShardedElector] = None,
+        clock: Callable[[], float] = time.time,
     ):
         super().__init__(name="nhd-scheduler", daemon=True)
         self.logger = get_logger(__name__)
@@ -216,7 +234,28 @@ class Scheduler(threading.Thread):
         # lease; without one it is the reference's single-replica
         # stance — always acting, writes unfenced
         self.elector = elector
-        self._acting = elector is None
+        # federation mode (k8s/lease.py ShardedElector): the node-group
+        # set is partitioned into S shards, this replica leases a
+        # subset, and every commit is fenced by the epoch of the shard
+        # owning the TARGET NODE. "Acting" means "holds at least one
+        # shard"; pods are routed by their home shard, and pods no
+        # owned shard can place flow through the spillover queue
+        # (docs/RESILIENCE.md "Federation"). Mutually exclusive with
+        # ``elector`` — a one-shard federation IS the single lease.
+        self.sharded = sharded
+        if elector is not None and sharded is not None:
+            raise ValueError("pass elector OR sharded, not both")
+        self._acting = elector is None and sharded is None
+        # {shard: epoch} snapshot from the last leadership poll;
+        # poll_leadership diffs it to find freshly gained shards that
+        # need the scoped promotion replay before any write. The epoch
+        # matters: a shard lost and RE-acquired between polls comes back
+        # at a higher epoch (every acquisition bumps it), and its slice
+        # must replay — a rival may have bound pods in the interim
+        self._owned_prev: Dict[int, int] = {}
+        # injectable wall clock for spillover 'since' stamps (chaos runs
+        # drive the orphan window off the sim's step clock)
+        self._spill_clock = clock
         # loop-liveness heartbeat, observed by the stall watchdog
         # (k8s/lease.py StallWatchdog): refreshed at the top of every
         # run_once turn — the same turn the flight-recorder spans and
@@ -468,7 +507,11 @@ class Scheduler(threading.Thread):
 
         t_batch = time.perf_counter()
         t_batch_mono = time.monotonic()
-        if len(self.nodes) > STREAM_NODE_THRESH:
+        # under federation, solve only over the owned shards' nodes —
+        # commits onto them are fenceable; everything else is another
+        # replica's control plane
+        nodes_view = self._solve_nodes()
+        if len(nodes_view) > STREAM_NODE_THRESH:
             from nhd_tpu.solver.streaming import StreamingScheduler
 
             if self._stream is None:
@@ -482,7 +525,7 @@ class Scheduler(threading.Thread):
         else:
             solver = self.batch
         results, bstats = solver.schedule(
-            self.nodes, [item for _, item in prepared]
+            nodes_view, [item for _, item in prepared]
         )
         self._beat()   # one solve finished: loop progress, not a wedge
         self.perf["batches_total"] += 1
@@ -523,6 +566,12 @@ class Scheduler(threading.Thread):
         for (parser, item), result in zip(prepared, results):
             ns, pod = item.key
             if result.node is None:
+                if self.sharded is not None:
+                    # federation: "no candidate HERE" is not a verdict —
+                    # spill to the untried shards (the explicit failure
+                    # fires only once every shard has tried)
+                    self._spill_unplaced(pod, ns, corrs.get(item.key))
+                    continue
                 self.backend.generate_pod_event(
                     pod, ns, "FailedScheduling", EventType.WARNING,
                     f"No valid candidate nodes found for scheduling pod {pod}",
@@ -538,12 +587,12 @@ class Scheduler(threading.Thread):
                     )
                     if (
                         len(prepared) <= EXPLAIN_MAX
-                        and len(self.nodes) <= EXPLAIN_MAX_NODES
+                        and len(nodes_view) <= EXPLAIN_MAX_NODES
                     ):
                         # small batches on small clusters get the full
                         # rejection reason from the explainer (per-node
                         # first failing predicate)
-                        d["reasons"] = self._explain_summary(item)
+                        d["reasons"] = self._explain_summary(item, nodes_view)
                     rec.record_decision(d)
             else:
                 winners.append((parser, item, result))
@@ -647,14 +696,16 @@ class Scheduler(threading.Thread):
             "node": node, "phases": phases, "time": time.time(),
         }
 
-    def _explain_summary(self, item: BatchItem) -> dict:
+    def _explain_summary(
+        self, item: BatchItem, nodes: Optional[Dict[str, HostNode]] = None
+    ) -> dict:
         """Reason histogram from the unschedulability explainer — why the
         solver had no candidate node (reason → node count)."""
         from nhd_tpu.solver.explain import explain
 
         try:
             return explain(
-                self.nodes, item.request,
+                self.nodes if nodes is None else nodes, item.request,
                 respect_busy=self.batch.respect_busy,
             ).summary
         except Exception as exc:
@@ -771,20 +822,247 @@ class Scheduler(threading.Thread):
             )
         return epoch
 
-    def _commit_write(self, fn, *args):
+    def _commit_write(
+        self, fn, *args,
+        node: Optional[str] = None, shard: Optional[int] = None,
+    ):
         """THE fenced-commit chokepoint: every mutating backend call on
         the commit path routes through here (nhdlint NHD501 flags any
         that doesn't) so the current fencing epoch is stamped onto the
         write and a stale epoch is rejected BY THE BACKEND — a deposed
         leader's in-flight batch cannot land. StaleLeaseError subclasses
         TransientBackendError, so rejection unwinds onto the existing
-        requeue path and the new leader owns the pod's next attempt."""
+        requeue path and the new leader owns the pod's next attempt.
+
+        Under federation the fence is PER SHARD: the write is checked
+        against the lease of the shard owning the target ``node`` (or
+        the explicitly named ``shard`` for pod-level writes with no node,
+        e.g. the spillover record), so losing one shard fences exactly
+        that shard's in-flight commits and nothing else."""
+        if self.sharded is not None:
+            s = self._shard_for_commit(node, shard)
+            epoch = self.sharded.fencing_epoch_for(s)
+            if epoch is None:
+                raise StaleLeaseError(
+                    f"this replica no longer holds shard {s} "
+                    "(handed off or deposed mid-commit)"
+                )
+            return fn(
+                *args, epoch=epoch,
+                fence_lease=self.sharded.lease_name_of(s),
+            )
         epoch = self._fence_epoch()
         if epoch is None:
             # keep duck-typed test backends without the epoch kwarg
             # working in single-replica mode
             return fn(*args)
         return fn(*args, epoch=epoch)
+
+    # ------------------------------------------------------------------
+    # federation: shard routing + cross-shard spillover
+    # ------------------------------------------------------------------
+
+    def _owned_shards(self) -> Dict[int, int]:
+        """{shard: fencing epoch} this replica currently holds."""
+        return self.sharded.owned_shards() if self.sharded else {}
+
+    def _node_shard(self, node: HostNode) -> int:
+        """A node's home shard, from its live group set — group moves
+        re-home the node on the spot (both sides compute the same
+        deterministic answer, k8s/lease.py shard_for_groups)."""
+        return shard_for_groups(node.groups, self.sharded.n_shards)
+
+    def _shard_for_commit(
+        self, node: Optional[str], shard: Optional[int]
+    ) -> int:
+        if shard is not None:
+            return shard
+        if node is not None and node in self.nodes:
+            return self._node_shard(self.nodes[node])
+        # unknown target: refusing to guess keeps the fence sound — the
+        # transient path requeues and the scan retries with fresh state
+        raise StaleLeaseError(
+            f"cannot fence a write for unknown target node {node!r}"
+        )
+
+    def _solve_nodes(self) -> Dict[str, HostNode]:
+        """The nodes this replica may place onto: all of them outside
+        federation; under federation only the nodes whose home shard it
+        currently leases (commits onto them carry that shard's epoch)."""
+        if self.sharded is None:
+            return self.nodes
+        owned = set(self._owned_shards())
+        return {
+            name: node for name, node in self.nodes.items()
+            if self._node_shard(node) in owned
+        }
+
+    def _read_spill_record(self, pod: str, ns: str) -> Optional[dict]:
+        """The pod's parsed spillover record, or None when the pod is
+        unreadable (gone, or the API is down — skip it this pass)."""
+        try:
+            annots = self.backend.get_pod_annotations(pod, ns)
+        except TransientBackendError:
+            return None
+        if annots is None:
+            return None
+        return parse_spill_record(annots.get(SPILLOVER_ANNOTATION))
+
+    def _gate_pod(
+        self, pod: str, ns: str, now: float, rec: Any = _SPILL_UNREAD,
+    ) -> bool:
+        """May THIS replica drive this pending pod right now?
+
+        Home-shard pods with no spill record need no coordination —
+        home-shard ownership IS the mutual exclusion (and a handoff's
+        old/new owners racing the same home pod are serialized by that
+        one shard's epoch, exactly the PR 5 single-lease semantics). A
+        pod carrying a spill record is contended across shards: every
+        attempt must first win the annotation claim, fenced by the
+        claiming shard's epoch, which closes the cross-shard double-bind
+        hole. A record older than the orphan window is force-exhausted
+        by the home owner (explicit verdict + fresh cycle) so orphaned
+        shards mid-rebalance cannot strand a pod indefinitely."""
+        owned = set(self._owned_shards())
+        if not owned:
+            return False
+        if rec is _SPILL_UNREAD:
+            rec = self._read_spill_record(pod, ns)
+        if rec is None:
+            return False
+        try:
+            groups = self.backend.get_pod_node_groups(pod, ns)
+        except TransientBackendError:
+            return False
+        home = shard_for_groups(groups, self.sharded.n_shards)
+        if not rec["tried"] and rec["claim"] is None:
+            return home in owned
+        if (
+            home in owned and rec["since"] is not None
+            and now - rec["since"] > SPILLOVER_MAX_AGE_SEC
+        ):
+            self._declare_shards_exhausted(pod, ns, home, aged_out=True)
+            return False
+        untried = owned - rec["tried"]
+        if not untried:
+            return False
+        shard = min(untried)
+        epoch = self.sharded.fencing_epoch_for(shard)
+        if epoch is None:
+            return False
+        try:
+            got = self._commit_write(
+                self.backend.claim_spillover_pod, ns, pod,
+                self.sharded.lease_name_of(shard), epoch,
+                shard=shard,
+            )
+        except TransientBackendError:
+            return False
+        if got:
+            API_COUNTERS.inc("shard_spillover_claims_total")
+        return bool(got)
+
+    def _filter_responsible(
+        self, pods: List[Tuple[str, str, str]]
+    ) -> List[Tuple[str, str, str]]:
+        """Federation routing for a scan's pending set: keep the pods
+        this replica must drive, claim the spilled ones it can take, and
+        refresh the spillover gauges while walking."""
+        now = self._spill_clock()
+        out: List[Tuple[str, str, str]] = []
+        depth, oldest = 0, 0.0
+        for pod, ns, uid in pods:
+            rec = self._read_spill_record(pod, ns)
+            if rec is not None and rec["since"] is not None:
+                depth += 1
+                oldest = max(oldest, now - rec["since"])
+            # hand the record down — _gate_pod would otherwise re-issue
+            # the same annotation GET per pod per scan
+            if self._gate_pod(pod, ns, now, rec=rec):
+                out.append((pod, ns, uid))
+        API_COUNTERS.set("shard_spillover_depth", depth)
+        API_COUNTERS.set("shard_spillover_oldest_age_seconds", oldest)
+        if oldest > API_COUNTERS.get("shard_spillover_orphan_age_max_seconds"):
+            API_COUNTERS.set(
+                "shard_spillover_orphan_age_max_seconds", oldest
+            )
+        return out
+
+    def _spill_unplaced(self, pod: str, ns: str, corr: Optional[str]) -> None:
+        """No owned node could place this pod: extend its spillover
+        record with every shard this attempt covered, releasing our
+        claim so the next shard's owner can take it. Once every shard in
+        the federation has tried, the pod gets its explicit verdict and
+        the record resets — the next scan cycle starts a fresh window."""
+        owned = set(self._owned_shards())
+        rec = self._read_spill_record(pod, ns)
+        if rec is None or not owned:
+            return
+        rec["tried"] = set(rec["tried"]) | owned
+        rec["claim"] = None
+        if rec["since"] is None:
+            rec["since"] = self._spill_clock()
+        fence_shard = min(owned)
+        if rec["tried"] >= set(range(self.sharded.n_shards)):
+            self._declare_shards_exhausted(pod, ns, fence_shard,
+                                           aged_out=False)
+            outcome = "shards-exhausted"
+        else:
+            try:
+                self._commit_write(
+                    self.backend.annotate_pod_meta, ns, pod,
+                    SPILLOVER_ANNOTATION, render_spill_record(rec),
+                    shard=fence_shard,
+                )
+            except TransientBackendError as exc:
+                # best-effort: the periodic scan re-attempts, and an
+                # unwritten record just means the home owner retries
+                self.logger.warning(
+                    f"spill record write failed for {ns}/{pod}: {exc}"
+                )
+                return
+            API_COUNTERS.inc("shard_spillover_spilled_total")
+            self.backend.generate_pod_event(
+                pod, ns, "SpilloverScheduling", EventType.NORMAL,
+                f"No candidate in shards {sorted(owned)}; spilling "
+                f"{ns}/{pod} to the untried shards",
+            )
+            self.pod_state.pop((ns, pod), None)
+            outcome = "spilled"
+        rec_sink = get_recorder()
+        if rec_sink is not None:
+            rec_sink.record_decision(self._decision(pod, ns, corr, outcome))
+
+    def _declare_shards_exhausted(
+        self, pod: str, ns: str, fence_shard: int, *, aged_out: bool
+    ) -> None:
+        """The bounded-orphan-window verdict: every shard tried (or the
+        record aged out mid-rebalance) — the pod is EXPLICITLY
+        unschedulable for this cycle, never silently pending forever."""
+        why = (
+            "spillover record exceeded the orphan window"
+            if aged_out else
+            f"all {self.sharded.n_shards} shards tried"
+        )
+        self.backend.generate_pod_event(
+            pod, ns, "FailedScheduling", EventType.WARNING,
+            f"No valid candidate nodes found for scheduling pod {pod} "
+            f"in any shard ({why})",
+        )
+        API_COUNTERS.inc("shard_spillover_exhausted_total")
+        self.failed_schedule_count += 1
+        self.pod_state[(ns, pod)] = {
+            "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
+        }
+        try:
+            self._commit_write(
+                self.backend.annotate_pod_meta, ns, pod,
+                SPILLOVER_ANNOTATION, "", shard=fence_shard,
+            )
+        except TransientBackendError as exc:
+            self.logger.warning(
+                f"spill record reset failed for {ns}/{pod}: {exc}"
+            )
 
     def _commit_pod_calls_inner(self, parser: CfgParser, item: BatchItem, result) -> bool:
         ns, pod = item.key
@@ -797,7 +1075,7 @@ class Scheduler(threading.Thread):
         nic_indices = sorted({x[0] for x in (result.nic_list or [])})
         nad = ",".join(f"{x}@{x}" for x in node.nad_names_from_indices(nic_indices))
         if nad and not self._commit_write(
-            self.backend.add_nad_to_pod, pod, ns, nad
+            self.backend.add_nad_to_pod, pod, ns, nad, node=result.node
         ):
             self.logger.error(f"NAD annotation failed for {ns}/{pod}")
             return False
@@ -806,7 +1084,8 @@ class Scheduler(threading.Thread):
         gpu_map = parser.to_gpu_map()
 
         if gpu_map and not self._commit_write(
-            self.backend.annotate_pod_gpu_map, ns, pod, gpu_map
+            self.backend.annotate_pod_gpu_map, ns, pod, gpu_map,
+            node=result.node,
         ):
             self.backend.generate_pod_event(
                 pod, ns, "PodCfgFailed", EventType.WARNING,
@@ -815,7 +1094,8 @@ class Scheduler(threading.Thread):
             return False
 
         if not self._commit_write(
-            self.backend.annotate_pod_config, ns, pod, solved
+            self.backend.annotate_pod_config, ns, pod, solved,
+            node=result.node,
         ):
             self.backend.generate_pod_event(
                 pod, ns, "PodCfgFailed", EventType.WARNING,
@@ -828,7 +1108,8 @@ class Scheduler(threading.Thread):
         )
 
         if not self._commit_write(
-            self.backend.bind_pod_to_node, pod, result.node, ns
+            self.backend.bind_pod_to_node, pod, result.node, ns,
+            node=result.node,
         ):
             self.backend.generate_pod_event(
                 pod, ns, "FailedScheduling", EventType.WARNING,
@@ -886,6 +1167,9 @@ class Scheduler(threading.Thread):
                 self.pod_state[key] = {
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
                 }
+        if self.sharded is not None:
+            # federation routing: home-shard pods plus claimable spills
+            to_schedule = self._filter_responsible(to_schedule)
         if to_schedule:
             self.attempt_scheduling_batch(to_schedule)
 
@@ -1075,6 +1359,10 @@ class Scheduler(threading.Thread):
 
         elif item.type == WatchType.TRIAD_POD_CREATE:
             ns, pod, uid = item.pod["ns"], item.pod["name"], item.pod["uid"]
+            if self.sharded is not None and not self._gate_pod(
+                pod, ns, self._spill_clock()
+            ):
+                return  # another shard's owner drives this pod
             state = self.pod_state.get((ns, pod))
             if state and state["state"] == PodStatus.SCHEDULED:
                 if state["uid"] == uid:
@@ -1131,6 +1419,11 @@ class Scheduler(threading.Thread):
         self.load_deployed_configs()
         if self.elector is not None:
             self._acting = self.elector.is_leader
+        if self.sharded is not None:
+            # the full startup replay just claimed every bound pod, so
+            # shards already held by now are replayed by construction
+            self._owned_prev = dict(self._owned_shards())
+            self._acting = bool(self._owned_prev)
         if self._acting:
             self.check_pending_pods()
         # flush any watch events raised while we replayed existing pods
@@ -1150,7 +1443,14 @@ class Scheduler(threading.Thread):
         for pending pods) — the standby's possibly-stale mirror is never
         trusted, the cluster's annotations are the durable truth. A
         leader→standby flip just stops acting; in-flight commits are
-        fenced off by their stale epoch at the backend."""
+        fenced off by their stale epoch at the backend.
+
+        Under federation the same contract holds PER SHARD: freshly
+        gained shards run the promotion replay scoped to their node
+        slice before this replica writes a byte on their behalf, and a
+        failed scoped replay hands those shards back."""
+        if self.sharded is not None:
+            return self._poll_shard_leadership()
         if self.elector is None:
             return True
         lead = self.elector.is_leader
@@ -1196,6 +1496,106 @@ class Scheduler(threading.Thread):
         self._missing_once.clear()
         self._requeue_attempts.clear()
         self.load_deployed_configs()
+        self._beat()
+        self.check_pending_pods()
+
+    def _poll_shard_leadership(self) -> bool:
+        """The federation form of poll_leadership: diff the owned shard
+        set against the last poll; gained shards run the SCOPED
+        promotion replay (and are handed back if it fails — a shard is
+        never led without replayed state), lost shards just stop being
+        acted on (their in-flight commits are fenced off by epoch).
+
+        The diff is EPOCH-aware, not a set diff: a shard that lapsed and
+        was re-acquired between polls (keeper thread demoted + re-won
+        while the loop sat in a long solve) shows the same shard id at a
+        HIGHER epoch. A rival may have bound pods during the lapse, so
+        holding the current epoch is not enough — the mirror is stale in
+        a way fencing cannot catch, and the slice must replay."""
+        owned = dict(self._owned_shards())
+        gained = {
+            s for s, ep in owned.items() if self._owned_prev.get(s) != ep
+        }
+        lost = set(self._owned_prev) - set(owned)
+        if lost:
+            self.logger.warning(
+                f"shards {sorted(lost)} handed off or lost; their "
+                "in-flight commits are fenced off by epoch"
+            )
+        if gained:
+            self.logger.warning(
+                f"gained shards {sorted(gained)}; replaying their slice "
+                "of cluster state from annotations"
+            )
+            if self._guarded(
+                "shard promotion replay",
+                self._shard_promotion_replay, gained,
+            ):
+                API_COUNTERS.inc("ha_promotions_total")
+            else:
+                # the crash-only contract holds per shard: leading a
+                # shard whose state never replayed is wrong — give the
+                # gained shards back so a healthy replica (or a later,
+                # successful tick) takes them
+                self.logger.error(
+                    "shard promotion replay failed; releasing "
+                    f"gained shards {sorted(gained)}"
+                )
+                for s in gained:
+                    self.sharded.release_shard(s)
+                    owned.pop(s, None)
+        self._owned_prev = owned
+        self._acting = bool(owned)
+        return self._acting
+
+    def _shard_promotion_replay(self, gained: Set[int]) -> None:
+        """The PR 5 promotion replay scoped to freshly gained shards:
+        rebuild THOSE shards' node slice from the cluster (a cordon or
+        group move the previous owner saw last must not survive the
+        handoff), re-claim their bound pods from solved-config
+        annotations, then scan. Nodes on shards this replica already
+        held keep their live mirror — gaining one shard must not pay a
+        fleet-wide replay."""
+        old = self.nodes
+        self.nodes = {}
+        try:
+            self.build_initial_node_list()
+            self._beat()
+            fresh = self.nodes
+            merged: Dict[str, HostNode] = {}
+            for name, node in fresh.items():
+                prev = old.get(name)
+                # shard membership judged on the FRESH labels: a node
+                # that group-moved into a gained shard gets the fresh
+                # (replayed) state, one that never left our held shards
+                # keeps its live mirror
+                if prev is not None and self._node_shard(node) not in gained:
+                    merged[name] = prev
+                else:
+                    merged[name] = node
+            self.nodes = merged
+            self._missing_once.clear()
+            for pod, ns, uid, phase in self.backend.get_scheduled_pods(
+                self.sched_name
+            ):
+                if phase not in ("Running", "CrashLoopBackOff", "Pending"):
+                    continue
+                node_name = self.backend.get_pod_node(pod, ns)
+                node = self.nodes.get(node_name or "")
+                if node is None or self._node_shard(node) not in gained:
+                    continue
+                self.pod_state.pop((ns, pod), None)
+                self._requeue_attempts.pop((ns, pod), None)
+                self.claim_pod_resources(pod, ns, uid)
+        except BaseException:
+            # a failed replay releases only the GAINED shards — the
+            # held shards keep leading, so their live mirror must
+            # survive the failure intact. Restoring the pre-replay map
+            # is sound: held-shard nodes are the very same objects
+            # (replay claims touch only gained-shard nodes, which are
+            # fresh objects discarded with the exception)
+            self.nodes = old
+            raise
         self._beat()
         self.check_pending_pods()
 

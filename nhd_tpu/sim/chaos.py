@@ -26,6 +26,22 @@ cluster is never headless for longer than lease expiry + a few ticks).
 Restarts additionally assert **state equivalence**: the re-replayed
 claims must equal the pre-restart claims (and the cluster's own bound
 set), not merely satisfy the invariants.
+
+With ``federation=S`` the sim becomes the **shard-federation harness**
+(docs/RESILIENCE.md "Federation"): ``n_replicas`` complete replicas,
+each with a ShardedElector over S shard leases, share one fake cluster.
+Each replica sees the watch stream through its own vantage (the single
+stream fans out, with per-replica drop/poison faults), the ``fed-*``
+profiles add per-shard lease faults plus ASYMMETRIC partitions (one
+replica's API calls all fail and its watch goes silent while the rest
+keep working), and kill/restart waves take whole replicas down for
+steps at a time. Three federation invariants join the standing set:
+**no pod uid is ever bound under two shard epochs** (the bind log
+records the fencing lease of every landed bind), **per-shard
+leadership gaps are bounded** (no shard is ownerless past lease expiry
+plus rendezvous patience plus the fault windows), and **no spilled pod
+outlives the orphan window** (every cross-shard spillover pod is
+placed or explicitly declared unschedulable within a bounded age).
 """
 
 from __future__ import annotations
@@ -34,14 +50,26 @@ import json
 import queue
 import random
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from nhd_tpu.k8s.fake import FakeClusterBackend
-from nhd_tpu.k8s.interface import LEASE_NAME
-from nhd_tpu.k8s.lease import LeaderElector
+from nhd_tpu.k8s.interface import (
+    LEASE_NAME,
+    SPILLOVER_ANNOTATION,
+    TransientBackendError,
+    WatchEvent,
+    parse_spill_record,
+)
+from nhd_tpu.k8s.lease import (
+    SHARD_PATIENCE_TICKS,
+    LeaderElector,
+    ShardedElector,
+    shard_for_group,
+    shard_lease_name,
+)
 from nhd_tpu.k8s.retry import ApiCounters
 from nhd_tpu.scheduler.controller import Controller
-from nhd_tpu.scheduler.core import Scheduler
+from nhd_tpu.scheduler.core import SPILLOVER_MAX_AGE_SEC, Scheduler
 from nhd_tpu.scheduler.events import WatchQueue
 from nhd_tpu.sim.faults import FaultProfile, FaultyBackend
 from nhd_tpu.sim.synth import SynthNodeSpec, make_node_labels, make_triad_config
@@ -49,6 +77,10 @@ from nhd_tpu.sim.synth import SynthNodeSpec, make_node_labels, make_triad_config
 # one chaos step advances the sim clock this much (the controller's
 # TriadSet cadence and, in HA mode, lease expiry both run off it)
 STEP_SEC = 10.0
+
+# kill/restart waves leave a federation replica down for at most this
+# many steps before its fresh incarnation rejoins (crash-only restart)
+KILL_DOWN_MAX_STEPS = 2
 
 
 @dataclass
@@ -66,7 +98,120 @@ class ChaosStats:
     # the longest stretch of steps with no replica believing it leads
     lease_epoch: int = 0
     max_leader_gap: int = 0
+    # federation mode (federation=S): per-shard epoch high-water marks,
+    # the longest ownerless stretch of any one shard, fault/chaos action
+    # tallies, and the spillover lifecycle counters
+    shard_epochs: Dict[int, int] = field(default_factory=dict)
+    max_shard_gap: int = 0
+    partitions: int = 0
+    kill_waves: int = 0
+    spilled: int = 0
+    spillover_exhausted: int = 0
+    max_spill_age_sec: float = 0.0
     violations: List[str] = field(default_factory=list)
+
+
+def _fed_group_pool(n_shards: int) -> List[str]:
+    """Deterministic node-group names whose rendezvous shards cover every
+    shard id, so a federation storm exercises ALL S shard leases (with
+    only 'default'/'edge' and small S, whole shards would sit empty)."""
+    pool: List[str] = ["default", "edge"]
+    covered = {shard_for_group(g, n_shards) for g in pool}
+    i = 0
+    while len(covered) < n_shards and i < 512:
+        name = f"g{i}"
+        i += 1
+        s = shard_for_group(name, n_shards)
+        if s not in covered:
+            pool.append(name)
+            covered.add(s)
+    return pool
+
+
+class _FedVantage:
+    """One replica's view of the shared cluster under federation chaos:
+    a private watch-event feed (the sim fans the single stream out to
+    every replica, like each replica owning its own watch connection)
+    and an asymmetric-partition switch — while ``partition_left`` > 0,
+    every API call this replica issues raises TransientBackendError and
+    its watch stream is silent, while the rest of the federation keeps
+    working against the same cluster."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._feed: List[WatchEvent] = []
+        self.partition_left = 0
+
+    def feed(self, events: List[WatchEvent]) -> None:
+        self._feed.extend(events)
+
+    def poll_watch_events(self, timeout: float = 0.0) -> List[WatchEvent]:
+        if self.partition_left > 0:
+            return []
+        out, self._feed = self._feed, []
+        return out
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if callable(attr) and self.partition_left > 0:
+            def _partitioned(*args, **kwargs):
+                raise TransientBackendError(
+                    f"asymmetric partition: {name} unreachable"
+                )
+
+            return _partitioned
+        return attr
+
+
+class _FedReplica:
+    """One federation member: ShardedElector + scheduler + controller
+    behind a partitionable vantage, with its own seeded fault stream —
+    what one pod of the N-replica/S-shard Deployment recipe runs
+    (docs/OPERATIONS.md)."""
+
+    def __init__(self, sim: "ChaosSim", ident: str, peers: List[str],
+                 incarnation: int):
+        self.ident = ident
+        self.dead_for = 0
+        if sim.fed_profile is not None:
+            # per-replica fault stream, reseeded per incarnation so a
+            # restarted replica doesn't replay its predecessor's rolls
+            self.faulty: Optional[FaultyBackend] = FaultyBackend(
+                sim.base, sim.fed_profile,
+                random.Random(sim.seed * 1000003 + 7919 * incarnation),
+            )
+        else:
+            self.faulty = None
+        self.vantage = _FedVantage(self.faulty or sim.base)
+        self.elector = ShardedElector(
+            self.vantage, identity=ident, peers=peers,
+            n_shards=sim.n_shards, ttl=sim.lease_ttl,
+            clock=sim.sim_clock, counters=ApiCounters(),
+        )
+        self.sched = Scheduler(
+            self.vantage, WatchQueue(), queue.Queue(),
+            respect_busy=False, sharded=self.elector, clock=sim.sim_clock,
+        )
+        self.controller = Controller(
+            self.vantage, self.sched.nqueue,
+            isolate_events=sim.hardened, elector=self.elector,
+        )
+        self.sched.build_initial_node_list()
+        self.sched.load_deployed_configs()
+
+    def truly_owned(self, sim: "ChaosSim") -> Set[int]:
+        """The shards this replica believes it holds AND the lease
+        agrees (not a stale believer) — the scope within which its
+        mirror must agree with the cluster."""
+        out: Set[int] = set()
+        for s, epoch in self.elector.owned_shards().items():
+            view = sim.base.lease_read(shard_lease_name(s, sim.n_shards))
+            if (
+                view is not None and view.holder == self.ident
+                and view.epoch == epoch
+            ):
+                out.add(s)
+        return out
 
 
 class _Replica:
@@ -111,6 +256,10 @@ class ChaosSim:
     tests can demonstrate that the same storm kills an unhardened stack.
     ``ha=True`` runs TWO replicas against the shared backend under
     leader election (split-brain mode; see the module docstring).
+    ``federation=S`` runs ``n_replicas`` replicas over S shard leases
+    (the shard-federation harness; see the module docstring) — S=1 is
+    the single-lease degenerate case, behavior-equivalent on the wire
+    to ``ha=True`` (the regression pin in tests/test_ha.py).
     """
 
     def __init__(
@@ -121,34 +270,60 @@ class ChaosSim:
         api_faults: Optional[FaultProfile] = None,
         hardened: bool = True,
         ha: bool = False,
+        federation: int = 0,
+        n_replicas: int = 3,
         lease_ttl: float = 3 * STEP_SEC,
     ):
+        if ha and federation:
+            raise ValueError("ha=True and federation=S are exclusive modes")
+        self.seed = seed
         self.rng = random.Random(seed)
         self.hardened = hardened
         self.ha = ha
+        self.federation = int(federation or 0)
+        self.n_shards = self.federation
         self.lease_ttl = lease_ttl
         self._now = 0.0
         base = FakeClusterBackend()
         # lease expiry runs off the sim's step clock, not wall time —
         # a failing seed replays exactly
         base.clock = self.sim_clock
-        if api_faults is not None:
+        self.base = base
+        self.fed_profile = api_faults if self.federation else None
+        if api_faults is not None and not self.federation:
             # the fault RNG is its own seeded stream: fault timing stays
             # reproducible without perturbing the churn sequence
             self.backend = FaultyBackend(
                 base, api_faults, random.Random(seed + 7919)
             )
         else:
+            # federation: faults are PER REPLICA (each member has its own
+            # seeded FaultyBackend behind its vantage); the sim's own
+            # handle stays the bare cluster
             self.backend = base
+        if self.federation:
+            self.group_pool = _fed_group_pool(self.federation)
         for i in range(n_nodes):
             spec = SynthNodeSpec(name=f"node{i}")
+            if self.federation:
+                # spread node groups so every shard lease fronts nodes
+                spec.groups = self.group_pool[i % len(self.group_pool)]
             self.backend.add_node(
                 spec.name, make_node_labels(spec), hugepages_gb=spec.hugepages_gb
             )
         self.stats = ChaosStats()
         self._pod_seq = 0
         self._leader_gap = 0
-        if self.ha:
+        if self.federation:
+            self._peers = [f"fed-{chr(ord('a') + i)}" for i in range(n_replicas)]
+            self._shard_gap = {s: 0 for s in range(self.n_shards)}
+            self._incarnations = 0
+            self._retired_faults: Dict[str, int] = {}
+            self.replicas = [
+                _FedReplica(self, ident, self._peers, self._next_incarnation())
+                for ident in self._peers
+            ]
+        elif self.ha:
             self.replicas = [
                 _Replica(self, "sched-a"), _Replica(self, "sched-b")
             ]
@@ -157,6 +332,38 @@ class ChaosSim:
 
     def sim_clock(self) -> float:
         return self._now
+
+    def _next_incarnation(self) -> int:
+        self._incarnations += 1
+        return self._incarnations
+
+    def _replace_replica(self, idx: int) -> None:
+        """Crash-only replacement: bank the dead incarnation's fault
+        tallies, then rejoin under the same identity with a fresh
+        elector (re-acquisitions bump every shard epoch, fencing the old
+        incarnation's in-flight writes)."""
+        old = self.replicas[idx]
+        if old.faulty is not None:
+            for k, n in old.faulty.fault_stats.items():
+                self._retired_faults[k] = self._retired_faults.get(k, 0) + n
+        self.replicas[idx] = _FedReplica(
+            self, old.ident, self._peers, self._next_incarnation()
+        )
+
+    def fault_totals(self) -> Dict[str, int]:
+        """Injected-fault tallies across the whole run (federation mode
+        sums every replica incarnation's stream)."""
+        if self.federation:
+            tot = dict(self._retired_faults)
+            for r in self.replicas:
+                if r.faulty is None:
+                    continue
+                for k, n in r.faulty.fault_stats.items():
+                    tot[k] = tot.get(k, 0) + n
+            return tot
+        if isinstance(self.backend, FaultyBackend):
+            return dict(self.backend.fault_stats)
+        return {}
 
     def _fresh_scheduler(self) -> None:
         self.sched = Scheduler(
@@ -174,7 +381,12 @@ class ChaosSim:
 
     def _act_create(self) -> None:
         self._pod_seq += 1
-        groups = self.rng.choice([None, None, "default", "edge"])
+        if self.federation:
+            # draw from the shard-covering pool so pods home to (and
+            # spill across) every shard, not just default/edge's
+            groups = self.rng.choice([None] + self.group_pool)
+        else:
+            groups = self.rng.choice([None, None, "default", "edge"])
         if self.rng.random() < 0.25:
             # exercise the second config format through the same storm
             cfg_type = "json"
@@ -209,7 +421,14 @@ class ChaosSim:
         from nhd_tpu.scheduler.controller import NHD_GROUP_LABEL
 
         name = self.rng.choice(list(self.backend.nodes))
-        value = self.rng.choice(["default", "edge", "default.edge", None])
+        if self.federation:
+            # group moves RE-HOME a node across shards mid-storm — the
+            # handoff/fencing machinery must survive the node-set of a
+            # shard changing under it
+            dotted = ".".join(self.rng.sample(self.group_pool, 2))
+            value = self.rng.choice(self.group_pool + [dotted, None])
+        else:
+            value = self.rng.choice(["default", "edge", "default.edge", None])
         self.backend.update_node_labels(name, {NHD_GROUP_LABEL: value})
         self.stats.group_moves += 1
 
@@ -327,7 +546,16 @@ class ChaosSim:
 
     def _act_restart(self) -> None:
         """Scheduler crash + restart: state must replay from annotations
-        to EQUIVALENT claims (not just invariant-clean ones)."""
+        to EQUIVALENT claims (not just invariant-clean ones). Federation
+        restarts rejoin with a fresh elector — epochs bump on every
+        shard the new incarnation re-acquires, and its scoped promotion
+        replays are vetted by the per-shard mirror invariants."""
+        if self.federation:
+            alive = [i for i, r in enumerate(self.replicas) if r.dead_for == 0]
+            if alive:
+                self._replace_replica(self.rng.choice(alive))
+                self.stats.restarts += 1
+            return
         if self.ha:
             idx = self.rng.randrange(len(self.replicas))
             old = self.replicas[idx]
@@ -348,33 +576,111 @@ class ChaosSim:
             self._check_restart_equivalence(pre_claims, pre_snap, self.sched)
         self.stats.restarts += 1
 
+    def _act_kill_wave(self) -> None:
+        """Federation-only: take 1..N-1 replicas down simultaneously for
+        a couple of steps — their shards must expire, rebalance onto the
+        survivors (scoped replays included), and hand back when the
+        fresh incarnations rejoin."""
+        alive = [i for i, r in enumerate(self.replicas) if r.dead_for == 0]
+        if len(alive) <= 1:
+            return
+        k = self.rng.randint(1, len(alive) - 1)
+        for i in self.rng.sample(alive, k):
+            self.replicas[i].dead_for = self.rng.randint(
+                1, KILL_DOWN_MAX_STEPS
+            )
+        self.stats.kill_waves += 1
+
     # ------------------------------------------------------------------
+
+    def _fed_pre_step(self) -> None:
+        """Federation housekeeping at the top of a step: revive expired
+        corpses as fresh incarnations, age/roll asymmetric partitions,
+        then tick every live member's elector in jittered order."""
+        for i, r in enumerate(self.replicas):
+            if r.dead_for > 0:
+                r.dead_for -= 1
+                if r.dead_for == 0:
+                    self._replace_replica(i)
+                    self.stats.restarts += 1
+        p = self.fed_profile.partition if self.fed_profile else 0.0
+        steps_max = self.fed_profile.partition_steps if self.fed_profile else 0
+        for r in self.replicas:
+            if r.dead_for > 0:
+                continue
+            if r.vantage.partition_left > 0:
+                r.vantage.partition_left -= 1
+            elif p > 0 and self.rng.random() < p:
+                r.vantage.partition_left = self.rng.randint(1, steps_max)
+                self.stats.partitions += 1
+        for r in self.rng.sample(self.replicas, len(self.replicas)):
+            if r.dead_for == 0:
+                r.elector.tick()
 
     def step(self) -> None:
         self.stats.steps += 1
         self._now += STEP_SEC
-        if self.ha:
+        if self.federation:
+            self._fed_pre_step()
+        elif self.ha:
             # jittered tick order: sometimes a standby acquires an
             # expired lease BEFORE the stale leader's tick notices —
             # the split-brain overlap fencing exists for
             for r in self.rng.sample(self.replicas, len(self.replicas)):
                 r.elector.tick()
-        action = self.rng.choices(
-            [self._act_create, self._act_delete, self._act_cordon,
-             self._act_maintenance, self._act_bind_failure, self._act_restart,
-             self._act_group_move, self._act_silent_delete],
-            weights=[40, 15, 10, 10, 10, 5, 8, 8],
-        )[0]
+        actions = [
+            self._act_create, self._act_delete, self._act_cordon,
+            self._act_maintenance, self._act_bind_failure, self._act_restart,
+            self._act_group_move, self._act_silent_delete,
+        ]
+        weights = [40, 15, 10, 10, 10, 5, 8, 8]
+        if self.federation:
+            actions.append(self._act_kill_wave)
+            weights.append(4)
+        action = self.rng.choices(actions, weights=weights)[0]
         action()
         self._drive_control_plane()
         # clear one-shot bind failures so pods eventually land
         self.backend.fail_bind_for.clear()
-        if self.ha:
+        if self.federation:
+            self._track_shard_leadership()
+        elif self.ha:
             self._track_leadership()
         self.check_invariants()
 
     def _drive_control_plane(self, extra_drain: bool = False) -> None:
         """Let the control plane catch up on this step's churn."""
+        if self.federation:
+            # fan the single watch stream out to every live, unpartitioned
+            # replica through its own faulted vantage (a partitioned
+            # replica's events are simply lost to it — the resync-shaped
+            # periodic scans repair whatever it missed)
+            events = list(self.base.poll_watch_events())
+            for r in self.replicas:
+                if r.dead_for > 0 or r.vantage.partition_left > 0:
+                    continue
+                if r.faulty is not None:
+                    r.vantage.feed(r.faulty.filter_watch_events(events))
+                else:
+                    r.vantage.feed(events)
+                r.controller.run_once(now=self._now)
+            for r in self.replicas:
+                if r.dead_for > 0:
+                    continue
+                acting = r.sched.poll_leadership()
+                for _ in range(8):
+                    if r.sched.nqueue.empty():
+                        break
+                    r.sched.run_once()
+                if acting:
+                    # guarded like the run loop's periodic scan: a scan
+                    # hitting a partition is isolated, and the mirror
+                    # rebuilds on the next successful pass
+                    r.sched._guarded("chaos scan", r.sched.check_pending_pods)
+                    if extra_drain:
+                        while not r.sched.nqueue.empty():
+                            r.sched.run_once()
+            return
         if not self.ha:
             self.controller.run_once(now=self._now)
             for _ in range(8):
@@ -428,14 +734,63 @@ class ChaosSim:
         if view is not None:
             self.stats.lease_epoch = view.epoch
 
+    def _track_shard_leadership(self) -> None:
+        """The per-shard bounded-gap invariant: no shard may sit without
+        a live owner longer than lease expiry + rendezvous patience +
+        the fault windows the storm is allowed to open (a partition or
+        kill wave can delay one handoff, never stall a shard forever)."""
+        bound = (
+            int(self.lease_ttl / STEP_SEC) + SHARD_PATIENCE_TICKS
+            + (self.fed_profile.partition_steps if self.fed_profile else 0)
+            + KILL_DOWN_MAX_STEPS + 6
+        )
+        for s in range(self.n_shards):
+            # lease truth, not believed ownership: a partitioned replica
+            # inside its renew grace still REPORTS the shard in
+            # owned_shards() after its lease expired — counting that as
+            # held would reset the gap and the bound would never be
+            # measured. A shard counts as held only while its lease is
+            # unexpired AND the holder is a live replica that knows it
+            holder = self.base.lease_live(shard_lease_name(s, self.n_shards))
+            held = bool(holder) and any(
+                r.dead_for == 0 and r.ident == holder
+                and s in r.elector.owned_shards()
+                for r in self.replicas
+            )
+            if held:
+                self._shard_gap[s] = 0
+            else:
+                self._shard_gap[s] += 1
+                self.stats.max_shard_gap = max(
+                    self.stats.max_shard_gap, self._shard_gap[s]
+                )
+                if self._shard_gap[s] > bound:
+                    self.stats.violations.append(
+                        f"step {self.stats.steps}: shard {s} ownerless "
+                        f"for {self._shard_gap[s]} steps (bound {bound})"
+                    )
+            view = self.base.lease_read(shard_lease_name(s, self.n_shards))
+            if view is not None:
+                self.stats.shard_epochs[s] = view.epoch
+        self.stats.lease_epoch = max(
+            self.stats.shard_epochs.values(), default=0
+        )
+
     # ------------------------------------------------------------------
     # invariants
     # ------------------------------------------------------------------
 
-    def _check_scheduler_invariants(self, sched: Scheduler) -> None:
-        """Conservation laws for one scheduler's mirror."""
+    def _check_scheduler_invariants(
+        self, sched: Scheduler, only_nodes: Optional[Set[str]] = None
+    ) -> None:
+        """Conservation laws for one scheduler's mirror. ``only_nodes``
+        scopes the check to a shard's node slice under federation —
+        a member's mirror for shards it does NOT own is a warm standby
+        view that legitimately lags the cluster."""
         v = self.stats.violations
         for name, node in sched.nodes.items():
+            if only_nodes is not None and name not in only_nodes:
+                continue
             if node.mem.free_hugepages_gb < 0:
                 v.append(f"step {self.stats.steps}: {name} negative hugepages")
             for nic in node.nics:
@@ -459,6 +814,8 @@ class ChaosSim:
         # backend and mirror agree on placements
         bound = self._backend_bound()
         for key, node_name in self._claims_map(sched).items():
+            if only_nodes is not None and node_name not in only_nodes:
+                continue
             if key not in bound:
                 # a vanished pod is released only after missing on two
                 # consecutive scans (reconcile_deleted_pods); a claim in
@@ -471,7 +828,26 @@ class ChaosSim:
 
     def check_invariants(self) -> None:
         """Conservation laws that must hold after every step."""
-        if self.ha:
+        if self.federation:
+            # each live member's mirror must agree with the cluster on
+            # the shards the LEASE says it truly owns (a stale believer's
+            # slice is fenced off and repairs at its next scoped replay)
+            for r in self.replicas:
+                if r.dead_for > 0 or r.vantage.partition_left > 0:
+                    # a partitioned member cannot see the cluster, so its
+                    # mirror legitimately lags until the heal-time scan
+                    # rebuilds it; quiesce re-checks with partitions off
+                    continue
+                owned_true = r.truly_owned(self)
+                if not owned_true:
+                    continue
+                only = {
+                    name for name, node in r.sched.nodes.items()
+                    if r.sched._node_shard(node) in owned_true
+                }
+                self._check_scheduler_invariants(r.sched, only_nodes=only)
+            self._check_spillover_orphans()
+        elif self.ha:
             # a stale believer's mirror legitimately lags (its writes are
             # fenced off; its view repairs at the next promotion replay) —
             # the TRUE leader's mirror is the one that must agree with the
@@ -486,17 +862,48 @@ class ChaosSim:
     def _check_single_epoch_binds(self) -> None:
         """The split-brain acceptance invariant: every pod incarnation is
         bound by AT MOST one leadership. Two successful binds for one uid
-        — same epoch or different — mean a deposed leader's write landed
-        past the fence."""
+        — same epoch or different, same shard lease or different — mean
+        a deposed owner's write landed past the fence."""
         per_uid: Dict[str, List] = {}
-        for ns, pod, uid, node, epoch in self.backend.bind_log:
-            per_uid.setdefault(uid, []).append((ns, pod, node, epoch))
+        for ns, pod, uid, node, epoch, lease in self.backend.bind_log:
+            per_uid.setdefault(uid, []).append((ns, pod, node, epoch, lease))
         for uid, binds in per_uid.items():
             if len(binds) > 1:
                 self.stats.violations.append(
                     f"step {self.stats.steps}: pod uid {uid} bound "
                     f"{len(binds)} times: {binds}"
                 )
+
+    def _check_spillover_orphans(self) -> None:
+        """The bounded-orphan-window invariant: a pod carrying a spill
+        record either places or gets its explicit unschedulable verdict
+        (which resets the record) within the orphan window — no spilled
+        pod ages past the bound while still Pending. Also refreshes the
+        spillover lifecycle tallies from the cluster's event trail."""
+        bound_sec = SPILLOVER_MAX_AGE_SEC + 15 * STEP_SEC
+        for p in self.base.pods.values():
+            if p.node is not None:
+                continue
+            rec = parse_spill_record(p.annotations.get(SPILLOVER_ANNOTATION))
+            if rec["since"] is None:
+                continue
+            age = self._now - rec["since"]
+            self.stats.max_spill_age_sec = max(
+                self.stats.max_spill_age_sec, age
+            )
+            if age > bound_sec:
+                self.stats.violations.append(
+                    f"step {self.stats.steps}: spilled pod "
+                    f"{p.namespace}/{p.name} orphaned for {age:.0f}s "
+                    f"(bound {bound_sec:.0f}s)"
+                )
+        self.stats.spilled = sum(
+            1 for e in self.base.events if e.reason == "SpilloverScheduling"
+        )
+        self.stats.spillover_exhausted = sum(
+            1 for e in self.base.events
+            if e.reason == "FailedScheduling" and "in any shard" in e.message
+        )
 
     def run(self, steps: int) -> ChaosStats:
         for _ in range(steps):
@@ -516,16 +923,33 @@ class ChaosSim:
         the cluster — every invariant holds and nothing stays stranded
         because of an API fault (``stuck_pods()`` empty). In HA mode the
         election must also converge: one replica ends up leading and its
-        scans place whatever the churn left pending."""
-        if isinstance(self.backend, FaultyBackend):
+        scans place whatever the churn left pending. In federation mode
+        partitions heal, corpses rejoin, every shard converges onto one
+        owner, and the spillover queue drains — each spilled pod ends
+        placed or explicitly unschedulable."""
+        if self.federation:
+            for i, r in enumerate(self.replicas):
+                if r.dead_for > 0:
+                    r.dead_for = 0
+                    self._replace_replica(i)
+                r = self.replicas[i]
+                r.vantage.partition_left = 0
+                if r.faulty is not None:
+                    r.faulty.enabled = False
+        elif isinstance(self.backend, FaultyBackend):
             self.backend.enabled = False
         for _ in range(rounds):
             self._now += STEP_SEC
-            if self.ha:
+            if self.federation:
+                for r in self.rng.sample(self.replicas, len(self.replicas)):
+                    r.elector.tick()
+            elif self.ha:
                 for r in self.rng.sample(self.replicas, len(self.replicas)):
                     r.elector.tick()
             self._drive_control_plane(extra_drain=True)
-            if self.ha:
+            if self.federation:
+                self._track_shard_leadership()
+            elif self.ha:
                 self._track_leadership()
             self.check_invariants()
         return self.unplaced_pods()

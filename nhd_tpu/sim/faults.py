@@ -55,6 +55,12 @@ class FaultProfile:
     #                                    demote immediately)
     lease_acquire_error: float = 0.0   # acquire raises TransientBackendError
     #                                    (follower stays follower this tick)
+    # federation-level (ChaosSim federation mode, sim/chaos.py): per-step
+    # probability that one replica enters an ASYMMETRIC partition — all
+    # of ITS API calls fail and its watch stream goes silent while every
+    # other replica keeps working — for 1..partition_steps steps
+    partition: float = 0.0
+    partition_steps: int = 3
     # HTTP-level (FaultyHttpClient)
     http_error: float = 0.0            # injected HTTP error status
     http_statuses: Tuple[int, ...] = (500, 503, 429)
@@ -92,6 +98,19 @@ PROFILES: Dict[str, FaultProfile] = {
         lease_acquire_error=0.15, drop_watch_event=0.10,
         transient_bind=0.15, transient_annotate=0.10,
         poison_watch_event=0.05,
+    ),
+    # federation storms (ChaosSim federation=S, `make fed-chaos`): the
+    # ha-* fault surface PLUS asymmetric partitions; kill/restart waves
+    # are a chaos ACTION in federation mode, not a profile probability
+    "fed-light": FaultProfile(
+        name="fed-light", lease_renew_error=0.15, lease_acquire_error=0.05,
+        partition=0.04,
+    ),
+    "fed-storm": FaultProfile(
+        name="fed-storm", lease_renew_error=0.30, lease_renew_conflict=0.08,
+        lease_acquire_error=0.12, drop_watch_event=0.10,
+        transient_bind=0.15, transient_annotate=0.10,
+        poison_watch_event=0.05, partition=0.08,
     ),
 }
 
@@ -307,15 +326,17 @@ class FaultyBackend(ClusterBackend):
     def get_cfg_map(self, pod: str, ns: str):
         return self.inner.get_cfg_map(pod, ns)
 
-    # ---- writes (fault points; the fencing epoch passes through) ----
+    # ---- writes (fault points; fencing epoch + lease pass through) ----
 
     def add_nad_to_pod(
-        self, pod: str, ns: str, nad: str, *, epoch=None
+        self, pod: str, ns: str, nad: str, *, epoch=None, fence_lease=None
     ) -> bool:
-        return self.inner.add_nad_to_pod(pod, ns, nad, epoch=epoch)
+        return self.inner.add_nad_to_pod(
+            pod, ns, nad, epoch=epoch, fence_lease=fence_lease
+        )
 
     def annotate_pod_config(
-        self, ns: str, pod: str, cfg: str, *, epoch=None
+        self, ns: str, pod: str, cfg: str, *, epoch=None, fence_lease=None
     ) -> bool:
         key = (ns, pod)
         if key not in self._annotate_faulted and self._roll(
@@ -326,15 +347,55 @@ class FaultyBackend(ClusterBackend):
             raise TransientBackendError(
                 f"injected transient annotate failure for {ns}/{pod}"
             )
-        return self.inner.annotate_pod_config(ns, pod, cfg, epoch=epoch)
+        return self.inner.annotate_pod_config(
+            ns, pod, cfg, epoch=epoch, fence_lease=fence_lease
+        )
 
     def annotate_pod_gpu_map(
-        self, ns: str, pod: str, gpu_map: Dict[str, int], *, epoch=None
+        self, ns: str, pod: str, gpu_map: Dict[str, int],
+        *, epoch=None, fence_lease=None,
     ) -> bool:
-        return self.inner.annotate_pod_gpu_map(ns, pod, gpu_map, epoch=epoch)
+        return self.inner.annotate_pod_gpu_map(
+            ns, pod, gpu_map, epoch=epoch, fence_lease=fence_lease
+        )
+
+    def annotate_pod_meta(
+        self, ns: str, pod: str, key: str, value: str,
+        *, epoch=None, fence_lease=None,
+    ) -> bool:
+        fk = (ns, pod, "meta")
+        if fk not in self._annotate_faulted and self._roll(
+            self.profile.transient_annotate
+        ):
+            self._annotate_faulted.add(fk)
+            self.fault_stats["transient_annotates"] += 1
+            raise TransientBackendError(
+                f"injected transient meta-annotate failure for {ns}/{pod}"
+            )
+        return self.inner.annotate_pod_meta(
+            ns, pod, key, value, epoch=epoch, fence_lease=fence_lease
+        )
+
+    def claim_spillover_pod(
+        self, ns: str, pod: str, claim_lease: str, claim_epoch: int,
+        *, epoch=None, fence_lease=None,
+    ) -> bool:
+        fk = (ns, pod, "claim")
+        if fk not in self._annotate_faulted and self._roll(
+            self.profile.transient_annotate
+        ):
+            self._annotate_faulted.add(fk)
+            self.fault_stats["transient_annotates"] += 1
+            raise TransientBackendError(
+                f"injected transient spillover-claim failure for {ns}/{pod}"
+            )
+        return self.inner.claim_spillover_pod(
+            ns, pod, claim_lease, claim_epoch,
+            epoch=epoch, fence_lease=fence_lease,
+        )
 
     def bind_pod_to_node(
-        self, pod: str, node: str, ns: str, *, epoch=None
+        self, pod: str, node: str, ns: str, *, epoch=None, fence_lease=None
     ) -> bool:
         key = (ns, pod)
         if key not in self._bind_faulted and self._roll(
@@ -345,7 +406,9 @@ class FaultyBackend(ClusterBackend):
             raise TransientBackendError(
                 f"injected transient bind failure for {ns}/{pod}"
             )
-        return self.inner.bind_pod_to_node(pod, node, ns, epoch=epoch)
+        return self.inner.bind_pod_to_node(
+            pod, node, ns, epoch=epoch, fence_lease=fence_lease
+        )
 
     def generate_pod_event(
         self, pod: str, ns: str, reason: str, event_type: EventType,
@@ -356,8 +419,17 @@ class FaultyBackend(ClusterBackend):
     # ---- watch plane (fault points) ----
 
     def poll_watch_events(self, timeout: float = 0.0) -> Iterable[WatchEvent]:
+        return self.filter_watch_events(self.inner.poll_watch_events(timeout))
+
+    def filter_watch_events(
+        self, events: Iterable[WatchEvent]
+    ) -> List[WatchEvent]:
+        """The watch-plane fault surface, factored out of the poll so the
+        federated chaos harness can fan one shared event stream out to N
+        replicas and still give each replica its own seeded drop/poison
+        faults (sim/chaos.py)."""
         out: List[WatchEvent] = []
-        for ev in self.inner.poll_watch_events(timeout):
+        for ev in events:
             if ev.kind in ("pod_create", "pod_delete") and self._roll(
                 self.profile.drop_watch_event
             ):
@@ -408,6 +480,9 @@ class FaultyBackend(ClusterBackend):
 
     def lease_read(self, name: str):
         return self.inner.lease_read(name)
+
+    def lease_live(self, name: str) -> str:
+        return self.inner.lease_live(name)
 
     # ---- TriadSets (pass-through) ----
 

@@ -1,0 +1,31 @@
+"""NHD501 negatives, controller scope: the sanctioned coordinator-write
+shapes stay clean."""
+
+
+class GatedController:
+    def __init__(self, backend, elector=None):
+        self.backend = backend
+        self.elector = elector
+
+    def _coordinator_write(self, fn, *args):
+        # THE chokepoint: direct TriadSet mutator calls are allowed only
+        # here, with coordinatorship re-checked at the write
+        if self.elector is not None and not self.elector.is_leader:
+            return False
+        return bool(fn(*args))
+
+    def reconcile(self, ts, ordinal, observed):
+        # bound-method ARGUMENTS are not call expressions — sanctioned
+        ok = self._coordinator_write(
+            self.backend.create_pod_for_triadset, ts, ordinal
+        )
+        if not ok:
+            return False
+        return self._coordinator_write(
+            self.backend.update_triadset_status, ts, observed
+        )
+
+    def observe(self):
+        # reads stay out of the rule's scope
+        sets = self.backend.list_triadsets()
+        return [self.backend.list_pods_of_triadset(ts) for ts in sets]

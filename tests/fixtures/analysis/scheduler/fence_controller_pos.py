@@ -1,0 +1,34 @@
+"""NHD501 positives, controller scope: raw TriadSet mutators in
+scheduler-scoped code.
+
+The controller's reconciliation writes (pod creation, scale-status
+patches) are gated on coordinatorship PER WRITE through
+``_coordinator_write`` — a raw call keeps writing after a mid-pass
+deposition, racing the new coordinator's reconciliation.
+"""
+
+
+class LeakyController:
+    def __init__(self, backend, elector=None):
+        self.backend = backend
+        self.elector = elector
+
+    def reconcile(self, ts, ordinal, observed):
+        self.backend.create_pod_for_triadset(ts, ordinal)    # EXPECT[NHD501]
+        return self.backend.update_triadset_status(ts, observed)  # EXPECT[NHD501]
+
+    def gated_at_the_pass_only(self, ts, ordinal):
+        # a leadership check at the TOP of the pass is not enough — the
+        # write itself must re-check (deposition lands mid-pass)
+        if self.elector is None or self.elector.is_leader:
+            return self.backend.create_pod_for_triadset(ts, ordinal)  # EXPECT[NHD501]
+
+
+def free_function(ctrl, ts, observed):
+    # module-level code in scheduler scope is just as ungated
+    return ctrl.backend.update_triadset_status(ts, observed)  # EXPECT[NHD501]
+
+
+def bare_backend_param(backend, ts, ordinal):
+    # a helper taking the backend directly must not evade the rule
+    return backend.create_pod_for_triadset(ts, ordinal)      # EXPECT[NHD501]

@@ -3,7 +3,13 @@ an injected clock (the tests/test_retry.py pattern — zero real waiting),
 fenced-commit stale-epoch rejection incl. an epoch bumped mid-commit,
 the stall watchdog, standby→promotion replay equivalence, restart
 state equivalence, and the split-brain chaos matrix (two schedulers, one
-cluster, lease faults on)."""
+cluster, lease faults on).
+
+Plus the sharded federation (ShardedElector + scheduler federation
+routing): rendezvous determinism, bounded shard handoff, dead-member
+rebalance, per-shard fencing, cross-shard spillover (claim/place and
+explicit exhaustion), scoped promotion replay, the federation chaos
+matrix, and the S=1 wire-equivalence regression pin."""
 
 import queue
 
@@ -453,7 +459,7 @@ def test_split_brain_chaos_storm(seed):
     assert any(r.elector.is_leader for r in sim.replicas)
     # every landed bind carries exactly one epoch per pod incarnation
     per_uid = {}
-    for ns, pod, uid, node, epoch in sim.backend.bind_log:
+    for ns, pod, uid, node, epoch, lease in sim.backend.bind_log:
         per_uid.setdefault(uid, set()).add(epoch)
     assert all(len(eps) == 1 for eps in per_uid.values())
 
@@ -508,3 +514,421 @@ def test_commit_path_unfenced_without_elector():
     assert backend.pods[("default", "p1")].node is not None
     assert backend.bind_log[0][4] is None     # unfenced write
     assert sched.pod_state[("default", "p1")]["state"] is PodStatus.SCHEDULED
+
+
+# ---------------------------------------------------------------------------
+# shard federation (k8s/lease.py ShardedElector + scheduler federation
+# routing + fed chaos matrix; docs/RESILIENCE.md "Federation")
+# ---------------------------------------------------------------------------
+
+from nhd_tpu.k8s.interface import (  # noqa: E402
+    SPILLOVER_ANNOTATION,
+    parse_spill_record,
+)
+from nhd_tpu.k8s.lease import (  # noqa: E402
+    ShardedElector,
+    presence_lease_name,
+    rendezvous_owner,
+    shard_for_group,
+    shard_lease_name,
+)
+
+FED_IDS = ["fed-a", "fed-b", "fed-c"]
+
+
+def _sharded(backend, clock, ident, peers=None, n_shards=3, ttl=30.0):
+    return ShardedElector(
+        backend, identity=ident, peers=peers or FED_IDS, n_shards=n_shards,
+        ttl=ttl, clock=clock, counters=ApiCounters(),
+    )
+
+
+def _fed_scheduler(backend, sharded, clock):
+    sched = Scheduler(
+        backend, WatchQueue(), queue.Queue(), respect_busy=False,
+        sharded=sharded, clock=clock,
+    )
+    sched.build_initial_node_list()
+    sched.load_deployed_configs()
+    return sched
+
+
+def _converge(els, clock, rounds=8, advance=2.0):
+    for _ in range(rounds):
+        for el in els:
+            el.tick()
+        clock.advance(advance)
+
+
+def _group_for_shard(shard, n_shards, prefix="a"):
+    """A deterministic group name homing to ``shard`` that sorts before
+    'default' (so a node carrying {g, default} re-homes to g's shard)."""
+    for i in range(512):
+        g = f"{prefix}{i}"
+        if shard_for_group(g, n_shards) == shard:
+            return g
+    raise AssertionError("no group found")  # pragma: no cover
+
+
+def test_rendezvous_deterministic_and_minimal_reshuffle():
+    owners = {s: rendezvous_owner(s, FED_IDS) for s in range(8)}
+    # membership order never matters (hashlib, not hash())
+    assert owners == {
+        s: rendezvous_owner(s, list(reversed(FED_IDS))) for s in range(8)
+    }
+    # removing one member reassigns ONLY its shards
+    survivors = [i for i in FED_IDS if i != "fed-b"]
+    for s in range(8):
+        if owners[s] != "fed-b":
+            assert rendezvous_owner(s, survivors) == owners[s]
+    # group → shard covers every shard id over a realistic name pool
+    assert {shard_for_group(f"g{i}", 3) for i in range(64)} == {0, 1, 2}
+    assert shard_lease_name(0, 1) == LEASE_NAME    # S=1 degenerates
+
+
+def test_federation_converges_each_shard_one_owner():
+    backend, clock = _cluster(0)
+    els = {i: _sharded(backend, clock, i) for i in FED_IDS}
+    _converge(els.values(), clock)
+    owned = {i: set(el.owned_shards()) for i, el in els.items()}
+    assert sorted(s for ss in owned.values() for s in ss) == [0, 1, 2]
+    # ...and exactly the deterministic rendezvous assignment
+    for ident, ss in owned.items():
+        for s in ss:
+            assert rendezvous_owner(s, FED_IDS) == ident
+
+
+def test_shard_handoff_bounded_one_per_tick():
+    backend, clock = _cluster(0)
+    a = _sharded(backend, clock, "fed-a")
+    _converge([a], clock, rounds=2)
+    assert set(a.owned_shards()) == {0, 1, 2}   # alone: owns the fleet
+    b = _sharded(backend, clock, "fed-b")
+    c = _sharded(backend, clock, "fed-c")
+    b.tick()
+    c.tick()                                    # presence beacons land
+    handed_total = 0
+    for _ in range(6):
+        before = set(a.owned_shards())
+        a.tick()
+        handed = before - set(a.owned_shards())
+        assert len(handed) <= 1                 # bounded handoff
+        handed_total += len(handed)
+        b.tick()
+        c.tick()
+        clock.advance(2)
+    # converged to the rendezvous assignment, one release at a time
+    assert handed_total == sum(
+        1 for s in range(3) if rendezvous_owner(s, FED_IDS) != "fed-a"
+    )
+    for s in range(3):
+        view = backend.lease_read(shard_lease_name(s, 3))
+        assert view.holder == rendezvous_owner(s, FED_IDS)
+
+
+def test_dead_member_shards_rebalance_within_ttl_plus_patience():
+    backend, clock = _cluster(0)
+    els = {i: _sharded(backend, clock, i) for i in FED_IDS}
+    _converge(els.values(), clock)
+    dead = next(i for i in FED_IDS if els[i].owned_shards())
+    lost = set(els[dead].owned_shards())
+    survivors = [els[i] for i in FED_IDS if i != dead]
+    clock.advance(31)                           # dead's leases all expire
+    _converge(survivors, clock, rounds=4)
+    held = set()
+    for el in survivors:
+        held |= set(el.owned_shards())
+    assert lost <= held                         # every orphan re-homed
+    assert held == {0, 1, 2}
+
+
+def test_clean_step_down_rebalances_without_waiting_out_ttl():
+    backend, clock = _cluster(0)
+    els = {i: _sharded(backend, clock, i) for i in FED_IDS}
+    _converge(els.values(), clock)
+    leaver = next(i for i in FED_IDS if els[i].owned_shards())
+    els[leaver].step_down()
+    assert els[leaver].owned_shards() == {}
+    # presence beacon released too: peers see the member gone NOW
+    assert backend.lease_live(presence_lease_name(leaver)) == ""
+    survivors = [els[i] for i in FED_IDS if i != leaver]
+    _converge(survivors, clock, rounds=3, advance=2.0)  # << ttl
+    held = set()
+    for el in survivors:
+        held |= set(el.owned_shards())
+    assert held == {0, 1, 2}
+
+
+def test_fencing_is_per_shard():
+    """A stale epoch on ONE shard fences exactly that shard's writes;
+    sibling shards' tokens stay valid."""
+    backend, clock = _cluster(1)
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    a = _sharded(backend, clock, "fed-a", peers=["fed-a"], n_shards=2)
+    a.tick()
+    assert set(a.owned_shards()) == {0, 1}
+    # a rival takes over shard 0 only (epoch 2 there)
+    backend.lease_release(shard_lease_name(0, 2), "fed-a", 1)
+    backend.lease_try_acquire(shard_lease_name(0, 2), "rival", 30.0)
+    with pytest.raises(StaleLeaseError):
+        backend.bind_pod_to_node(
+            "p1", "node0", "default",
+            epoch=1, fence_lease=shard_lease_name(0, 2),
+        )
+    assert backend.bind_log == []
+    # the untouched shard's token still lands writes
+    assert backend.bind_pod_to_node(
+        "p1", "node0", "default",
+        epoch=1, fence_lease=shard_lease_name(1, 2),
+    )
+    assert backend.bind_log[0][5] == shard_lease_name(1, 2)
+
+
+def _fed_cluster(node_groups, clock=None):
+    """Fake cluster whose nodes carry the given NHD_GROUP strings."""
+    clock = clock or StepClock()
+    backend = FakeClusterBackend()
+    backend.clock = clock
+    for i, groups in enumerate(node_groups):
+        spec = SynthNodeSpec(name=f"n{i}")
+        spec.groups = groups
+        backend.add_node(
+            spec.name, make_node_labels(spec), hugepages_gb=spec.hugepages_gb
+        )
+    return backend, clock
+
+
+def test_spillover_cross_shard_claim_and_place():
+    """The headline spillover path: the home shard has no candidate, the
+    pod spills, ANOTHER shard's owner claims it and binds under ITS
+    shard epoch — instead of the pod pending forever."""
+    n_shards = 3
+    home = shard_for_group("default", n_shards)
+    els_probe = {s: rendezvous_owner(s, FED_IDS) for s in range(n_shards)}
+    other = next(
+        s for s in range(n_shards)
+        if s != home and els_probe[s] != els_probe[home]
+    )
+    g = _group_for_shard(other, n_shards)
+    assert g < "default"     # so {g, default} homes to g's shard
+    # n0: home shard, will be cordoned; n1: carries 'default' too but
+    # homes to `other` — the cross-shard candidate
+    backend, clock = _fed_cluster(["default", f"{g}.default"])
+    els = {i: _sharded(backend, clock, i) for i in FED_IDS}
+    scheds = {i: _fed_scheduler(backend, els[i], clock) for i in FED_IDS}
+    _converge(els.values(), clock)
+    owner_of = {s: i for i in FED_IDS for s in els[i].owned_shards()}
+    assert owner_of[home] != owner_of[other]
+    backend.cordon_node("n0", True)
+    for i in FED_IDS:
+        scheds[i].poll_leadership()
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    scheds[owner_of[home]].check_pending_pods()
+    pod = backend.pods[("default", "p1")]
+    rec = parse_spill_record(pod.annotations.get(SPILLOVER_ANNOTATION))
+    assert pod.node is None and home in rec["tried"]
+    assert rec["since"] is not None
+    # the receiving shard's owner claims the spill and places it
+    scheds[owner_of[other]].check_pending_pods()
+    assert pod.node == "n1"
+    assert backend.bind_log[-1][5] == shard_lease_name(other, n_shards)
+
+
+def test_spillover_exhausts_with_explicit_verdict():
+    """A pod NO shard can place gets its explicit unschedulable verdict
+    once every shard has tried (never silently pending forever), and the
+    record resets for a fresh cycle."""
+    backend, clock = _fed_cluster(["default"])
+    els = {i: _sharded(backend, clock, i) for i in FED_IDS}
+    scheds = {i: _fed_scheduler(backend, els[i], clock) for i in FED_IDS}
+    _converge(els.values(), clock)
+    for i in FED_IDS:
+        scheds[i].poll_leadership()
+    # requests a group no node carries: unplaceable fleet-wide
+    backend.create_pod("p1", cfg_text=make_triad_config(), groups="zz")
+
+    def verdicts():
+        return [
+            e for e in backend.events
+            if e.pod == "p1" and e.reason == "FailedScheduling"
+            and "in any shard" in e.message
+        ]
+
+    for _ in range(4):
+        for i in FED_IDS:
+            scheds[i].check_pending_pods()
+            if verdicts():
+                break
+        if verdicts():
+            break
+        clock.advance(1)
+    pod = backend.pods[("default", "p1")]
+    assert pod.node is None
+    assert verdicts(), "no explicit shards-exhausted verdict"
+    # the record was reset with the verdict: the NEXT cycle starts fresh
+    assert parse_spill_record(
+        pod.annotations.get(SPILLOVER_ANNOTATION)
+    )["tried"] == set()
+
+
+def test_scoped_promotion_replay_on_shard_gain():
+    """A replica gaining shards replays THOSE shards' slice from the
+    cluster before acting — and its claims agree with the cluster's
+    bound set for the gained slice."""
+    backend, clock = _fed_cluster(["default", "default", "edge"])
+    peers = ["fed-a", "fed-b"]
+    a = _sharded(backend, clock, "fed-a", peers=peers)
+    sched_a = _fed_scheduler(backend, a, clock)
+    _converge([a], clock, rounds=2)
+    assert set(a.owned_shards()) == {0, 1, 2}
+    assert sched_a.poll_leadership() is True
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    backend.create_pod("p2", cfg_text=make_triad_config())
+    sched_a.check_pending_pods()
+    bound = {
+        (p.namespace, p.name): p.node
+        for p in backend.pods.values() if p.node
+    }
+    assert len(bound) == 2
+    # fed-b joins; a hands every shard over (b is rendezvous-preferred
+    # for all of them in this pair), one per tick
+    b = _sharded(backend, clock, "fed-b", peers=peers)
+    sched_b = _fed_scheduler(backend, b, clock)
+    _converge([a, b], clock, rounds=6)
+    assert set(b.owned_shards()) == {0, 1, 2}
+    assert sched_b.poll_leadership() is True
+    assert _claims(sched_b) == bound     # scoped replays == cluster truth
+    # the old owner's in-flight writes are fenced off now
+    assert sched_a.poll_leadership() is False
+    with pytest.raises(StaleLeaseError):
+        sched_a._commit_write(
+            backend.bind_pod_to_node, "px", "n0", "default", node="n0"
+        )
+
+
+def test_failed_scoped_replay_releases_gained_shards():
+    """The crash-only contract holds per shard: a gained shard whose
+    scoped replay fails is handed back, never led stateless."""
+    from nhd_tpu.k8s.interface import TransientBackendError
+
+    backend, clock = _fed_cluster(["default", "edge"])
+    a = _sharded(backend, clock, "fed-a", peers=["fed-a"])
+    sched = _fed_scheduler(backend, a, clock)
+    a.tick()
+    real_get_nodes = backend.get_nodes
+    backend.get_nodes = lambda: (_ for _ in ()).throw(
+        TransientBackendError("outage mid-replay")
+    )
+    assert sched.poll_leadership() is False
+    assert a.owned_shards() == {}        # gained shards released
+    backend.get_nodes = real_get_nodes
+    a.tick()                             # re-acquire on a later tick...
+    assert sched.poll_leadership() is True   # ...and replay succeeds
+    assert set(a.owned_shards()) == {0, 1, 2}
+
+
+# ---------------------------------------------------------------------------
+# federation chaos matrix (the acceptance cells; `make fed-chaos` runs
+# the full seeds × profiles sweep)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_federation_chaos_storm(seed):
+    """S=3 shards × 3 replicas under per-shard lease faults, asymmetric
+    partitions and kill/restart waves: no pod uid bound under two shard
+    epochs, per-shard leadership gaps bounded, no spillover orphan past
+    the window, and the cluster converges once the storm lifts."""
+    sim = ChaosSim(
+        seed=seed, n_nodes=6, federation=3, n_replicas=3,
+        api_faults=PROFILES["fed-storm"],
+    )
+    stats = sim.run(steps=40)
+    assert stats.violations == []
+    # the storm actually churned shard leadership
+    assert max(stats.shard_epochs.values()) >= 2
+    totals = sim.fault_totals()
+    assert totals["lease_renew_errors"] + totals["lease_renew_conflicts"] > 0
+    sim.quiesce()
+    assert stats.violations == []
+    assert sim.stuck_pods() == []
+    # every shard converges onto exactly one live owner
+    for s in range(3):
+        holders = [
+            r.ident for r in sim.replicas
+            if s in r.elector.owned_shards()
+        ]
+        assert len(holders) == 1
+    # no pod uid bound under two shard epochs (the bind log records the
+    # fencing lease of every landed bind)
+    per_uid = {}
+    for ns, pod, uid, node, epoch, lease in sim.base.bind_log:
+        per_uid.setdefault(uid, set()).add((lease, epoch))
+    assert all(len(v) == 1 for v in per_uid.values())
+
+
+def test_federation_light_profile_spillover_and_gaps():
+    sim = ChaosSim(
+        seed=0, n_nodes=6, federation=3, n_replicas=3,
+        api_faults=PROFILES["fed-light"],
+    )
+    stats = sim.run(steps=40)
+    sim.quiesce()
+    assert stats.violations == []
+    assert sim.stuck_pods() == []
+    from nhd_tpu.k8s.lease import SHARD_PATIENCE_TICKS
+    from nhd_tpu.sim.chaos import KILL_DOWN_MAX_STEPS, STEP_SEC
+    bound = (
+        int(sim.lease_ttl / STEP_SEC) + SHARD_PATIENCE_TICKS
+        + PROFILES["fed-light"].partition_steps + KILL_DOWN_MAX_STEPS + 6
+    )
+    assert stats.max_shard_gap <= bound
+
+
+def test_single_shard_federation_is_wire_equivalent_to_ha():
+    """The S=1 regression pin: a one-shard federation competes for
+    exactly the PR 5 single lease on the wire (plus presence beacons),
+    fences every bind with it, and passes the same split-brain storm
+    invariants as ``ha=True`` — federation strictly generalizes HA."""
+    sim = ChaosSim(
+        seed=0, n_nodes=4, federation=1, n_replicas=2,
+        api_faults=PROFILES["ha-storm"],
+    )
+    stats = sim.run(steps=40)
+    sim.quiesce()
+    assert stats.violations == []
+    assert sim.stuck_pods() == []
+    presence = {
+        presence_lease_name(r.ident) for r in sim.replicas
+    }
+    assert set(sim.base.leases) <= {LEASE_NAME} | presence
+    for ns, pod, uid, node, epoch, lease in sim.base.bind_log:
+        if epoch is not None:
+            assert lease == LEASE_NAME    # byte-identical fence lease
+    assert stats.lease_epoch >= 2         # the storm churned leadership
+
+
+def test_shard_metrics_exported():
+    from nhd_tpu.k8s.lease import publish_shard_status
+
+    publish_shard_status("fed-a", 3, {0: 4, 2: 7})
+    try:
+        out = render_metrics([], failed_count=0)
+        for name, kind in (
+            ("nhd_shard_owned_count", "gauge"),
+            ("nhd_shard_acquisitions_total", "counter"),
+            ("nhd_shard_handoffs_total", "counter"),
+            ("nhd_shard_spillover_claims_total", "counter"),
+            ("nhd_shard_spillover_spilled_total", "counter"),
+            ("nhd_shard_spillover_exhausted_total", "counter"),
+            ("nhd_shard_spillover_depth", "gauge"),
+            ("nhd_shard_spillover_oldest_age_seconds", "gauge"),
+            ("nhd_shard_spillover_orphan_age_max_seconds", "gauge"),
+            ("nhd_shard_epoch", "gauge"),
+        ):
+            assert f"# TYPE {name} {kind}" in out
+        assert 'nhd_shard_epoch{shard="0"} 4' in out
+        assert 'nhd_shard_epoch{shard="2"} 7' in out
+        assert 'nhd_shard_epoch{shard="1"}' not in out   # not held
+    finally:
+        publish_shard_status("", 0, {})

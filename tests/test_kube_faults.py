@@ -483,3 +483,102 @@ def test_fenced_write_rejected_over_http(stub):
     # the CURRENT epoch still lands over the wire
     assert b.bind_pod_to_node("p1", "n1", "default", epoch=2) is True
     assert len(stub.bindings) == 1
+
+
+def test_bind_inside_fence_cache_window_caught_via_epoch_hwm(
+    stub, monkeypatch
+):
+    """The NHD_FENCE_CACHE_SEC staleness pin: a fenced write whose lease
+    view is still warm in the cache must STILL be rejected once this
+    process has observed a rival acquisition through ANY lease operation
+    — the per-lease epoch high-water mark closes the cache window the
+    moment the rival leadership is seen (here: the elector's own failed
+    renewal), instead of admitting stale binds for the rest of the TTL."""
+    import pytest as _pytest
+
+    from nhd_tpu.k8s import kube as kube_mod
+    from nhd_tpu.k8s.interface import LEASE_NAME, StaleLeaseError
+    from nhd_tpu.k8s.lease import LeaderElector
+    from nhd_tpu.k8s.retry import ApiCounters
+
+    stub.add_node("n1")
+    stub.add_pod("p1")
+    # a cache that never expires within the test: any rejection below is
+    # provably the high-water mark, not a lucky cache miss
+    monkeypatch.setattr(kube_mod, "_FENCE_CACHE_SEC", 300.0)
+    b = _backend()
+    el = LeaderElector(b, identity="replica-1", ttl=30, counters=ApiCounters())
+    assert el.tick() is True and el.epoch == 1
+    # warm the fence cache with a successful fenced write at epoch 1
+    assert b.annotate_pod_config("default", "p1", "cfg", epoch=1) is True
+    # a rival acquisition lands on the server (epoch 2); the cached
+    # fence view still says epoch 1 and stays warm for 300 s
+    lease = stub.leases[("default", LEASE_NAME)]
+    lease["spec"]["holderIdentity"] = "rival"
+    lease["spec"]["leaseTransitions"] = 2
+    # the elector's next renewal observes the rival state (CAS loss) —
+    # that observation advances the epoch high-water mark
+    assert el.tick() is False
+    with _pytest.raises(StaleLeaseError):
+        b.bind_pod_to_node("p1", "n1", "default", epoch=1)
+    assert stub.bindings == []
+
+
+def test_federation_shard_leases_over_http(stub):
+    """The sharded federation's lease table on the real HTTP path: S
+    shard leases plus per-replica presence beacons as ordinary
+    coordination.k8s.io Leases, converging to the deterministic
+    rendezvous assignment with one holder per shard."""
+    from nhd_tpu.k8s.lease import (
+        ShardedElector,
+        presence_lease_name,
+        rendezvous_owner,
+        shard_lease_name,
+    )
+    from nhd_tpu.k8s.retry import ApiCounters
+
+    b = _backend()
+    ids = ["replica-1", "replica-2"]
+    els = [
+        ShardedElector(
+            b, identity=i, peers=ids, n_shards=3, ttl=30,
+            counters=ApiCounters(),
+        )
+        for i in ids
+    ]
+    for _ in range(6):
+        for el in els:
+            el.tick()
+    owned = {}
+    for i, el in zip(ids, els):
+        for s in el.owned_shards():
+            assert s not in owned, "two holders for one shard"
+            owned[s] = i
+    assert sorted(owned) == [0, 1, 2]
+    for s, i in owned.items():
+        lease = stub.leases[("default", shard_lease_name(s, 3))]
+        assert lease["spec"]["holderIdentity"] == i
+        assert rendezvous_owner(s, ids) == i
+    for i in ids:
+        assert ("default", presence_lease_name(i)) in stub.leases
+
+
+def test_lease_get_outage_is_transient_for_liveness(stub):
+    """fail_lease_gets: a lease read outage surfaces as
+    TransientBackendError once the retry budget is spent — the
+    federation's liveness probes (lease_live) treat it as
+    'unverifiable', never as a verdict."""
+    import pytest as _pytest
+
+    from nhd_tpu.k8s.interface import LEASE_NAME, TransientBackendError
+    from nhd_tpu.k8s.lease import LeaderElector
+    from nhd_tpu.k8s.retry import ApiCounters
+
+    b = _backend()
+    el = LeaderElector(b, identity="replica-1", ttl=30, counters=ApiCounters())
+    assert el.tick() is True
+    stub.fail_lease_gets = 50            # past any retry budget
+    with _pytest.raises(TransientBackendError):
+        b.lease_live(LEASE_NAME)
+    stub.fail_lease_gets = 0
+    assert b.lease_live(LEASE_NAME) == "replica-1"

@@ -69,6 +69,8 @@ def found_of(path: Path, packs=None) -> set:
     ("det_out_of_scope.py", ["determinism"]),
     ("scheduler/fence_pos.py", ["fencing"]),
     ("scheduler/fence_neg.py", ["fencing"]),
+    ("scheduler/fence_controller_pos.py", ["fencing"]),
+    ("scheduler/fence_controller_neg.py", ["fencing"]),
     ("fence_out_of_scope.py", ["fencing"]),
     ("lockgraph_pos.py", ["lockgraph"]),
     ("lockgraph_neg.py", ["lockgraph"]),

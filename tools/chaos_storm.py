@@ -10,17 +10,27 @@ docs/RESILIENCE.md; CI runs the one-seed fast cell in
 tests/test_faults.py.
 
     make chaos                         # 6 seeds x {light,storm,heavy}
+    make ha-chaos                      # split-brain: 2 replicas, 1 lease
+    make fed-chaos                     # federation: N replicas, S shards
     make chaos CHAOS_SEEDS=25          # wider sweep
     python tools/chaos_storm.py --profiles heavy --seeds 50 --steps 120
+    python tools/chaos_storm.py --federation 3 --replicas 3 \
+        --profiles fed-light,fed-storm --json-out artifacts/fed_chaos.json
 
-Exit status is non-zero on the first failing cell; the seed and profile
-are printed so the failure replays with
-``ChaosSim(seed=<seed>, n_nodes=<n>, api_faults=PROFILES[<profile>])``.
+Every run can emit a machine-readable summary artifact (``--json-out``):
+one record per (profile, seed) cell with the invariant verdicts, shard/
+leadership high-water marks, spillover lifecycle counts and injected-
+fault tallies — so CI diffs the matrix instead of scraping logs. All
+cells run even after a failure (the artifact shows the whole matrix);
+the exit status reports whether any cell failed. A failing cell replays
+with ``ChaosSim(seed=<seed>, n_nodes=<n>, api_faults=PROFILES[<profile>],
+...)`` using the mode flags printed alongside it.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -33,6 +43,51 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from nhd_tpu.utils import force_cpu_backend  # noqa: E402
 
 force_cpu_backend()
+
+
+def _run_cell(args, profile: str, seed: int) -> dict:
+    """One (profile, seed) cell → its machine-readable summary record."""
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import PROFILES
+
+    faults = PROFILES[profile] if profile != "none" else None
+    sim = ChaosSim(
+        seed=seed, n_nodes=args.nodes, api_faults=faults,
+        ha=args.ha, federation=args.federation, n_replicas=args.replicas,
+    )
+    stats = sim.run(steps=args.steps)
+    sim.quiesce()
+    stuck = sim.stuck_pods()
+    record = {
+        "profile": profile,
+        "seed": seed,
+        "nodes": args.nodes,
+        "steps": args.steps,
+        "mode": (
+            "federation" if args.federation
+            else "ha" if args.ha else "single"
+        ),
+        "ok": not stats.violations and not stuck,
+        "violations": list(stats.violations),
+        "stuck_pods": [list(k) for k in stuck],
+        "faults_injected": sim.fault_totals(),
+        "lease_epoch": stats.lease_epoch,
+        "max_leader_gap": stats.max_leader_gap,
+    }
+    if args.federation:
+        record.update({
+            "shards": args.federation,
+            "replicas": args.replicas,
+            "shard_epochs": {str(s): e for s, e in stats.shard_epochs.items()},
+            "max_shard_gap": stats.max_shard_gap,
+            "partitions": stats.partitions,
+            "kill_waves": stats.kill_waves,
+            "restarts": stats.restarts,
+            "spilled": stats.spilled,
+            "spillover_exhausted": stats.spillover_exhausted,
+            "max_spill_age_sec": round(stats.max_spill_age_sec, 1),
+        })
+    return record
 
 
 def main() -> int:
@@ -51,12 +106,29 @@ def main() -> int:
                          "leader election share each cell's cluster; adds "
                          "the double-epoch-bind and bounded-leadership-gap "
                          "invariants (pair with the ha-* profiles)")
+    ap.add_argument("--federation", type=int, default=0, metavar="S",
+                    help="shard-federation mode: --replicas full replicas "
+                         "over S shard leases share each cell's cluster, "
+                         "under per-shard lease faults, asymmetric "
+                         "partitions and kill/restart waves; adds the "
+                         "no-double-shard-epoch-bind, bounded-per-shard-"
+                         "gap and bounded-spillover-orphan invariants "
+                         "(pair with the fed-* profiles)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="federation members per cell (default 3; "
+                         "requires --federation)")
+    ap.add_argument("--json-out", default=None, metavar="PATH",
+                    help="write the machine-readable matrix summary here "
+                         "(one record per cell; written even when cells "
+                         "fail, so CI diffs results instead of logs)")
     ap.add_argument("--start-seed", type=int, default=0)
     args = ap.parse_args()
 
-    from nhd_tpu.sim.chaos import ChaosSim
     from nhd_tpu.sim.faults import PROFILES
 
+    if args.ha and args.federation:
+        print("--ha and --federation are exclusive modes")
+        return 2
     profiles = [p.strip() for p in args.profiles.split(",") if p.strip()]
     for p in profiles:
         if p not in PROFILES:
@@ -64,44 +136,79 @@ def main() -> int:
             return 2
 
     t0 = time.time()
-    cells = 0
+    cells = []
     for profile in profiles:
         totals: dict = {}
-        epochs, gaps = 0, 0
+        epochs, gaps, shard_gaps = 0, 0, 0
         for seed in range(args.start_seed, args.start_seed + args.seeds):
-            faults = PROFILES[profile] if profile != "none" else None
-            sim = ChaosSim(
-                seed=seed, n_nodes=args.nodes, api_faults=faults,
-                ha=args.ha,
-            )
-            stats = sim.run(steps=args.steps)
-            sim.quiesce()
-            stuck = sim.stuck_pods()
-            if stats.violations or stuck:
+            rec = _run_cell(args, profile, seed)
+            cells.append(rec)
+            if not rec["ok"]:
+                mode_flags = (
+                    f" --federation {args.federation} "
+                    f"--replicas {args.replicas}" if args.federation
+                    else " --ha" if args.ha else ""
+                )
                 print(f"CHAOS FAIL profile={profile} seed={seed} "
-                      f"nodes={args.nodes} steps={args.steps}"
-                      f"{' ha' if args.ha else ''}:")
-                for v in stats.violations:
+                      f"nodes={args.nodes} steps={args.steps}{mode_flags}:")
+                for v in rec["violations"]:
                     print(f"  violation: {v}")
-                for key in stuck:
-                    print(f"  stuck pod: {key}")
-                return 1
-            if faults is not None:
-                for k, n in sim.backend.fault_stats.items():
-                    totals[k] = totals.get(k, 0) + n
-            epochs = max(epochs, stats.lease_epoch)
-            gaps = max(gaps, stats.max_leader_gap)
-            cells += 1
-        extra = (
-            f", max lease epoch {epochs}, max leader gap {gaps}"
-            if args.ha else ""
-        )
-        print(f"profile {profile:>8}: {args.seeds} seeds clean "
+                for key in rec["stuck_pods"]:
+                    print(f"  stuck pod: {tuple(key)}")
+                continue
+            for k, n in rec["faults_injected"].items():
+                totals[k] = totals.get(k, 0) + n
+            epochs = max(epochs, rec["lease_epoch"])
+            gaps = max(gaps, rec["max_leader_gap"])
+            shard_gaps = max(shard_gaps, rec.get("max_shard_gap", 0))
+        if args.federation:
+            extra = (f", max shard epoch {epochs}, max shard gap "
+                     f"{shard_gaps} steps")
+        elif args.ha:
+            extra = f", max lease epoch {epochs}, max leader gap {gaps}"
+        else:
+            extra = ""
+        clean = sum(1 for c in cells if c["profile"] == profile and c["ok"])
+        print(f"profile {profile:>9}: {clean}/{args.seeds} seeds clean "
               f"(faults injected: {totals}{extra})")
-    print(f"chaos matrix OK: {cells} cells "
+
+    failed = [c for c in cells if not c["ok"]]
+    summary = {
+        "matrix": {
+            "profiles": profiles,
+            "seeds": args.seeds,
+            "start_seed": args.start_seed,
+            "steps": args.steps,
+            "nodes": args.nodes,
+            "mode": ("federation" if args.federation
+                     else "ha" if args.ha else "single"),
+            "federation_shards": args.federation,
+            "federation_replicas": args.replicas if args.federation else 0,
+        },
+        "ok": not failed,
+        "cells_total": len(cells),
+        "cells_failed": len(failed),
+        "wall_seconds": round(time.time() - t0, 1),
+        "cells": cells,
+    }
+    if args.json_out:
+        out_dir = os.path.dirname(os.path.abspath(args.json_out))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"matrix summary -> {args.json_out}")
+
+    if failed:
+        print(f"chaos matrix FAILED: {len(failed)}/{len(cells)} cells")
+        return 1
+    mode = (
+        f", federation {args.federation} shards x {args.replicas} replicas"
+        if args.federation else ", split-brain" if args.ha else ""
+    )
+    print(f"chaos matrix OK: {len(cells)} cells "
           f"({len(profiles)} profiles x {args.seeds} seeds, "
-          f"{args.steps} steps{', split-brain' if args.ha else ''}) "
-          f"in {time.time() - t0:.1f}s")
+          f"{args.steps} steps{mode}) in {summary['wall_seconds']}s")
     return 0
 
 
