@@ -15,7 +15,7 @@ FED_STEPS ?= 50
 FED_SHARDS ?= 3
 FED_REPLICAS ?= 3
 
-.PHONY: test lint sanitize proto bench wheel clean native soak chaos ha-chaos fed-chaos trace-demo docker docker-smoke release
+.PHONY: test lint sanitize proto bench bench-diff wheel clean native soak chaos ha-chaos fed-chaos trace-demo fleet-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -48,14 +48,27 @@ lint:
 # cycle fails loud with a witness instead of hanging the run
 # (docs/OBSERVABILITY.md; NHD_SAN_REPORT holds the dump path).
 # test_ha.py includes the fastest federation cell (fed-light storm),
-# so the shard-lease/handoff/spillover lock surfaces run instrumented.
+# so the shard-lease/handoff/spillover lock surfaces run instrumented;
+# test_fleet.py puts the ISSUE 7 observability plane (per-replica span
+# rings, SLO trackers, journey merge, demotion dumps) under the same
+# instrumented locks.
 sanitize:
 	NHD_SAN=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
-		tests/test_streaming.py tests/test_faults.py tests/test_ha.py -q
+		tests/test_streaming.py tests/test_faults.py tests/test_ha.py \
+		tests/test_fleet.py -q
 
-# full release gate: lint + suite + benchmark smoke on the CPU backend
+# full release gate: lint + suite + benchmark smoke on the CPU backend +
+# the 3-replica fleet-observability drive (merged journey + validated
+# fleet artifact) + the perf-regression diff when a previous bench
+# artifact exists to compare against
 check: lint test
 	NHD_BENCH_PLATFORM=cpu python bench.py
+	$(MAKE) fleet-demo
+	@if [ $$(ls artifacts/bench/*.json 2>/dev/null | wc -l) -ge 2 ]; then \
+		$(MAKE) bench-diff; \
+	else \
+		echo "bench-diff: fewer than two artifacts; gate skipped"; \
+	fi
 
 # Regenerate protobuf message bindings. Service stubs are hand-written in
 # nhd_tpu/rpc/server.py (no grpc_python_plugin needed).
@@ -64,6 +77,23 @@ proto:
 
 bench:
 	python bench.py
+
+# continuous perf-regression gate (docs/OBSERVABILITY.md "Perf
+# telemetry"): diff two bench artifacts, nonzero exit on a watched
+# figure regressing past the threshold. Defaults to the two newest
+# artifacts/bench/*.json; override with BENCH_OLD=... BENCH_NEW=...
+bench-diff:
+	@old="$(BENCH_OLD)"; new="$(BENCH_NEW)"; \
+	if [ -z "$$old" ] || [ -z "$$new" ]; then \
+		set -- $$(ls -t artifacts/bench/*.json 2>/dev/null | head -2); \
+		new=$${new:-$$1}; old=$${old:-$$2}; \
+	fi; \
+	if [ -z "$$old" ] || [ -z "$$new" ]; then \
+		echo "bench-diff: need two artifacts (run 'make bench' twice" \
+		     "or set BENCH_OLD/BENCH_NEW)"; \
+		exit 2; \
+	fi; \
+	python tools/bench_diff.py "$$old" "$$new"
 
 wheel:
 	# --no-build-isolation: use the interpreter's setuptools instead of
@@ -102,12 +132,20 @@ fed-chaos:
 	python tools/chaos_storm.py --federation $(FED_SHARDS) \
 		--replicas $(FED_REPLICAS) --profiles fed-light,fed-storm \
 		--seeds $(FED_SEEDS) --steps $(FED_STEPS) --nodes 6 \
-		--json-out artifacts/chaos/fed_chaos.json
+		--json-out artifacts/chaos/fed_chaos.json \
+		--fleet-out artifacts/fleet
 
 # flight-recorder demo: run the sim with tracing on, dump the Chrome
 # trace, validate its schema + per-pod span pipeline (docs/OBSERVABILITY.md)
 trace-demo:
 	python tools/trace_demo.py
+
+# fleet-observability demo: 3 replicas x 3 shards on the fake cluster ->
+# one merged cross-replica pod journey (single corr ID, spans from >= 2
+# replicas) + a schema-validated fleet artifact under artifacts/fleet
+# (docs/OBSERVABILITY.md "Federation observability")
+fleet-demo:
+	python tools/fleet_demo.py
 
 # container image + in-container smoke test (reference: Makefile:244-252;
 # no registry push here — zero-egress environment, tag locally instead)

@@ -239,7 +239,20 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40,
             f"bench[{name}]: phases {detail}; "
             f"solve-active/wall {min(util, 100.0):.0f}%"
         )
-    return {"wall": wall, "placed": placed, "speedup": speedup}
+    return {
+        "wall": wall, "placed": placed, "speedup": speedup,
+        "rounds": stats.rounds,
+        # the coarse solve/select/assign trio joins the fine-grained
+        # phase names (disjoint key sets): tools/bench_diff.py gates on
+        # "solve" and must find it in every artifact, legacy included
+        "phases": {
+            "solve": stats.solve_seconds,
+            "select": stats.select_seconds,
+            "assign": stats.assign_seconds,
+            **stats.phases,
+        },
+        "p99_bind_ms": stats.bind_latency_percentile(results, 99) * 1e3,
+    }
 
 
 def make_fake_sched(n_nodes: int, prefix: str, hugepages_gb: int = None):
@@ -479,12 +492,17 @@ def main() -> None:
 
     from nhd_tpu.sim.workloads import cap_cluster
 
-    bench_config("cfg1:100x32", 100, 32, ["default"], baseline_sample=30)
-    bench_config("cfg2:1kx256", 1000, 256, ["default"], baseline_sample=30)
+    configs = {}
+    configs["cfg1:100x32"] = bench_config(
+        "cfg1:100x32", 100, 32, ["default"], baseline_sample=30
+    )
+    configs["cfg2:1kx256"] = bench_config(
+        "cfg2:1kx256", 1000, 256, ["default"], baseline_sample=30
+    )
 
     # cfg3: NIC-saturated contention shape (places ~4k of 10k — the cluster
     # runs out of unshared NICs; throughput under heavy infeasibility)
-    bench_config(
+    configs["cfg3:10kx1k-sat"] = bench_config(
         "cfg3:10kx1k-sat", 10_000, 1_000, ["default", "edge", "batch"],
         baseline_sample=40,
     )
@@ -497,24 +515,67 @@ def main() -> None:
             "cfg4:10kx1k-cap", 10_000, 1_000, ["default", "edge", "batch"],
             baseline_sample=40, cluster_fn=cap_cluster,
         )
+    configs["cfg4:10kx1k-cap"] = result
     if result["placed"] < 10_000:
         _log(f"bench: WARNING cfg4 placed {result['placed']}/10000 "
              "on the capacity-matched cluster")
 
     # cfg5: federation stretch through the streaming solver (default-on)
     if not os.environ.get("NHD_BENCH_SKIP_FED"):
-        bench_config(
+        configs["cfg5:100kx10k-stream"] = bench_config(
             "cfg5:100kx10k-stream", 100_000, 10_000,
             ["default", "edge", "batch", "fed1", "fed2"], baseline_sample=10,
             cluster_fn=cap_cluster, runner=run_stream,
         )
 
-    print(json.dumps({
+    headline = {
         "metric": "pods_matched_per_sec_10k_pods_x_1k_nodes",
         "value": round(result["placed"] / result["wall"], 1),
         "unit": "pods/s",
         "vs_baseline": round(result["speedup"], 1),
-    }))
+    }
+
+    # schema-versioned perf artifact (obs/perf.py): the run's per-config
+    # walls, phase breakdowns and per-(phase, shape) attribution on disk,
+    # so tools/bench_diff.py can gate the NEXT run against this one
+    if not os.environ.get("NHD_BENCH_NO_ARTIFACT"):
+        from nhd_tpu.obs.jitstats import JIT_STATS
+        from nhd_tpu.obs.perf import (
+            build_bench_artifact,
+            config_record,
+            write_bench_artifact,
+        )
+
+        jit = JIT_STATS.snapshot()
+        artifact = build_bench_artifact(
+            {
+                name: config_record(
+                    wall_seconds=r["wall"], placed=r["placed"],
+                    speedup=r["speedup"], rounds=r["rounds"],
+                    phases=r["phases"], p99_bind_ms=r["p99_bind_ms"],
+                )
+                for name, r in configs.items()
+            },
+            headline=headline,
+            platform=jax.devices()[0].platform,
+            phase_attribution={
+                "phase_seconds": jit["phase_seconds"],
+                "phase_counts": jit["phase_counts"],
+            },
+        )
+        # the artifact is a byproduct: a full disk or read-only FS must
+        # not eat the headline line (the one-JSON-line stdout contract)
+        # after a multi-minute bench run
+        try:
+            path = write_bench_artifact(
+                artifact,
+                os.environ.get("NHD_BENCH_ARTIFACT_DIR", "artifacts/bench"),
+            )
+            _log(f"bench artifact -> {path}")
+        except (OSError, ValueError) as exc:
+            _log(f"bench artifact write failed (run unaffected): {exc}")
+
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -116,11 +116,17 @@ def _pack_lockgraph(modules):
     return check_project(modules)
 
 
+def _pack_metrics(modules):
+    from nhd_tpu.analysis.rules_metrics import check_project
+    return check_project(modules)
+
+
 # project packs: check_project(modules: Sequence[ModuleSource]) -> findings.
 # They run over the whole analyzed path set at once (analyze_file hands
 # them a one-module project, so EXPECT fixtures keep working unchanged).
 PROJECT_PACKS: Dict[str, Callable] = {
     "lockgraph": _pack_lockgraph,
+    "metrics": _pack_metrics,
 }
 
 ALL_PACK_NAMES: Tuple[str, ...] = (*PACKS, *PROJECT_PACKS)
@@ -202,6 +208,18 @@ RULES: Dict[str, Tuple[str, str]] = {
                "must carry the owning shard's fencing epoch), TriadSet "
                "mutators in Controller._coordinator_write (coordinatorship "
                "re-checked at the write, not the pass)"),
+    "NHD601": ("metrics",
+               "exported metric name does not match nhd_[a-z0-9_]+: "
+               "scrapers key on the prefix, and invalid characters break "
+               "the text exposition format"),
+    "NHD602": ("metrics",
+               "metric family emitted but registered nowhere (# TYPE "
+               "declaration, histogram registry, name/kind table row or "
+               "*FAMILIES* list): it scrapes TYPE-less and undocumented"),
+    "NHD603": ("metrics",
+               "unbounded-cardinality label (corr/uid/pod/...) on a "
+               "metric family: one time series per pod ever seen — "
+               "identities belong in /decisions, not label values"),
 }
 
 

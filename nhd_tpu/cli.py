@@ -35,6 +35,7 @@ def build_threads(
     ha_identity=None,
     shards: int = 1,
     shard_peers=None,
+    on_demote=None,
 ):
     """Wire up the thread set for a backend; returns (threads, rpc_queue).
 
@@ -63,11 +64,14 @@ def build_threads(
         sharded = ShardedElector(
             backend, identity=ha_identity,
             peers=shard_peers or [ha_identity], n_shards=shards,
+            on_demote=on_demote,
         )
     elif ha_identity:
         from nhd_tpu.k8s.lease import LeaderElector
 
-        elector = LeaderElector(backend, identity=ha_identity)
+        elector = LeaderElector(
+            backend, identity=ha_identity, on_demote=on_demote
+        )
 
     scheduler = Scheduler(
         backend, watch_q, rpc_q, respect_busy=respect_busy,
@@ -275,10 +279,11 @@ def main(argv=None) -> int:
         else:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    trace_capacity = int(os.environ.get("NHD_TRACE_CAPACITY", "16384"))
     if args.trace_out:
         from nhd_tpu import obs
 
-        obs.enable(capacity=int(os.environ.get("NHD_TRACE_CAPACITY", "16384")))
+        obs.enable(capacity=trace_capacity)
         logger.warning(f"flight recorder on; traces → {args.trace_out}")
 
     if args.explain or args.explain_pod:
@@ -305,6 +310,14 @@ def main(argv=None) -> int:
         import socket
 
         ha_identity = args.ha_identity or f"{socket.gethostname()}-{os.getpid()}"
+        if args.trace_out:
+            from nhd_tpu import obs
+
+            # re-install the ring with this replica's identity stamped
+            # on every span (nothing has recorded yet — threads start
+            # below): merged cross-replica journeys attribute each leg
+            # by it (obs/chrome.py merge_chrome_traces)
+            obs.enable(capacity=trace_capacity, identity=ha_identity)
     if args.shards > 1:
         shard_peers = sorted(
             {p.strip() for p in (args.shard_replicas or "").split(",")
@@ -324,10 +337,32 @@ def main(argv=None) -> int:
     elif args.ha:
         logger.warning(f"HA mode: competing for the lease as {ha_identity}")
 
+    on_demote = None
+    if args.trace_out and (args.ha or args.shards > 1):
+        from nhd_tpu import obs
+
+        # demotion dump (ISSUE 7 satellite): a deposed leader's final
+        # batch must stay investigable — the ring used to dump only on
+        # clean exit and Ctrl-C, but a demoted replica keeps running as
+        # a standby and its spans would age out of the ring. Throttled:
+        # a sharded handoff demotes once per lost shard, and each dump
+        # is a full ring serialization.
+        demote_state = {"last": 0.0}
+
+        def on_demote(why: str) -> None:
+            now = time.monotonic()
+            if now - demote_state["last"] < 5.0:
+                return
+            demote_state["last"] = now
+            rec = obs.get_recorder()
+            if rec is not None:
+                path = obs.dump_chrome_trace(rec, args.trace_out)
+                logger.warning(f"demoted ({why}); trace dumped to {path}")
+
     threads, _ = build_threads(
         backend, rpc_port=args.rpc_port, metrics_port=args.metrics_port,
         trace_dir=args.trace_out, ha_identity=ha_identity,
-        shards=args.shards, shard_peers=shard_peers,
+        shards=args.shards, shard_peers=shard_peers, on_demote=on_demote,
     )
     for t in threads:
         t.start()

@@ -70,6 +70,9 @@ class FakePod:
     configmap_name: Optional[str] = None
     hostname: str = ""
     subdomain: str = ""
+    # creationTimestamp in the backend's clock domain (the sim clock
+    # under chaos) — the SLO engine's time-to-bind origin
+    created: float = 0.0
 
 
 class FakeClusterBackend(ClusterBackend):
@@ -146,7 +149,8 @@ class FakeClusterBackend(ClusterBackend):
             uid = f"uid-{next(self._uid)}"
             pod = FakePod(name=name, namespace=ns, uid=uid,
                           scheduler_name=scheduler_name,
-                          resources=dict(resources or {}))
+                          resources=dict(resources or {}),
+                          created=self.clock())
             pod.annotations[CFG_TYPE_ANNOTATION] = cfg_type
             if groups:
                 pod.annotations[GROUPS_ANNOTATION] = groups
@@ -285,6 +289,14 @@ class FakeClusterBackend(ClusterBackend):
         with self._lock:
             p = self._pod(pod, ns)
             return dict(p.resources) if p else {}
+
+    def get_pod_created(self, pod: str, ns: str) -> Optional[float]:
+        with self._lock:
+            p = self._pod(pod, ns)
+            return p.created if p else None
+
+    def clock_now(self) -> float:
+        return self.clock()
 
     def get_scheduled_pods(self, scheduler: str) -> List[Tuple[str, str, str, str]]:
         with self._lock:

@@ -47,6 +47,47 @@ LEASE_NAME = "nhd-scheduler-leader"
 #: the first-spill stamp ("since", for the orphan-age metrics)
 SPILLOVER_ANNOTATION = f"{DOMAIN}/nhd_spillover"
 
+#: cross-replica trace context (docs/OBSERVABILITY.md "Federation"): one
+#: JSON annotation stamped at a pod's FIRST receipt by any replica —
+#: the correlation ID, the origin replica, and the receipt wall stamp.
+#: Every later replica that drives the pod (spillover claim, shard
+#: handoff, post-restart retry) ADOPTS the recorded corr ID instead of
+#: minting its own, so N processes' flight-recorder dumps merge into one
+#: journey per pod (obs/chrome.py merge_chrome_traces).
+TRACE_ANNOTATION = f"{DOMAIN}/nhd_trace"
+
+
+def parse_trace_record(raw: Optional[str]) -> Optional[dict]:
+    """Decode a trace-context annotation; None for absence or garbage
+    (a malformed record just means the next replica re-stamps — trace
+    continuity is best-effort, never load-bearing for scheduling)."""
+    if not raw:
+        return None
+    import json
+
+    try:
+        data = json.loads(raw)
+        corr = str(data["corr"])
+        if not corr:
+            return None
+        return {
+            "corr": corr,
+            "origin": str(data.get("origin", "")),
+            "t0": float(data["t0"]) if data.get("t0") is not None else None,
+        }
+    except (ValueError, TypeError, KeyError):
+        return None
+
+
+def render_trace_record(rec: dict) -> str:
+    import json
+
+    return json.dumps({
+        "corr": rec["corr"],
+        "origin": rec.get("origin", ""),
+        "t0": rec.get("t0"),
+    }, sort_keys=True)
+
 
 def parse_spill_record(raw: Optional[str]) -> dict:
     """Decode a spillover annotation; tolerant of absence and garbage
@@ -192,6 +233,16 @@ class ClusterBackend(ABC):
     def get_pod_annotations(self, pod: str, ns: str) -> Optional[Dict[str, str]]:
         """(K8SMgr.py:194-202)"""
 
+    def get_pod_annotations_cached(
+        self, pod: str, ns: str
+    ) -> Optional[Dict[str, str]]:
+        """Annotations at watch-level freshness: backends with a
+        watch-derived pod mirror may serve this without an API read.
+        For consumers where slightly-stale is acceptable (trace-corr
+        adoption) — NEVER for fenced CAS paths, which must read live.
+        Default: the live read."""
+        return self.get_pod_annotations(pod, ns)
+
     @abstractmethod
     def get_cfg_annotations(self, pod: str, ns: str) -> Optional[str]:
         """The solved-config annotation, if present (K8SMgr.py:137-150)."""
@@ -207,6 +258,26 @@ class ClusterBackend(ABC):
     @abstractmethod
     def get_requested_pod_resources(self, pod: str, ns: str) -> Dict[str, str]:
         """First container's resource requests (K8SMgr.py:215-225)."""
+
+    def get_pod_created(self, pod: str, ns: str) -> Optional[float]:
+        """The pod's creationTimestamp in THIS backend's clock domain
+        (``clock_now``), or None when unknown. This is the SLO engine's
+        time-to-bind origin (obs/slo.py): unlike the local enqueue
+        stamp, it survives spillover hops, shard handoffs, and replica
+        restarts — the cluster, not any one process, owns it. Default
+        None keeps duck-typed test backends working (SLO observation is
+        simply skipped)."""
+        return None
+
+    def clock_now(self) -> float:
+        """Now, in the same clock domain ``get_pod_created`` reports in
+        (wall time against a real API server; the injectable sim clock
+        on the fake). Callers compute time-to-bind as
+        ``clock_now() - get_pod_created(...)`` — never by mixing in a
+        local monotonic stamp."""
+        import time
+
+        return time.time()
 
     @abstractmethod
     def get_scheduled_pods(self, scheduler: str) -> List[Tuple[str, str, str, str]]:

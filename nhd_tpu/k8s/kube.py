@@ -46,9 +46,11 @@ from nhd_tpu.utils import get_logger
 # missed (docs/RESILIENCE.md).
 _RESYNC_DEFAULT_SEC = float(os.environ.get("NHD_RESYNC_SEC", "300"))
 
-# last-seen pod snapshot: (uid, annotations, scheduler_name, node) — what a
-# synthetic delete event must carry after the object is gone
-_PodSnap = Tuple[str, Dict[str, str], str, str]
+# last-seen pod snapshot: (uid, annotations, scheduler_name, node,
+# created) — what a synthetic delete event must carry after the object is
+# gone, plus the creationTimestamp (epoch seconds or None) so the SLO
+# engine's per-bind get_pod_created is a dict lookup, not a pod GET
+_PodSnap = Tuple[str, Dict[str, str], str, str, Optional[float]]
 
 # namespace holding the election Lease object (the scheduler Deployment's
 # own namespace in the 2-replica recipe, docs/OPERATIONS.md)
@@ -284,6 +286,34 @@ class KubeClusterBackend(ClusterBackend):
     def get_pod_annotations(self, pod: str, ns: str) -> Optional[Dict[str, str]]:
         obj = self._read_pod(pod, ns)
         return dict(obj.metadata.annotations or {}) if obj else None
+
+    def get_pod_annotations_cached(
+        self, pod: str, ns: str
+    ) -> Optional[Dict[str, str]]:
+        """Watch-level freshness from the _PodSnap mirror — the
+        trace-corr adoption read per pod per batch stays a dict lookup
+        instead of a pod GET; live read only for pods the watch has not
+        delivered."""
+        with self._state_lock:
+            snap = self._known_pods.get((ns, pod))
+        if snap is not None:
+            return dict(snap[1])
+        return self.get_pod_annotations(pod, ns)
+
+    def get_pod_created(self, pod: str, ns: str) -> Optional[float]:
+        """metadata.creationTimestamp as epoch seconds (the wall-clock
+        domain clock_now reports in) — the SLO time-to-bind origin,
+        owned by the API server so it survives spills and restarts.
+        Served from the watch-derived snapshot (creationTimestamp is
+        immutable, and _known_pods tracks delete/re-create) so the
+        per-bind SLO observation costs a dict lookup, not a pod GET;
+        the GET is only the cold-start fallback for pods the watch has
+        not delivered."""
+        with self._state_lock:
+            snap = self._known_pods.get((ns, pod))
+        if snap is not None and snap[4] is not None:
+            return snap[4]
+        return self._created_ts(self._read_pod(pod, ns))
 
     def get_cfg_annotations(self, pod: str, ns: str) -> Optional[str]:
         annots = self.get_pod_annotations(pod, ns)
@@ -590,12 +620,23 @@ class KubeClusterBackend(ClusterBackend):
             self._stop_watcher(w)
 
     @staticmethod
+    def _created_ts(obj) -> Optional[float]:
+        ts = getattr(obj.metadata, "creation_timestamp", None) if obj else None
+        if ts is None:
+            return None
+        try:
+            return ts.timestamp()
+        except (AttributeError, ValueError):
+            return None
+
+    @staticmethod
     def _pod_snap(obj) -> _PodSnap:
         return (
             obj.metadata.uid,
             dict(obj.metadata.annotations or {}),
             obj.spec.scheduler_name or "",
             obj.spec.node_name or "",
+            KubeClusterBackend._created_ts(obj),
         )
 
     def _note_pod(self, ev_type: str, obj) -> Optional[WatchEvent]:

@@ -83,6 +83,7 @@ class LeaderElector:
         ttl: float = LEASE_TTL_SEC,
         clock: Callable[[], float] = time.monotonic,
         counters: ApiCounters = API_COUNTERS,
+        on_demote: Optional[Callable[[str], None]] = None,
     ):
         if ttl <= 0:
             raise ValueError(f"lease ttl must be > 0, got {ttl}")
@@ -93,6 +94,11 @@ class LeaderElector:
         self.logger = get_logger(__name__)
         self._clock = clock
         self._counters = counters
+        # fires once per leader→follower transition (with the reason),
+        # AFTER the state flip — the flight-recorder demotion dump rides
+        # this (cli.py): a deposed leader's final batch stays
+        # investigable instead of only surviving clean exits
+        self._on_demote = on_demote
         self._lock = threading.Lock()
         self._leader = False
         self._epoch = 0           # last epoch we led under (never rewinds)
@@ -207,6 +213,14 @@ class LeaderElector:
         self._counters.inc("ha_transitions_total")
         self._counters.set("ha_is_leader", 0)
         self.logger.warning(f"{self.identity}: stepping down — {why}")
+        if self._on_demote is not None:
+            # outside the lock: the callback may do I/O (trace dump) and
+            # must never wedge the election; its failure is loggable, not
+            # demotable — the state flip above already happened
+            try:
+                self._on_demote(why)
+            except Exception:
+                self.logger.exception("on_demote callback failed")
 
 
 class LeaseKeeper(threading.Thread):
@@ -474,6 +488,7 @@ class ShardedElector:
         clock: Callable[[], float] = time.monotonic,
         counters: ApiCounters = API_COUNTERS,
         patience: int = SHARD_PATIENCE_TICKS,
+        on_demote: Optional[Callable[[str], None]] = None,
     ):
         if n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, got {n_shards}")
@@ -494,11 +509,18 @@ class ShardedElector:
         # thrash ha_is_leader/ha_epoch; _publish() writes the
         # replica-level truth for those instead)
         inner_counters = _MonotonicOnly(counters)
+        # shard-qualified demotion callback: every lost shard is a
+        # demotion event for the dump hook (the presence beacon is NOT —
+        # losing it costs rendezvous preference, not leadership)
         self._electors: Dict[int, LeaderElector] = {
             s: LeaderElector(
                 backend, identity=identity,
                 lease_name=shard_lease_name(s, n_shards),
                 ttl=ttl, clock=clock, counters=inner_counters,
+                on_demote=(
+                    None if on_demote is None
+                    else (lambda why, _s=s: on_demote(f"shard {_s}: {why}"))
+                ),
             )
             for s in range(n_shards)
         }

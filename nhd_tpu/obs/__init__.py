@@ -17,10 +17,15 @@ from nhd_tpu.obs.chrome import (
     chrome_trace,
     chrome_trace_of,
     dump_chrome_trace,
+    journey_replicas,
+    merge_chrome_traces,
+    pod_journeys,
+    scheduled_journeys,
     validate_chrome_trace,
 )
-from nhd_tpu.obs.histo import HISTOGRAMS, Histogram
+from nhd_tpu.obs.histo import HISTOGRAMS, LABELED_HISTOGRAMS, Histogram
 from nhd_tpu.obs.jitstats import JIT_STATS
+from nhd_tpu.obs.slo import SLO, SloTracker
 from nhd_tpu.obs.recorder import (
     FlightRecorder,
     Span,
@@ -39,6 +44,9 @@ __all__ = [
     "HISTOGRAMS",
     "Histogram",
     "JIT_STATS",
+    "LABELED_HISTOGRAMS",
+    "SLO",
+    "SloTracker",
     "Span",
     "chrome_trace",
     "chrome_trace_of",
@@ -49,7 +57,11 @@ __all__ = [
     "dump_chrome_trace",
     "enable",
     "get_recorder",
+    "journey_replicas",
+    "merge_chrome_traces",
     "new_corr_id",
+    "pod_journeys",
+    "scheduled_journeys",
     "span",
     "validate_chrome_trace",
 ]

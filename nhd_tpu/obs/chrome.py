@@ -26,11 +26,20 @@ _PID = 1
 
 
 def chrome_trace(recorder: FlightRecorder) -> dict:
-    """Render the recorder's current ring as a Chrome trace dict."""
-    return chrome_trace_of(recorder.spans())
+    """Render the recorder's current ring as a Chrome trace dict. The
+    export carries an ``nhdMeta`` block (replica identity + the
+    monotonic→wall anchor) so N replicas' dumps can be merged onto one
+    timeline (merge_chrome_traces)."""
+    return chrome_trace_of(
+        recorder.spans(),
+        meta={
+            "replica": recorder.identity,
+            "epochOffset": recorder.epoch_offset,
+        },
+    )
 
 
-def chrome_trace_of(spans: List[Span]) -> dict:
+def chrome_trace_of(spans: List[Span], *, meta: Optional[dict] = None) -> dict:
     origin = min((s.t0 for s in spans), default=0.0)
     tids: Dict[str, int] = {}
     for name in sorted({s.thread for s in spans}):
@@ -47,6 +56,14 @@ def chrome_trace_of(spans: List[Span]) -> dict:
         args: dict = {"corr": s.corr}
         if s.attrs:
             args.update(s.attrs)
+        # federation coordinates, only where stamped: which replica
+        # produced the span, and which (shard, fencing epoch) covered a
+        # commit-path leg — a merged journey shows every leadership a
+        # pod's life ran under
+        for key in ("replica", "shard", "epoch"):
+            v = getattr(s, key, None)
+            if v is not None:
+                args[key] = v
         body.append({
             "ph": "X",
             "name": s.name,
@@ -60,7 +77,127 @@ def chrome_trace_of(spans: List[Span]) -> dict:
         })
     body.sort(key=lambda e: (e["ts"], e["tid"], e["name"]))
     events.extend(body)
-    return {"displayTimeUnit": "ms", "traceEvents": events}
+    out = {"displayTimeUnit": "ms", "traceEvents": events}
+    if meta is not None:
+        out["nhdMeta"] = {**meta, "originMono": origin}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cross-replica journey merge (ISSUE 7): N replicas' dumps → one timeline
+# ---------------------------------------------------------------------------
+
+
+def merge_chrome_traces(traces: List[dict]) -> dict:
+    """Merge N replicas' trace dumps into ONE Chrome trace: each input
+    becomes its own pid (process row) named by its replica identity, and
+    timestamps are re-based onto a shared wall clock via each dump's
+    ``nhdMeta`` anchor (originMono + epochOffset) — so a pod that spilled
+    across shards reads as one journey whose legs line up in real time.
+
+    Re-basing is all-or-none: dumps without an ``nhdMeta`` anchor
+    (pre-federation exports) have no wall reference, and mixing one into
+    an anchored set would put it ~epoch-seconds away from the rest in
+    the viewer — so if ANY input lacks the anchor, every input merges on
+    its raw relative timestamps (correct within one process, best effort
+    across several). Deterministic for deterministic input: pids are
+    assigned in (replica name, input order) order and events sort by
+    (ts, pid, tid, name)."""
+    keyed = sorted(
+        enumerate(traces),
+        key=lambda it: (
+            str((it[1].get("nhdMeta") or {}).get("replica", "")), it[0]
+        ),
+    )
+    # each dump's absolute wall time at ts=0, or None when unanchored
+    wall0: List[Optional[float]] = []
+    for _, t in keyed:
+        m = t.get("nhdMeta") or {}
+        if "originMono" in m:
+            wall0.append(
+                float(m["originMono"]) + float(m.get("epochOffset", 0.0))
+            )
+        else:
+            wall0.append(None)
+    if any(w is None for w in wall0):
+        wall0 = [0.0] * len(wall0)
+    base = min(wall0, default=0.0)
+    events: List[dict] = []
+    body: List[dict] = []
+    replicas: List[str] = []
+    for pid0, ((idx, trace), w0) in enumerate(zip(keyed, wall0), start=1):
+        m = trace.get("nhdMeta") or {}
+        name = str(m.get("replica") or f"replica-{idx}")
+        replicas.append(name)
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid0, "tid": 0,
+            "args": {"name": name},
+        })
+        shift = (w0 - base) * 1e6
+        for ev in trace.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid0
+            if ev.get("ph") == "X":
+                ev["ts"] = round(float(ev.get("ts", 0.0)) + shift, 3)
+                body.append(ev)
+            else:
+                events.append(ev)
+    body.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"]))
+    events.extend(body)
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "nhdMeta": {"merged": True, "replicas": replicas},
+    }
+
+
+def pod_journeys(trace: dict) -> Dict[str, List[dict]]:
+    """corr ID → that pod's spans (X events), each journey sorted by
+    timestamp. Works on single-replica exports and merged traces alike —
+    the fleet aggregator and the federation tests both read journeys
+    through this one definition."""
+    out: Dict[str, List[dict]] = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        corr = (ev.get("args") or {}).get("corr")
+        if not corr:
+            continue
+        out.setdefault(str(corr), []).append(ev)
+    for evs in out.values():
+        evs.sort(key=lambda e: (e.get("ts", 0.0), e.get("name", "")))
+    return out
+
+
+def scheduled_journeys(journeys: Dict[str, List[dict]]) -> Dict[str, List[dict]]:
+    """Journeys that progressed past watch receipt. EVERY replica
+    records a watch_event under its own locally minted corr (standbys
+    included), and only the replica that schedules the pod re-aliases
+    its receipt leg into the adopted journey — counting the one-span
+    receipt orphans as journeys inflates the pod tally roughly
+    n_replicas-fold."""
+    return {
+        corr: evs for corr, evs in journeys.items()
+        if any(ev.get("name") != "watch_event" for ev in evs)
+    }
+
+
+def journey_replicas(
+    trace: dict, corr: str, journeys: Optional[Dict[str, List[dict]]] = None
+) -> List[str]:
+    """The distinct replica identities that produced spans for one corr
+    ID — ≥2 proves a cross-replica journey (spillover hop, shard
+    handoff, fenced rejection + retry on the new owner). Pass the
+    precomputed ``pod_journeys(trace)`` dict when iterating many corrs —
+    rebuilding the index per corr is quadratic."""
+    if journeys is None:
+        journeys = pod_journeys(trace)
+    seen = []
+    for ev in journeys.get(corr, []):
+        rep = (ev.get("args") or {}).get("replica")
+        if rep and rep not in seen:
+            seen.append(rep)
+    return seen
 
 
 def validate_chrome_trace(trace: object) -> List[str]:

@@ -106,6 +106,64 @@ class Histogram:
         return lines
 
 
+class LabeledHistogram:
+    """A histogram family with ONE label dimension (e.g. per solver
+    round phase). Child histograms materialize on first observe; the
+    label set must be bounded by construction at the call sites — phase
+    names come from solver code, never from pod/corr identifiers
+    (nhdlint NHD603 polices the unbounded-cardinality mistake)."""
+
+    def __init__(
+        self, name: str, label: str, help_text: str,
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.label = label
+        self.help_text = help_text
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        self._children: Dict[str, Histogram] = {}
+
+    def observe(self, label_value: str, value: float) -> None:
+        with self._lock:
+            child = self._children.get(label_value)
+            if child is None:
+                child = Histogram(self.name, self.help_text, self.buckets)
+                self._children[label_value] = child
+        child.observe(value)
+
+    def render(self, prefix: str = "nhd_") -> List[str]:
+        full = f"{prefix}{self.name}"
+        with self._lock:
+            children = sorted(self._children.items())
+        if not children:
+            return []
+        lines = [
+            f"# HELP {full} {self.help_text}",
+            f"# TYPE {full} histogram",
+        ]
+        for label_value, child in children:
+            cum, total_sum, total_count = child.snapshot()
+            sel = f'{self.label}="{label_value}"'
+            for edge, c in zip(child.buckets, cum):
+                lines.append(
+                    f'{full}_bucket{{{sel},le="{_fmt(edge)}"}} {c}'
+                )
+            lines.append(f'{full}_bucket{{{sel},le="+Inf"}} {cum[-1]}')
+            lines.append(f'{full}_sum{{{sel}}} {_fmt(total_sum)}')
+            lines.append(f'{full}_count{{{sel}}} {total_count}')
+        return lines
+
+    def snapshot(self) -> Dict[str, Tuple[List[int], float, int]]:
+        with self._lock:
+            children = dict(self._children)
+        return {k: child.snapshot() for k, child in children.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._children.clear()
+
+
 # ---------------------------------------------------------------------------
 # registry: adding a histogram here is all it takes to surface it on
 # /metrics (rpc/metrics.py renders the whole table, mirroring the
@@ -140,6 +198,27 @@ HISTOGRAMS: Dict[str, Histogram] = {
             "Retry-layer API call latency (incl. backoff sleeps)",
             API_BUCKETS,
         ),
+        Histogram(
+            "time_to_bind_seconds",
+            "True end-to-end pod creationTimestamp to bound (survives "
+            "spillover hops, shard handoffs and replica restarts)",
+            # SLO-shaped edges: the default latency ladder plus the
+            # minutes range a spilled/orphaned pod can legitimately wait
+            (*DEFAULT_BUCKETS, 60.0, 120.0, 300.0, 600.0),
+        ),
+    )
+}
+
+#: labeled families — one label dimension each (bounded label sets)
+LABELED_HISTOGRAMS: Dict[str, LabeledHistogram] = {
+    h.name: h
+    for h in (
+        LabeledHistogram(
+            "round_phase_seconds", "phase",
+            "Per-batch wall seconds by solver round phase (encode / "
+            "materialize / upload / solve / select / readback ... — the "
+            "fine-grained device-phase attribution, ISSUE 7)",
+        ),
     )
 }
 
@@ -150,14 +229,28 @@ def observe(name: str, value: float) -> None:
     HISTOGRAMS[name].observe(value)
 
 
+def observe_labeled(name: str, label_value: str, value: float) -> None:
+    """Observe into a registered labeled family (KeyError on a typo)."""
+    LABELED_HISTOGRAMS[name].observe(label_value, value)
+
+
 def render_all(prefix: str = "nhd_") -> List[str]:
     lines: List[str] = []
     for name in HISTOGRAMS:
         lines.extend(HISTOGRAMS[name].render(prefix))
+    for name in LABELED_HISTOGRAMS:
+        lines.extend(LABELED_HISTOGRAMS[name].render(prefix))
     return lines
 
 
 def reset_all() -> None:
     """Back to all-zero (test isolation)."""
+    from nhd_tpu.obs.slo import SLO
+
     for h in HISTOGRAMS.values():
         h.reset()
+    for lh in LABELED_HISTOGRAMS.values():
+        lh.reset()
+    # the global SLO tracker rides the same /metrics plane and must not
+    # leak observations across reset_all-isolated tests
+    SLO.reset()

@@ -31,6 +31,13 @@ class JitStats:
         self._uses: Dict[str, int] = {}
         self._calls = 0
         self._compiles = 0
+        # per-(phase, shape-bucket) wall seconds + event counts: the
+        # round-phase attribution the perf-telemetry pipeline folds into
+        # bench artifacts and /metrics (ISSUE 7). Keys are
+        # "phase:shape"; shapes come from the solver's bucket keys, so
+        # cardinality is bounded by the compiled-program table.
+        self._phase_seconds: Dict[str, float] = {}
+        self._phase_counts: Dict[str, int] = {}
 
     def record_use(self, kind: str, shape_key: str) -> None:
         """One solver dispatch of *kind* at *shape_key* (the dims the
@@ -43,6 +50,18 @@ class JitStats:
                 self._uses[key] = 0
             self._uses[key] += 1
 
+    def record_phase(self, phase: str, shape_key: str, seconds: float) -> None:
+        """Attribute *seconds* of round wall time to *phase* at
+        *shape_key* (the cluster/bucket shape the round ran at) — fed by
+        BatchStats.phase_add, so every solver phase the overhead war
+        tracks lands here with its shape context."""
+        key = f"{phase}:{shape_key}"
+        with self._lock:
+            self._phase_seconds[key] = (
+                self._phase_seconds.get(key, 0.0) + seconds
+            )
+            self._phase_counts[key] = self._phase_counts.get(key, 0) + 1
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -51,6 +70,8 @@ class JitStats:
                 "cache_hits_total": self._calls - self._compiles,
                 "distinct_programs": len(self._uses),
                 "shapes": dict(self._uses),
+                "phase_seconds": dict(self._phase_seconds),
+                "phase_counts": dict(self._phase_counts),
             }
 
     def reset(self) -> None:
@@ -58,6 +79,8 @@ class JitStats:
             self._uses = {}
             self._calls = 0
             self._compiles = 0
+            self._phase_seconds = {}
+            self._phase_counts = {}
 
 
 #: process-wide registry (one jit cache per process, one counter set)

@@ -17,7 +17,8 @@ Design constraints, in order:
 * **thread-safe by construction** — spans arrive concurrently from the
   controller, scheduler, commit-pool, and RPC threads; the ring is a
   ``deque(maxlen=...)`` guarded by one lock, and a span is immutable
-  after ``record`` returns;
+  after ``record`` returns (sole exception: ``realias_corr`` rewrites
+  corr under the ring lock when cross-replica adoption lands late);
 * **bounded** — the ring evicts oldest-first and counts what it dropped
   (the ``nhd_trace_ring_dropped_total`` metric), so tracing can stay on
   in production without growing the heap.
@@ -45,9 +46,16 @@ _corr_seq = itertools.count(1)
 _CORR_VAR: "ContextVar[Optional[str]]" = ContextVar("nhd_corr", default=None)
 
 
-def new_corr_id() -> str:
-    """Mint a fresh correlation ID (process-unique, monotonic)."""
-    return f"c{next(_corr_seq):06d}"
+def new_corr_id(scope: str = "") -> str:
+    """Mint a fresh correlation ID (monotonic; unique within one
+    process). ``scope`` — the minting replica's identity — makes the ID
+    unique ACROSS processes too: every replica's counter restarts at 1,
+    so two replicas' locally minted ``c000001`` would otherwise fuse
+    unrelated pods into one journey when their dumps merge
+    (chrome.merge_chrome_traces). Adopted corrs keep their origin's
+    scope by construction (the annotation carries the full ID)."""
+    n = next(_corr_seq)
+    return f"{scope}/c{n:06d}" if scope else f"c{n:06d}"
 
 
 def current_corr_id() -> Optional[str]:
@@ -68,9 +76,20 @@ def correlate(corr: Optional[str]) -> Iterator[None]:
 
 class Span:
     """One recorded interval. Immutable after construction; __slots__
-    because a gang-scale batch records tens of thousands of these."""
+    because a gang-scale batch records tens of thousands of these.
 
-    __slots__ = ("name", "cat", "corr", "t0", "dur", "thread", "attrs")
+    ``replica``/``shard``/``epoch`` are the federation coordinates
+    (ISSUE 7): which replica produced the span, and — for spans on the
+    fenced commit path — which shard lease and fencing epoch covered
+    the work. ``replica`` is stamped by the recorder (every span a
+    replica records is that replica's); shard/epoch only where the
+    producer knows them, so a merged cross-replica journey shows which
+    leadership each leg ran under."""
+
+    __slots__ = (
+        "name", "cat", "corr", "t0", "dur", "thread", "attrs",
+        "replica", "shard", "epoch",
+    )
 
     def __init__(
         self,
@@ -82,6 +101,9 @@ class Span:
         corr: Optional[str] = None,
         thread: Optional[str] = None,
         attrs: Optional[dict] = None,
+        replica: Optional[str] = None,
+        shard: Optional[int] = None,
+        epoch: Optional[int] = None,
     ):
         self.name = name
         self.t0 = t0
@@ -90,6 +112,9 @@ class Span:
         self.corr = corr
         self.thread = thread or threading.current_thread().name
         self.attrs = attrs
+        self.replica = replica
+        self.shard = shard
+        self.epoch = epoch
 
     def to_dict(self) -> dict:
         d = {
@@ -98,6 +123,10 @@ class Span:
         }
         if self.attrs:
             d["attrs"] = dict(self.attrs)
+        for key in ("replica", "shard", "epoch"):
+            v = getattr(self, key)
+            if v is not None:
+                d[key] = v
         return d
 
 
@@ -109,13 +138,26 @@ class FlightRecorder:
     that must not be evicted by span churn from one big batch).
     """
 
-    def __init__(self, capacity: int = 16384, decision_capacity: int = 256):
+    def __init__(
+        self,
+        capacity: int = 16384,
+        decision_capacity: int = 256,
+        *,
+        identity: str = "",
+    ):
         if capacity < 1 or decision_capacity < 1:
             raise ValueError(
                 f"capacities must be >= 1, got {capacity}/{decision_capacity}"
             )
         self.capacity = capacity
         self.decision_capacity = decision_capacity
+        # federation coordinates: which replica this ring belongs to
+        # (stamped onto every span), and the monotonic→wall anchor the
+        # cross-replica merge uses to put N processes' spans on one
+        # timeline (chrome.merge_chrome_traces). Captured once — the
+        # pair drifts together, which is exactly what re-basing needs.
+        self.identity = identity
+        self.epoch_offset = time.time() - time.monotonic()
         self._lock = threading.Lock()
         self._spans: "deque[Span]" = deque(maxlen=capacity)
         self._decisions: "deque[dict]" = deque(maxlen=decision_capacity)
@@ -133,17 +175,50 @@ class FlightRecorder:
         corr: Optional[str] = None,
         thread: Optional[str] = None,
         attrs: Optional[dict] = None,
+        shard: Optional[int] = None,
+        epoch: Optional[int] = None,
     ) -> None:
         """Append one span (t0 on the time.monotonic() clock, seconds)."""
         span = Span(
             name, t0, dur, cat=cat,
             corr=corr if corr is not None else _CORR_VAR.get(),
             thread=thread, attrs=attrs,
+            replica=self.identity or None, shard=shard, epoch=epoch,
         )
         with self._lock:
             if len(self._spans) == self.capacity:
                 self._dropped += 1
             self._spans.append(span)
+
+    def realias_corr(self, old: str, new: str) -> int:
+        """Rewrite ring spans recorded under *old* to carry *new* —
+        see realias_corrs. Returns the number of spans re-aliased."""
+        return self.realias_corrs({old: new})
+
+    def realias_corrs(self, mapping: Dict[str, str]) -> int:
+        """Rewrite ring spans whose corr is a key of *mapping* to carry
+        the mapped ID, in ONE ring pass.
+
+        The watch-receipt leg is recorded before the scheduler can read
+        the pod's cluster-stamped corr (adoption happens at batch
+        admission, _resolve_trace_corr); when adoption changes IDs,
+        this re-joins those already-recorded legs to their journeys
+        instead of orphaning them as one-span corrs. Batched because the
+        pass holds the ring lock every producer thread records under —
+        one O(capacity) scan per BATCH, not per pod. The sole sanctioned
+        mutation of a recorded span: corr only, under the ring lock.
+        Returns the number of spans re-aliased."""
+        mapping = {o: n for o, n in mapping.items() if o != n}
+        if not mapping:
+            return 0
+        n = 0
+        with self._lock:
+            for s in self._spans:
+                new = mapping.get(s.corr)
+                if new is not None:
+                    s.corr = new
+                    n += 1
+        return n
 
     def record_decision(self, decision: dict) -> None:
         """Append one per-pod scheduling decision (see scheduler/core.py
@@ -195,11 +270,17 @@ def get_recorder() -> Optional[FlightRecorder]:
 
 
 def enable(
-    capacity: int = 16384, decision_capacity: int = 256
+    capacity: int = 16384, decision_capacity: int = 256, *,
+    identity: str = "",
 ) -> FlightRecorder:
-    """Install (or replace) the process-global recorder and return it."""
+    """Install (or replace) the process-global recorder and return it.
+    ``identity`` names this replica in every span it records — set it
+    under HA/federation so merged cross-replica journeys attribute each
+    leg (chrome.merge_chrome_traces)."""
     global _RECORDER
-    _RECORDER = FlightRecorder(capacity, decision_capacity)
+    _RECORDER = FlightRecorder(
+        capacity, decision_capacity, identity=identity
+    )
     return _RECORDER
 
 

@@ -132,6 +132,26 @@ def render_metrics(
         ]
         for key, uses in sorted(jit["shapes"].items()):
             lines.append(f'nhd_jit_shape_uses_total{{shape="{key}"}} {uses}')
+    if jit.get("phase_seconds"):
+        # round-phase attribution per shape bucket (ISSUE 7 perf
+        # pipeline): where each cluster shape's wall time actually went
+        lines += [
+            "# HELP nhd_jit_phase_seconds_total Solver round wall "
+            "seconds by phase and shape bucket",
+            "# TYPE nhd_jit_phase_seconds_total counter",
+        ]
+        for key, secs in sorted(jit["phase_seconds"].items()):
+            pname, _, shape = key.partition(":")
+            lines.append(
+                f'nhd_jit_phase_seconds_total'
+                f'{{phase="{pname}",shape="{shape}"}} {secs}'
+            )
+
+    # SLO plane (obs/slo.py): true creation→bind time against the
+    # multi-window burn-rate objective
+    from nhd_tpu.obs.slo import SLO
+
+    lines += SLO.render()
 
     # flight-recorder ring state
     rec = get_recorder()

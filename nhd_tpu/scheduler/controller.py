@@ -48,6 +48,7 @@ class Controller(threading.Thread):
         poll_interval: float = 0.1,
         isolate_events: bool = True,
         elector=None,
+        recorder=None,
     ):
         super().__init__(name="nhd-controller", daemon=True)
         self.logger = get_logger(__name__)
@@ -55,6 +56,9 @@ class Controller(threading.Thread):
         self.queue = watch_queue
         self.sched_name = sched_name
         self.poll_interval = poll_interval
+        # per-replica flight recorder (None → process-global): the chaos
+        # harness runs N replicas in one process, each with its own ring
+        self._recorder = recorder
         # HA standby mode (k8s/lease.py): watch translation always runs
         # (the scheduler's standby path keeps its node mirror warm from
         # it), but TriadSet reconciliation MUTATES the cluster (pod
@@ -132,10 +136,11 @@ class Controller(threading.Thread):
         )
         # correlation ID minted at watch-event receipt: this is where one
         # pod's decision path enters the process, and every later span
-        # (queue wait, solve, select, assign, bind) carries this ID
-        corr = new_corr_id()
+        # (queue wait, solve, select, assign, bind) carries this ID —
+        # scoped by replica identity so N processes' dumps merge cleanly
+        rec = self._recorder if self._recorder is not None else get_recorder()
+        corr = new_corr_id(rec.identity if rec is not None else "")
         t_recv = time.monotonic()
-        rec = get_recorder()
         if rec is not None:
             rec.record(
                 "watch_event", t_recv, 0.0, cat="event", corr=corr,

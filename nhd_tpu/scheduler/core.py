@@ -24,17 +24,26 @@ from nhd_tpu.core.node import HostNode
 from nhd_tpu.core.request import PodRequest
 from nhd_tpu.k8s.interface import (
     SPILLOVER_ANNOTATION,
+    TRACE_ANNOTATION,
     ClusterBackend,
     EventType,
     StaleLeaseError,
     TransientBackendError,
     parse_spill_record,
+    parse_trace_record,
     render_spill_record,
+    render_trace_record,
 )
 from nhd_tpu.k8s.lease import LeaderElector, ShardedElector, shard_for_groups
 from nhd_tpu.k8s.retry import API_COUNTERS
 from nhd_tpu.obs import histo as obs_histo
-from nhd_tpu.obs.recorder import correlate, get_recorder, new_corr_id
+from nhd_tpu.obs import slo as obs_slo
+from nhd_tpu.obs.recorder import (
+    FlightRecorder,
+    correlate,
+    get_recorder,
+    new_corr_id,
+)
 from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
 from nhd_tpu.solver.batch import BatchItem, BatchScheduler
 from nhd_tpu.utils import get_logger
@@ -225,6 +234,8 @@ class Scheduler(threading.Thread):
         elector: Optional[LeaderElector] = None,
         sharded: Optional[ShardedElector] = None,
         clock: Callable[[], float] = time.time,
+        recorder: Optional[FlightRecorder] = None,
+        slo: Optional[obs_slo.SloTracker] = None,
     ):
         super().__init__(name="nhd-scheduler", daemon=True)
         self.logger = get_logger(__name__)
@@ -256,6 +267,18 @@ class Scheduler(threading.Thread):
         # injectable wall clock for spillover 'since' stamps (chaos runs
         # drive the orphan window off the sim's step clock)
         self._spill_clock = clock
+        # per-replica flight recorder (None → the process-global one):
+        # the chaos harness runs N replicas in one process and each must
+        # own its span ring for the cross-replica journey merge
+        self._recorder = recorder
+        # per-replica SLO tracker (None → the process-global obs.slo.SLO)
+        self._slo = slo
+        # this replica's identity in merged journeys / trace stamps
+        self.replica_id = (
+            sharded.identity if sharded is not None
+            else elector.identity if elector is not None
+            else f"solo-{os.getpid()}"
+        )
         # loop-liveness heartbeat, observed by the stall watchdog
         # (k8s/lease.py StallWatchdog): refreshed at the top of every
         # run_once turn — the same turn the flight-recorder spans and
@@ -449,6 +472,89 @@ class Scheduler(threading.Thread):
         req = PodRequest.from_topology(top, node_groups=groups)
         return parser, BatchItem((ns, pod), req, top)
 
+    # ------------------------------------------------------------------
+    # observability seams (per-replica recorder / SLO / trace context)
+    # ------------------------------------------------------------------
+
+    def _rec(self) -> Optional[FlightRecorder]:
+        """This replica's flight recorder: the injected per-replica ring
+        under the chaos harness (N replicas, one process), else the
+        process-global one. One read — the recorder-off hot path stays
+        one module-global load."""
+        return self._recorder if self._recorder is not None else get_recorder()
+
+    def _slo_tracker(self) -> obs_slo.SloTracker:
+        return self._slo if self._slo is not None else obs_slo.SLO
+
+    def _backend_now(self) -> float:
+        """Now in the backend's clock domain (the creationTimestamp
+        domain) — the only clock time-to-bind may be computed in."""
+        fn = getattr(self.backend, "clock_now", None)
+        return fn() if fn is not None else time.time()
+
+    def _resolve_trace_corr(self, pod: str, ns: str, corr: str) -> str:
+        """Cross-replica trace continuity: ADOPT the corr ID another
+        replica already stamped onto the pod (spillover hop, shard
+        handoff, restart retry — the journey keeps ONE ID), or stamp
+        ours at first receipt so later replicas adopt it. Best-effort on
+        both legs: an unreadable pod or a fenced-off stamp costs trace
+        continuity for this attempt, never scheduling. Watch-level
+        freshness suffices for best-effort tracing, so the read is the
+        cached one — no per-pod GET per batch on the kube backend."""
+        try:
+            annots = self.backend.get_pod_annotations_cached(pod, ns)
+        except TransientBackendError:
+            return corr
+        trace = parse_trace_record((annots or {}).get(TRACE_ANNOTATION))
+        if trace is not None:
+            return trace["corr"]
+        if annots is None:
+            return corr  # pod gone: nothing to stamp
+        payload = render_trace_record({
+            "corr": corr, "origin": self.replica_id,
+            "t0": self._backend_now(),
+        })
+        try:
+            if self.sharded is not None:
+                owned = self._owned_shards()
+                if not owned:
+                    return corr
+                self._commit_write(
+                    self.backend.annotate_pod_meta, ns, pod,
+                    TRACE_ANNOTATION, payload, shard=min(owned),
+                )
+            else:
+                self._commit_write(
+                    self.backend.annotate_pod_meta, ns, pod,
+                    TRACE_ANNOTATION, payload,
+                )
+        except TransientBackendError:
+            pass
+        return corr
+
+    def _observe_slo_bind(self, pod: str, ns: str) -> None:
+        """Feed the SLO engine one bound pod's TRUE end-to-end
+        time-to-bind: creationTimestamp → now, both in the backend's
+        clock domain. Unlike the local t_enqueue stamp this survives
+        spillover hops, shard handoffs and replica restarts — the
+        cluster owns the origin stamp (obs/slo.py)."""
+        try:
+            created = self.backend.get_pod_created(pod, ns)
+        except TransientBackendError:
+            return
+        if created is None:
+            return
+        now = self._backend_now()
+        tt = max(now - created, 0.0)
+        obs_histo.observe("time_to_bind_seconds", tt)
+        # tt is a duration, valid in any domain — but the window stamp
+        # must come from the TRACKER's own clock (the one burn_rate and
+        # render cut windows with). Passing the backend's now here mixes
+        # domains: on a fake backend (monotonic clock) vs the global
+        # tracker (wall clock) every burn-rate gauge would read 0
+        # forever. Chaos stays exact: its trackers run on the sim clock.
+        self._slo_tracker().observe(tt)
+
     def attempt_scheduling_batch(
         self,
         pods: List[Tuple[str, str, str]],
@@ -465,14 +571,28 @@ class Scheduler(threading.Thread):
         """
         self._beat()
         t_adm = time.monotonic()
-        rec = get_recorder()
+        rec = self._rec()
         uids = {(ns, pod): uid for pod, ns, uid in pods}
         corrs: Dict[Tuple[str, str], str] = {}
         waits: Dict[Tuple[str, str], float] = {}
+        adopted: Dict[str, str] = {}
         for pod, ns, _uid in pods:
             key = (ns, pod)
             corr, t_enq = (meta or {}).get(key, (None, 0.0))
-            corrs[key] = corr or new_corr_id()
+            corrs[key] = corr or new_corr_id(
+                rec.identity if rec is not None else ""
+            )
+            if rec is not None:
+                # cross-replica journey continuity: adopt (or stamp) the
+                # pod's cluster-held corr ID — one annotation read per
+                # pod per batch, paid only with tracing on
+                resolved = self._resolve_trace_corr(pod, ns, corrs[key])
+                if resolved != corrs[key]:
+                    # the watch-receipt span was recorded under the
+                    # locally minted corr before the cluster's was
+                    # readable — re-join that leg to the journey
+                    adopted[corrs[key]] = resolved
+                    corrs[key] = resolved
             if t_enq:
                 wait = max(t_adm - t_enq, 0.0)
                 waits[key] = wait
@@ -482,6 +602,10 @@ class Scheduler(threading.Thread):
                         "queue_wait", t_enq, wait, cat="pod",
                         corr=corrs[key], attrs={"pod": f"{ns}/{pod}"},
                     )
+        if rec is not None and adopted:
+            # one ring pass for the whole batch (the pass holds the ring
+            # lock every producer thread shares — never per pod)
+            rec.realias_corrs(adopted)
         prepared: List[Tuple[CfgParser, BatchItem]] = []
         for pod, ns, _uid in pods:
             if not self.backend.pod_exists(pod, ns):
@@ -539,10 +663,16 @@ class Scheduler(threading.Thread):
         obs_histo.observe("solve_phase_seconds", bstats.solve_seconds)
         obs_histo.observe("select_phase_seconds", bstats.select_seconds)
         obs_histo.observe("assign_phase_seconds", bstats.assign_seconds)
+        # fine-grained device-phase attribution (encode / materialize /
+        # upload / solve / readback ...): the solver's per-batch phase
+        # breakdown, as one labeled histogram family — the per-shape
+        # split lands in the jit-stats table (BatchStats.phase_add)
+        for pname, pdt in bstats.phases.items():
+            obs_histo.observe_labeled("round_phase_seconds", pname, pdt)
         if rec is not None:
             rec.record(
                 "batch", t_batch_mono, time.perf_counter() - t_batch,
-                cat="batch", corr=new_corr_id(),
+                cat="batch", corr=new_corr_id(rec.identity),
                 attrs={"pods": len(prepared), "rounds": bstats.rounds},
             )
             # per-pod phase spans: the batch's solve/select/assign wall
@@ -630,6 +760,9 @@ class Scheduler(threading.Thread):
                 obs_histo.observe(
                     "bind_latency_seconds", max(t_done - t_adm, 0.0)
                 )
+                # SLO plane: creation → bound on the cluster's clock
+                # (one backend read per successful bind)
+                self._observe_slo_bind(pod, ns)
                 self._requeue_attempts.pop((ns, pod), None)
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.SCHEDULED, "time": time.time(),
@@ -726,14 +859,26 @@ class Scheduler(threading.Thread):
         with correlate(corr):
             outcome = self._commit_pod_calls(parser, item, result)
         t_done = time.monotonic()
-        rec = get_recorder()
+        rec = self._rec()
         if rec is not None:
+            # federation coordinates on the commit-path span: which
+            # shard lease and fencing epoch covered this bind (merged
+            # journeys show every leadership a pod's life ran under)
+            shard = epoch = None
+            if self.sharded is not None:
+                node = self.nodes.get(result.node)
+                if node is not None:
+                    shard = self._node_shard(node)
+                    epoch = self.sharded.fencing_epoch_for(shard)
+            elif self.elector is not None:
+                epoch = self.elector.fencing_epoch()
             rec.record(
                 "bind", t0, t_done - t0, cat="pod", corr=corr,
                 attrs={
                     "pod": f"{item.key[0]}/{item.key[1]}",
                     "node": result.node, "outcome": outcome.name,
                 },
+                shard=shard, epoch=epoch,
             )
         return outcome, t_done
 
@@ -1029,8 +1174,19 @@ class Scheduler(threading.Thread):
             )
             self.pod_state.pop((ns, pod), None)
             outcome = "spilled"
-        rec_sink = get_recorder()
+        rec_sink = self._rec()
         if rec_sink is not None:
+            # the spill hop is a journey leg: record it as a span too,
+            # so a merged cross-replica trace shows WHERE the pod left
+            # this replica's shards (shard = the fencing shard the
+            # record write was stamped under)
+            rec_sink.record(
+                "spill", time.monotonic(), 0.0, cat="pod", corr=corr,
+                attrs={"pod": f"{ns}/{pod}", "outcome": outcome,
+                       "tried": sorted(rec["tried"])},
+                shard=fence_shard,
+                epoch=self.sharded.fencing_epoch_for(fence_shard),
+            )
             rec_sink.record_decision(self._decision(pod, ns, corr, outcome))
 
     def _declare_shards_exhausted(

@@ -47,6 +47,7 @@ placed or explicitly declared unschedulable within a bounded age).
 from __future__ import annotations
 
 import json
+import os
 import queue
 import random
 from dataclasses import dataclass, field
@@ -67,7 +68,10 @@ from nhd_tpu.k8s.lease import (
     shard_for_group,
     shard_lease_name,
 )
-from nhd_tpu.k8s.retry import ApiCounters
+from nhd_tpu.k8s.retry import API_COUNTERS, ApiCounters
+from nhd_tpu.obs.chrome import chrome_trace, merge_chrome_traces
+from nhd_tpu.obs.recorder import FlightRecorder
+from nhd_tpu.obs.slo import SloTracker
 from nhd_tpu.scheduler.controller import Controller
 from nhd_tpu.scheduler.core import SPILLOVER_MAX_AGE_SEC, Scheduler
 from nhd_tpu.scheduler.events import WatchQueue
@@ -183,18 +187,33 @@ class _FedReplica:
         else:
             self.faulty = None
         self.vantage = _FedVantage(self.faulty or sim.base)
+        self.counters = ApiCounters()
         self.elector = ShardedElector(
             self.vantage, identity=ident, peers=peers,
             n_shards=sim.n_shards, ttl=sim.lease_ttl,
-            clock=sim.sim_clock, counters=ApiCounters(),
+            clock=sim.sim_clock, counters=self.counters,
         )
+        if sim.tracing:
+            # per-replica observability plane: N replicas in ONE process
+            # must each own their span ring and SLO tracker, or the
+            # cross-replica journey merge (obs/chrome.py) would see one
+            # indistinguishable blob instead of N attributable dumps
+            self.recorder: Optional[FlightRecorder] = FlightRecorder(
+                capacity=4096, identity=ident
+            )
+            self.slo: Optional[SloTracker] = SloTracker(clock=sim.sim_clock)
+        else:
+            self.recorder = None
+            self.slo = None
         self.sched = Scheduler(
             self.vantage, WatchQueue(), queue.Queue(),
             respect_busy=False, sharded=self.elector, clock=sim.sim_clock,
+            recorder=self.recorder, slo=self.slo,
         )
         self.controller = Controller(
             self.vantage, self.sched.nqueue,
             isolate_events=sim.hardened, elector=self.elector,
+            recorder=self.recorder,
         )
         self.sched.build_initial_node_list()
         self.sched.load_deployed_configs()
@@ -223,9 +242,10 @@ class _Replica:
         self.ident = ident
         # per-replica counters: two replicas in one process must not
         # fight over the process-wide ha_is_leader/ha_epoch gauges
+        self.counters = ApiCounters()
         self.elector = LeaderElector(
             sim.backend, identity=ident, ttl=sim.lease_ttl,
-            clock=sim.sim_clock, counters=ApiCounters(),
+            clock=sim.sim_clock, counters=self.counters,
         )
         self.sched = Scheduler(
             sim.backend, WatchQueue(), queue.Queue(),
@@ -273,6 +293,7 @@ class ChaosSim:
         federation: int = 0,
         n_replicas: int = 3,
         lease_ttl: float = 3 * STEP_SEC,
+        tracing: Optional[bool] = None,
     ):
         if ha and federation:
             raise ValueError("ha=True and federation=S are exclusive modes")
@@ -283,6 +304,20 @@ class ChaosSim:
         self.federation = int(federation or 0)
         self.n_shards = self.federation
         self.lease_ttl = lease_ttl
+        # federation runs trace by default: the fleet artifact + journey
+        # merge ARE the mode's observability deliverable (ISSUE 7), and a
+        # 4096-span per-replica ring costs microseconds per step
+        self.tracing = bool(federation) if tracing is None else tracing
+        # views banked from replicas killed/restarted mid-storm, so a
+        # journey leg recorded by a dead incarnation still merges
+        self._retired_views: List[dict] = []
+        # monotonic counter totals banked from dead incarnations' private
+        # elector registries (handoffs, renewal failures) — see
+        # fleet_artifact for why these live outside API_COUNTERS
+        self._retired_counters: Dict[str, int] = {}
+        # the one-shot fleet artifact written around the FIRST invariant
+        # violation (path, or None until then)
+        self.violation_artifact_path: Optional[str] = None
         self._now = 0.0
         base = FakeClusterBackend()
         # lease expiry runs off the sim's step clock, not wall time —
@@ -343,9 +378,22 @@ class ChaosSim:
         elector (re-acquisitions bump every shard epoch, fencing the old
         incarnation's in-flight writes)."""
         old = self.replicas[idx]
+        self._bank_counters(old.counters)
         if old.faulty is not None:
             for k, n in old.faulty.fault_stats.items():
                 self._retired_faults[k] = self._retired_faults.get(k, 0) + n
+        if old.recorder is not None:
+            # bank the dead incarnation's view: its spans are legs of
+            # journeys that continue on the survivors, and the merge
+            # keys on the span-level replica stamp (same ident), so the
+            # view label only needs to stay unique
+            from nhd_tpu.obs.fleet import replica_view
+
+            self._retired_views.append(replica_view(
+                f"{old.ident}#retired{len(self._retired_views) + 1}",
+                recorder=old.recorder, slo=old.slo,
+                decisions=old.recorder.recent_decisions(200),
+            ))
         self.replicas[idx] = _FedReplica(
             self, old.ident, self._peers, self._next_incarnation()
         )
@@ -364,6 +412,119 @@ class ChaosSim:
         if isinstance(self.backend, FaultyBackend):
             return dict(self.backend.fault_stats)
         return {}
+
+    # ------------------------------------------------------------------
+    # fleet observability producers (federation mode with tracing on):
+    # the in-process twins of tools/fleet_top.py's scrape path
+    # ------------------------------------------------------------------
+
+    def fleet_views(self) -> List[dict]:
+        """One replica_view per live member plus the banked views of
+        killed incarnations — the input shape obs/fleet.py aggregates.
+        Degrades rather than crashes outside federation: ha-mode
+        _Replicas carry no recorder/SLO plane and their LeaderElector
+        has no shard table, so their views are identity + empty shards."""
+        from nhd_tpu.obs.fleet import replica_view
+
+        views = list(self._retired_views)
+        for r in getattr(self, "replicas", []):
+            rec = getattr(r, "recorder", None)
+            owned = getattr(r.elector, "owned_shards", None)
+            views.append(replica_view(
+                r.ident,
+                recorder=rec, slo=getattr(r, "slo", None),
+                shards=owned() if owned is not None else {},
+                decisions=(rec.recent_decisions(200)
+                           if rec is not None else None),
+            ))
+        return views
+
+    def merged_trace(self) -> dict:
+        """All replicas' span rings (dead incarnations included) merged
+        into one Chrome trace — the per-pod journey view."""
+        traces = [
+            v["trace"] for v in self._retired_views if v.get("trace")
+        ]
+        traces += [
+            chrome_trace(r.recorder)
+            for r in getattr(self, "replicas", [])
+            if getattr(r, "recorder", None) is not None
+        ]
+        return merge_chrome_traces(traces)
+
+    def fleet_artifact(self) -> dict:
+        """The schema-versioned fleet artifact for this run's current
+        state (obs/fleet.py; validated by the writer)."""
+        from nhd_tpu.obs.fleet import build_fleet_artifact
+
+        leadership = {
+            "max_shard_gap_steps": self.stats.max_shard_gap,
+            "max_leader_gap_steps": self.stats.max_leader_gap,
+            "shard_epochs": {
+                str(s): e for s, e in sorted(self.stats.shard_epochs.items())
+            },
+            "lease_ttl_sec": self.lease_ttl,
+            "steps": self.stats.steps,
+        }
+        return build_fleet_artifact(
+            self.fleet_views(), seed=self.seed, leadership=leadership,
+            counters=self._counter_totals(),
+            violations=list(self.stats.violations),
+        )
+
+    def _bank_counters(self, counters: ApiCounters) -> None:
+        """Bank a dead incarnation's monotonic totals before its private
+        registry is dropped with it."""
+        for k, v in counters.snapshot().items():
+            if v and ApiCounters.KNOWN.get(k, ("", ""))[0] == "counter":
+                self._retired_counters[k] = self._retired_counters.get(k, 0) + v
+
+    def _counter_totals(self) -> Dict[str, int]:
+        """API_COUNTERS plus every replica's private elector registry
+        (live and banked). The electors count handoffs/renewal failures
+        into per-replica ApiCounters (N replicas in one process must not
+        fight over the leader gauges) — without folding those monotonic
+        totals back in, the fleet artifact reports 0 handoffs through a
+        storm full of them. Counter kinds only: summing gauges like
+        ha_is_leader across replicas is meaningless."""
+        totals = dict(API_COUNTERS.snapshot())
+        tallies = dict(self._retired_counters)
+        for r in getattr(self, "replicas", []):
+            rc = getattr(r, "counters", None)
+            if rc is None:
+                continue
+            for k, v in rc.snapshot().items():
+                if v and ApiCounters.KNOWN.get(k, ("", ""))[0] == "counter":
+                    tallies[k] = tallies.get(k, 0) + v
+        for k, v in tallies.items():
+            totals[k] = totals.get(k, 0) + v
+        return totals
+
+    def write_fleet_artifact(self, out_dir: Optional[str] = None) -> str:
+        from nhd_tpu.obs import fleet as obs_fleet
+
+        out_dir = out_dir or os.environ.get("NHD_FLEET_DIR", "artifacts/fleet")
+        return obs_fleet.write_fleet_artifact(
+            self.fleet_artifact(), out_dir,
+            name=f"fleet-seed{self.seed}-step{self.stats.steps}.json",
+        )
+
+    def _maybe_capture_violation(self) -> None:
+        """First invariant violation → fleet artifact on disk, so a
+        failed storm leaves the federation's full observable state next
+        to the assertion message (one-shot; capture is best-effort —
+        a broken artifact writer must not mask the violation itself)."""
+        if (
+            not self.stats.violations
+            or not self.tracing
+            or not self.federation
+            or self.violation_artifact_path is not None
+        ):
+            return
+        try:
+            self.violation_artifact_path = self.write_fleet_artifact()
+        except Exception as exc:  # pragma: no cover - diagnostic path
+            self.violation_artifact_path = f"capture failed: {exc}"
 
     def _fresh_scheduler(self) -> None:
         self.sched = Scheduler(
@@ -565,6 +726,7 @@ class ChaosSim:
             sound = old.is_true_leader(self)
             pre_claims = self._claims_map(old.sched) if sound else None
             pre_snap = self._mirror_snapshot(old.sched) if sound else None
+            self._bank_counters(old.counters)
             self.replicas[idx] = _Replica(self, old.ident)
             self._check_restart_equivalence(
                 pre_claims, pre_snap, self.replicas[idx].sched
@@ -647,6 +809,7 @@ class ChaosSim:
         elif self.ha:
             self._track_leadership()
         self.check_invariants()
+        self._maybe_capture_violation()
 
     def _drive_control_plane(self, extra_drain: bool = False) -> None:
         """Let the control plane catch up on this step's churn."""
@@ -847,6 +1010,7 @@ class ChaosSim:
                 }
                 self._check_scheduler_invariants(r.sched, only_nodes=only)
             self._check_spillover_orphans()
+            self._check_slo_plane()
         elif self.ha:
             # a stale believer's mirror legitimately lags (its writes are
             # fenced off; its view repairs at the next promotion replay) —
@@ -872,6 +1036,34 @@ class ChaosSim:
                 self.stats.violations.append(
                     f"step {self.stats.steps}: pod uid {uid} bound "
                     f"{len(binds)} times: {binds}"
+                )
+
+    def _check_slo_plane(self) -> None:
+        """Physical laws of the SLO clock (obs/slo.py): time-to-bind is
+        measured creation→bind on the CLUSTER's clock, so no replica —
+        fresh incarnation or not — can ever report a figure exceeding
+        the sim's total elapsed time, and breaches can't outnumber
+        observations. A violation here means a tracker mixed clock
+        domains (exactly the bug the creationTimestamp origin exists to
+        rule out)."""
+        if not self.tracing:
+            return
+        for r in self.replicas:
+            slo = getattr(r, "slo", None)
+            if slo is None:
+                continue
+            snap = slo.snapshot(now=self._now)
+            if snap["breaches_total"] > snap["observations_total"]:
+                self.stats.violations.append(
+                    f"step {self.stats.steps}: {r.ident} SLO breaches "
+                    f"{snap['breaches_total']} > observations "
+                    f"{snap['observations_total']}"
+                )
+            if snap["max_seconds"] > self._now + STEP_SEC:
+                self.stats.violations.append(
+                    f"step {self.stats.steps}: {r.ident} time-to-bind "
+                    f"{snap['max_seconds']:.0f}s exceeds sim elapsed "
+                    f"{self._now:.0f}s (clock-domain mix)"
                 )
 
     def _check_spillover_orphans(self) -> None:
@@ -952,7 +1144,33 @@ class ChaosSim:
             elif self.ha:
                 self._track_leadership()
             self.check_invariants()
+        # the chaos-profile SLO invariant: a profile that promises a
+        # burn-rate bound must have met it once the storm quiesced
+        limit = getattr(self.fed_profile, "slo_burn_limit", None)
+        if limit is not None and self.tracing:
+            worst = self.worst_burn_rates()
+            for window, rate in sorted(worst.items()):
+                if rate > limit:
+                    self.stats.violations.append(
+                        f"quiesce: SLO burn rate {rate:.1f} over the "
+                        f"{window} window exceeds the profile's limit "
+                        f"{limit:.1f}"
+                    )
+        self._maybe_capture_violation()
         return self.unplaced_pods()
+
+    def worst_burn_rates(self) -> Dict[str, float]:
+        """Fleet-worst SLO burn rate per window — one replica's budget
+        on fire IS the fleet's page (obs/fleet.py uses the same rule)."""
+        worst: Dict[str, float] = {}
+        for r in self.replicas:
+            slo = getattr(r, "slo", None)
+            if slo is None:
+                continue
+            snap = slo.snapshot(now=self._now)
+            for window, rate in snap["burn_rates"].items():
+                worst[window] = max(worst.get(window, 0.0), rate)
+        return worst
 
     def unplaced_pods(self) -> List[Tuple[str, str]]:
         return [

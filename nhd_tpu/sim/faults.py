@@ -70,6 +70,12 @@ class FaultProfile:
     watch_gone: float = 0.0            # 410 Gone on watch establishment
     watch_cut: float = 0.0             # stream dies mid-line-sequence
     watch_malformed: float = 0.0       # garbage line injected, then cut
+    # SLO invariant (obs/slo.py, checked by ChaosSim.quiesce under
+    # federation tracing): after the storm quiesces, no replica's
+    # worst-window error-budget burn rate may exceed this. None = the
+    # profile makes no SLO promise (the heavy storms legitimately torch
+    # the budget; calibrated profiles and the fleet demo set a bound)
+    slo_burn_limit: Optional[float] = None
 
 
 #: the fault-storm matrix swept by `make chaos` (tools/chaos_storm.py)
@@ -313,6 +319,15 @@ class FaultyBackend(ClusterBackend):
 
     def get_pod_node_groups(self, pod: str, ns: str) -> List[str]:
         return self.inner.get_pod_node_groups(pod, ns)
+
+    # Concrete defaults on the ABC, so __getattr__ never fires for them:
+    # without these delegations the SLO clock reads the stub (None/wall
+    # time) in every faulted cell instead of the sim clock.
+    def get_pod_created(self, pod: str, ns: str) -> Optional[float]:
+        return self.inner.get_pod_created(pod, ns)
+
+    def clock_now(self) -> float:
+        return self.inner.clock_now()
 
     def get_requested_pod_resources(self, pod: str, ns: str) -> Dict[str, str]:
         return self.inner.get_requested_pod_resources(pod, ns)

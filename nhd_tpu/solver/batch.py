@@ -273,9 +273,21 @@ class BatchStats:
     # event counts (per-round pending, speculative claims/rejects) — the
     # round-convergence diagnostics the phase floats can't carry
     counters: Dict[str, int] = field(default_factory=dict)
+    # cluster shape bucket this batch ran at ("U{U}_K{K}_N{n}"), set by
+    # schedule() once the cluster is encoded; while set, every phase is
+    # ALSO attributed per shape into the process jit-stats table
+    # (obs/jitstats.py record_phase — the perf-telemetry pipeline's
+    # device-phase attribution). Aggregation paths that merge sub-batch
+    # stats (solver/streaming.py) leave it empty so tile phases are
+    # never double-counted.
+    shape_hint: str = ""
 
     def phase_add(self, name: str, dt: float) -> None:
         self.phases[name] = self.phases.get(name, 0.0) + dt
+        if self.shape_hint:
+            from nhd_tpu.obs.jitstats import JIT_STATS
+
+            JIT_STATS.record_phase(name, self.shape_hint, dt)
 
     def count_add(self, name: str, k: int) -> None:
         self.counters[name] = self.counters.get(name, 0) + int(k)
@@ -773,6 +785,9 @@ class BatchScheduler:
         )
         if context is None and not self.respect_busy:
             cluster.busy[:] = False
+        # per-shape phase attribution key: the (U, K, node-count) bucket
+        # this batch's programs specialize on
+        stats.shape_hint = f"U{cluster.U}_K{cluster.K}_N{len(node_list)}"
 
         # ONE fused pass collects the schedulable set AND the combo-
         # oversized subset (tractability memoized per group count: one

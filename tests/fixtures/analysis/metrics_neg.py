@@ -1,0 +1,53 @@
+"""Clean metrics idioms — the NHD6xx pack must stay silent here."""
+
+lines = []
+
+# literal TYPE/HELP declaration + static sample
+lines += [
+    "# HELP nhd_good_total A well-formed counter",
+    "# TYPE nhd_good_total counter",
+]
+n = 3
+lines.append(f"nhd_good_total {n}")
+
+# bounded label keys on a registered family
+lines.append('nhd_good_total{shard="0",window="5m"} 1')
+
+# the name/kind/help table-row idiom (rpc/metrics.py): the row registers
+# the family; the dynamic f-string render is skipped by design
+for name, kind, help_text in (
+    ("table_registered_total", "counter", "registered by the row idiom"),
+):
+    lines += [
+        f"# HELP nhd_{name} {help_text}",
+        f"# TYPE nhd_{name} {kind}",
+        f"nhd_{name} 1",
+    ]
+
+
+class Histogram:
+    """Stand-in for obs/histo.py's registry type."""
+
+    def __init__(self, name, help_text):
+        self.name = name
+
+
+# constructor registration covers the family and its histogram children
+H = Histogram("neg_latency_seconds", "bounded")
+le = "0.1"
+count = 2
+lines.append(f'nhd_neg_latency_seconds_bucket{{le="{le}"}} {count}')
+
+# the *FAMILIES* list idiom (obs/slo.py METRIC_FAMILIES)
+METRIC_FAMILIES = ("listed_total",)
+lines.append("nhd_listed_total 1")
+
+# the name -> (kind, help) dict idiom (k8s/retry.py ApiCounters.KNOWN)
+KNOWN = {"known_total": ("counter", "registered by the dict idiom")}
+lines.append("nhd_known_total 7")
+
+# prose, paths and bare family references are not emissions
+DOC = "nhd_tpu/rpc/metrics.py renders the nhd_tpu exposition surface"
+USAGE = "nhd-tpu --fake  # demo harness"
+BARE = "nhd_good_total"
+MSG = f"NHD: {n} pods rescheduled"

@@ -1,0 +1,46 @@
+"""Deliberate NHD6xx violations; EXPECT markers pin rule ids to lines.
+
+Analyzed as a one-module project, so registrations (where a case needs
+one) live in this file too.
+"""
+
+lines = []
+
+# NHD601: TYPE-declared family with uppercase characters
+lines.append("# TYPE NHD_Bad_Name counter")  # EXPECT[NHD601]
+
+# NHD601: uppercase family emitted as a sample line (malformed names are
+# not ALSO reported unregistered — one defect, one finding)
+lines.append('NHD_Upper_Total{shard="1"} 3')  # EXPECT[NHD601]
+
+# NHD602: emitted but registered nowhere in the project
+depth = 4
+lines.append(f"nhd_orphan_family_depth {depth}")  # EXPECT[NHD602]
+
+# NHD603: registered family, but the label is a correlation ID — one
+# time series per pod ever traced
+lines.append("# TYPE nhd_span_cardinality_total counter")
+corr = "c0001"
+lines.append(f'nhd_span_cardinality_total{{corr="{corr}"}} 1')  # EXPECT[NHD603]
+
+# NHD603: pod identity as a label value
+lines.append("# TYPE nhd_pod_bind_total counter")
+pod = "default/p1"
+lines.append(f'nhd_pod_bind_total{{pod="{pod}"}} 1')  # EXPECT[NHD603]
+
+
+class LabeledHistogram:
+    """Stand-in for obs/histo.py's family type (the pack keys on the
+    constructor name)."""
+
+    def __init__(self, name, label, help_text):
+        self.name = name
+        self.label = label
+
+
+# NHD603: a per-pod-uid child histogram is a cardinality bomb by
+# construction
+H = LabeledHistogram("per_pod_seconds", "pod_uid", "per-pod wall")  # EXPECT[NHD603]
+
+# NHD603: the keyword form must not escape the rule
+H2 = LabeledHistogram("per_corr_seconds", label="corr", help_text="per-corr")  # EXPECT[NHD603]

@@ -1,0 +1,442 @@
+"""Fleet observability integration (ISSUE 7): cross-replica trace
+context, the federation journey merge, the SLO clock surviving replica
+churn, demotion dumps, and the bench-artifact regression gate.
+
+The acceptance pin lives here: under ``ChaosSim(federation=3)`` a pod
+that spills across >= 2 shards yields ONE merged Chrome-trace journey —
+a single corr ID with spans from >= 2 replicas — and the run's fleet
+artifact validates with spillover-hop and SLO burn summaries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from nhd_tpu.k8s.fake import FakeClusterBackend
+from nhd_tpu.k8s.interface import (
+    TRACE_ANNOTATION,
+    parse_trace_record,
+    render_trace_record,
+)
+from nhd_tpu.k8s.lease import LeaderElector, ShardedElector
+from nhd_tpu.obs.chrome import (
+    journey_replicas,
+    pod_journeys,
+    validate_chrome_trace,
+)
+from nhd_tpu.obs.fleet import validate_fleet_artifact
+from nhd_tpu.obs.recorder import FlightRecorder
+from nhd_tpu.obs.slo import SloTracker
+from nhd_tpu.scheduler.core import Scheduler
+from nhd_tpu.scheduler.events import WatchQueue
+from nhd_tpu.sim.chaos import ChaosSim
+from nhd_tpu.sim.faults import FaultProfile, FaultyBackend
+from tests.test_scheduler import make_backend, pod_cfg
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _scheduler(backend, *, identity: str, slo=None) -> Scheduler:
+    sched = Scheduler(
+        backend, WatchQueue(), queue.Queue(), respect_busy=False,
+        recorder=FlightRecorder(capacity=256, identity=identity), slo=slo,
+    )
+    sched.build_initial_node_list()
+    sched.load_deployed_configs()
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# cross-replica trace context
+# ---------------------------------------------------------------------------
+
+def test_trace_record_roundtrip_and_garbage_tolerance():
+    rec = {"corr": "c1", "origin": "rep-a", "t0": 5.0}
+    assert parse_trace_record(render_trace_record(rec)) == rec
+    assert parse_trace_record(None) is None
+    assert parse_trace_record("") is None
+    assert parse_trace_record("{not json") is None
+    assert parse_trace_record('{"corr": ""}') is None  # empty ID = absent
+    assert parse_trace_record('{"origin": "x"}') is None  # no corr at all
+
+
+def test_corr_stamped_at_first_receipt_and_adopted_by_later_replica():
+    """The annotation roundtrip on the fake backend: replica A stamps
+    the pod's corr ID at first receipt; replica B (spillover claim,
+    handoff, restart — any later receipt) ADOPTS it instead of minting
+    its own, so the journey keeps ONE ID."""
+    backend = make_backend(n_nodes=1)
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    a = _scheduler(backend, identity="rep-a")
+    got_a = a._resolve_trace_corr("triad-0", "default", "c-from-a")
+    assert got_a == "c-from-a"
+    stamped = parse_trace_record(
+        backend.pods[("default", "triad-0")].annotations[TRACE_ANNOTATION]
+    )
+    assert stamped["corr"] == "c-from-a"
+    assert stamped["origin"] == a.replica_id
+
+    b = _scheduler(backend, identity="rep-b")
+    assert b._resolve_trace_corr("triad-0", "default", "c-from-b") == "c-from-a"
+    # adoption is read-only: the stamp still names the origin replica
+    stamped2 = parse_trace_record(
+        backend.pods[("default", "triad-0")].annotations[TRACE_ANNOTATION]
+    )
+    assert stamped2 == stamped
+
+
+def test_adoption_realiases_already_recorded_watch_leg():
+    """The controller records the watch_event span BEFORE the scheduler
+    can read the cluster-stamped corr (adoption happens at batch
+    admission). When adoption changes the ID, the already-recorded
+    receipt leg must be re-aliased into the pod's journey — not left as
+    a one-span orphan corr that drops the queue-wait leg from the merge
+    and inflates pods_traced."""
+    backend = make_backend(n_nodes=1)
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    a = _scheduler(backend, identity="rep-a")
+    assert a._resolve_trace_corr("triad-0", "default", "c-origin") == "c-origin"
+
+    b = _scheduler(backend, identity="rep-b")
+    rec = b._rec()
+    # the watch-receipt leg, recorded under B's locally minted corr
+    rec.record("watch_event", 0.0, 0.0, cat="event", corr="c-local",
+               attrs={"pod": "default/triad-0"})
+    b.attempt_scheduling_batch(
+        [("triad-0", "default", "uid-0")],
+        meta={("default", "triad-0"): ("c-local", 0.0)},
+    )
+    spans = rec.spans()
+    assert all(s.corr != "c-local" for s in spans)
+    watch = [s for s in spans if s.name == "watch_event"]
+    assert watch and watch[0].corr == "c-origin"
+
+
+def test_resolve_trace_corr_is_best_effort_on_missing_pod():
+    backend = make_backend(n_nodes=1)
+    a = _scheduler(backend, identity="rep-a")
+    # no pod: the local corr survives, nothing raises
+    assert a._resolve_trace_corr("ghost", "default", "c-x") == "c-x"
+
+
+# ---------------------------------------------------------------------------
+# the federation acceptance pin
+# ---------------------------------------------------------------------------
+
+def test_federation_spill_journey_merges_across_replicas():
+    """ChaosSim(federation=3): find a pod whose spillover crossed >= 2
+    shards AND >= 2 replicas, and assert its merged journey carries one
+    corr ID with attributable spans from both. Seeds are searched
+    deterministically so a scheduler change shifting one seed's churn
+    doesn't flake the pin."""
+    chosen = None
+    for seed in range(3, 11):
+        sim = ChaosSim(seed=seed, n_nodes=6, federation=3, n_replicas=3)
+        sim.run(40)
+        sim.quiesce()
+        assert sim.stats.violations == []
+        merged = sim.merged_trace()
+        journeys = pod_journeys(merged)
+        for corr, events in journeys.items():
+            replicas = journey_replicas(merged, corr, journeys)
+            shards = {
+                ev["args"].get("shard")
+                for ev in events
+                if (ev.get("args") or {}).get("shard") is not None
+            }
+            if len(replicas) >= 2 and len(shards) >= 2:
+                chosen = (sim, merged, corr, replicas, shards)
+                break
+        if chosen:
+            break
+    assert chosen is not None, "no cross-replica spill journey in 8 seeds"
+    sim, merged, corr, replicas, shards = chosen
+    assert validate_chrome_trace(merged) == []
+    # ONE corr ID spans the whole journey: every span of the journey
+    # carries it by construction of pod_journeys; the journey includes
+    # both a spill leg and legs from another replica
+    names = {ev["name"] for ev in pod_journeys(merged)[corr]}
+    assert "spill" in names
+    # the fleet artifact carries the spillover-hop and SLO burn summaries
+    art = sim.fleet_artifact()
+    assert validate_fleet_artifact(art) == []
+    payload = art["payload"]
+    assert payload["spillover"]["spill_events_total"] > 0
+    assert payload["spillover"]["cross_replica_journeys"] >= 1
+    assert "worst_burn_rates" in payload["slo"]
+
+
+def test_fleet_artifact_captured_around_violation(tmp_path, monkeypatch):
+    monkeypatch.setenv("NHD_FLEET_DIR", str(tmp_path))
+    sim = ChaosSim(seed=0, n_nodes=4, federation=2, n_replicas=2)
+    sim.run(5)
+    sim.stats.violations.append("synthetic violation (capture test)")
+    sim._maybe_capture_violation()
+    path = sim.violation_artifact_path
+    assert path is not None and os.path.exists(path)
+    art = json.loads(Path(path).read_text())
+    assert validate_fleet_artifact(art) == []
+    assert art["payload"]["violations"] == [
+        "synthetic violation (capture test)"
+    ]
+    # one-shot: a second violation doesn't clobber the first capture
+    sim.stats.violations.append("second")
+    sim._maybe_capture_violation()
+    assert sim.violation_artifact_path == path
+
+
+def test_fleet_views_degrade_outside_federation():
+    """ha-mode _Replicas carry no recorder/SLO plane and their
+    LeaderElector has no shard table — the fleet capture surface must
+    degrade to identity + empty shards, not crash, so wiring fleet
+    artifacts into the ha-chaos path stays a one-liner."""
+    sim = ChaosSim(seed=0, n_nodes=4, ha=True)
+    sim.run(3)
+    views = sim.fleet_views()
+    assert [v["replica"] for v in views] == ["sched-a", "sched-b"]
+    assert all(v["shards"] == {} and v["trace"] is None for v in views)
+    art = sim.fleet_artifact()
+    assert art["payload"]["journeys"]["pods_traced"] == 0
+
+
+def test_fleet_artifact_folds_private_elector_counters():
+    """Federation replicas count handoffs/renewal failures into their
+    own per-replica ApiCounters (so N replicas in one process don't
+    fight over the leader gauges) — the fleet artifact must fold those
+    monotonic totals in, including totals banked from incarnations
+    killed mid-storm, or it reports 0 handoffs through a storm full of
+    them."""
+    sim = ChaosSim(seed=0, n_nodes=4, federation=2, n_replicas=2)
+    sim.run(4)
+    sim.replicas[0].counters.inc("shard_handoffs_total")
+    sim.replicas[1].counters.inc("ha_renewal_failures_total")
+    fencing = sim.fleet_artifact()["payload"]["fencing"]
+    assert fencing["handoffs_total"] >= 1
+    assert fencing["renewal_failures_total"] >= 1
+    # a killed incarnation's totals survive its registry
+    sim._replace_replica(0)
+    fencing2 = sim.fleet_artifact()["payload"]["fencing"]
+    assert fencing2["handoffs_total"] >= fencing["handoffs_total"]
+
+
+# ---------------------------------------------------------------------------
+# SLO clock vs replica churn
+# ---------------------------------------------------------------------------
+
+def test_slo_clock_survives_replica_restart():
+    """A pod created at t=0 binds at t=50 through a FRESH scheduler
+    incarnation (its local enqueue clock knows nothing before t=50):
+    time-to-bind must still read ~50 s, because the origin stamp is the
+    cluster's creationTimestamp, not any process-local stamp."""
+    clock = {"t": 0.0}
+    backend = make_backend(n_nodes=1)
+    backend.clock = lambda: clock["t"]
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+
+    clock["t"] = 50.0  # the old incarnation died; a new one comes up
+    slo = SloTracker(clock=lambda: clock["t"])
+    sched = _scheduler(backend, identity="reborn", slo=slo)
+    sched.check_pending_pods()
+    assert backend.pods[("default", "triad-0")].node is not None
+    snap = slo.snapshot()
+    assert snap["observations_total"] == 1
+    assert snap["max_seconds"] == pytest.approx(50.0)
+
+
+def test_slo_clock_survives_kill_restart_wave():
+    """Federation churn with kill/restart waves: every SLO observation
+    across every incarnation obeys the physical clock-domain invariant
+    (chaos' _check_slo_plane), and the trackers saw the binds the bind
+    log recorded (retired incarnations included)."""
+    sim = ChaosSim(seed=5, n_nodes=6, federation=3, n_replicas=3)
+    sim.run(40)
+    sim.quiesce()
+    assert sim.stats.violations == []
+    assert sim.stats.restarts > 0, "seed produced no restarts; repin"
+    total_obs = sum(
+        v["slo"]["observations_total"]
+        for v in sim.fleet_views() if v.get("slo")
+    )
+    # every observation is a landed bind; faults can only lose (skip)
+    # observations, never invent them
+    assert 0 < total_obs <= len(sim.base.bind_log)
+
+
+def test_slo_burn_stamps_in_tracker_clock_domain():
+    """The bind duration is computed in the BACKEND's clock domain, but
+    the burn-window stamp must come from the tracker's own clock —
+    mixing domains (monotonic fake backend vs wall-clock tracker) left
+    every burn-rate gauge at 0 forever on fake-backed runs."""
+    backend_clock = {"t": 0.0}
+    wall = {"t": 1.7e9}  # tracker domain, ~epoch seconds apart
+    backend = make_backend(n_nodes=1)
+    backend.clock = lambda: backend_clock["t"]
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    backend_clock["t"] = 50.0
+    slo = SloTracker(target_sec=30.0, clock=lambda: wall["t"])
+    sched = _scheduler(backend, identity="rep", slo=slo)
+    sched.check_pending_pods()
+    snap = slo.snapshot()
+    assert snap["observations_total"] == 1
+    assert snap["max_seconds"] == pytest.approx(50.0)
+    # the 50 s bind breached the 30 s target: it must burn the window
+    # rendered NOW, in the tracker's domain
+    assert snap["burn_rates"]["5m"] > 0.0
+
+
+def test_slo_burn_limit_profile_invariant():
+    """A profile carrying slo_burn_limit turns budget burn into a chaos
+    violation at quiesce."""
+    profile = FaultProfile(name="strict-slo", slo_burn_limit=0.0)
+    sim = ChaosSim(
+        seed=0, n_nodes=4, federation=2, n_replicas=2, api_faults=profile,
+    )
+    sim.run(6)
+    # inject one breach (31 s > the 30 s target, < sim elapsed so the
+    # clock-domain invariant stays quiet)
+    sim.replicas[0].slo.observe(31.0, now=sim._now)
+    sim.quiesce()
+    assert any("SLO burn rate" in v for v in sim.stats.violations)
+
+
+def test_faulty_backend_delegates_slo_clock():
+    """get_pod_created/clock_now are CONCRETE defaults on the
+    ClusterBackend ABC, so FaultyBackend's __getattr__ never fires for
+    them — without explicit delegation every faulted chaos cell reads
+    the stubs (None / wall time) and the SLO plane is silently dead."""
+    clock = {"t": 7.0}
+    backend = make_backend(n_nodes=1)
+    backend.clock = lambda: clock["t"]
+    backend.create_pod("triad-0", cfg_text=pod_cfg())
+    wrapped = FaultyBackend(backend, FaultProfile(name="quiet"))
+    assert wrapped.clock_now() == pytest.approx(7.0)
+    assert wrapped.get_pod_created("triad-0", "default") == pytest.approx(
+        backend.get_pod_created("triad-0", "default")
+    )
+
+
+# ---------------------------------------------------------------------------
+# demotion dump hook (k8s/lease.py on_demote)
+# ---------------------------------------------------------------------------
+
+def test_leader_elector_fires_on_demote():
+    calls = []
+    backend = FakeClusterBackend()
+    el = LeaderElector(
+        backend, identity="a", ttl=10.0, on_demote=calls.append,
+    )
+    assert el.tick()  # acquires
+    el.step_down()
+    assert calls == ["voluntary step-down"]
+    el.step_down()  # idempotent: no second transition, no second dump
+    assert len(calls) == 1
+
+
+def test_sharded_elector_qualifies_demotions_with_the_shard():
+    calls = []
+    backend = FakeClusterBackend()
+    el = ShardedElector(
+        backend, identity="a", peers=["a"], n_shards=2, ttl=10.0,
+        on_demote=calls.append,
+    )
+    el.tick()
+    assert set(el.owned_shards()) == {0, 1}
+    el.step_down()
+    assert sorted(calls) == [
+        "shard 0: voluntary step-down", "shard 1: voluntary step-down",
+    ]
+
+
+def test_demote_callback_failure_never_breaks_the_election():
+    def boom(why):
+        raise RuntimeError("dump failed")
+
+    backend = FakeClusterBackend()
+    el = LeaderElector(backend, identity="a", ttl=10.0, on_demote=boom)
+    assert el.tick()
+    el.step_down()  # must not raise
+    assert not el.is_leader
+    assert el.tick()  # and the elector still works afterwards
+
+
+# ---------------------------------------------------------------------------
+# bench artifacts + the regression gate
+# ---------------------------------------------------------------------------
+
+def _mk_bench(tmp_path, name, solve):
+    from nhd_tpu.obs.perf import build_bench_artifact, config_record
+
+    art = build_bench_artifact(
+        {
+            "cfg4": config_record(
+                wall_seconds=1.0, placed=100, speedup=10.0, rounds=3,
+                phases={"solve": solve, "select": 0.1},
+            )
+        },
+        headline={"metric": "pods_per_sec", "value": 100.0,
+                  "unit": "pods/s", "vs_baseline": 10.0},
+        platform="cpu", rev="testrev", created=1.0,
+    )
+    path = tmp_path / name
+    path.write_text(json.dumps(art))
+    return str(path)
+
+
+def _bench_diff(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "bench_diff.py"), *args],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+
+
+def test_bench_diff_fails_on_injected_solve_regression(tmp_path):
+    old = _mk_bench(tmp_path, "old.json", solve=0.50)
+    new = _mk_bench(tmp_path, "new.json", solve=0.57)  # +14%
+    proc = _bench_diff(old, new)
+    assert proc.returncode == 1, proc.stdout
+    assert "REGRESSION" in proc.stdout
+    # within threshold passes
+    ok = _mk_bench(tmp_path, "ok.json", solve=0.52)  # +4%
+    assert _bench_diff(old, ok).returncode == 0
+    # and the threshold is a knob
+    assert _bench_diff(old, ok, "--threshold", "0.01").returncode == 1
+
+
+def test_bench_diff_reads_legacy_driver_records():
+    proc = _bench_diff("BENCH_r01.json", "BENCH_r01.json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "cfg" in proc.stdout  # per-config rows recovered from the tail
+
+
+def test_legacy_bench_artifacts_all_load():
+    from nhd_tpu.obs.perf import load_bench_artifact
+
+    for i in range(1, 6):
+        art = load_bench_artifact(str(REPO / f"BENCH_r0{i}.json"))
+        assert art["schema_version"] == 0
+        assert art["payload"]["headline"]["unit"] == "pods/s"
+        assert art["payload"]["configs"], f"BENCH_r0{i}: no configs parsed"
+
+
+def test_bench_artifact_validator_names_defects(tmp_path):
+    from nhd_tpu.obs.perf import (
+        load_bench_artifact,
+        validate_bench_artifact,
+    )
+
+    good = json.loads(Path(_mk_bench(tmp_path, "g.json", 0.5)).read_text())
+    assert validate_bench_artifact(good) == []
+    assert validate_bench_artifact(dict(good, schema_version=99))
+    bad = dict(good, payload={"platform": "cpu"})
+    assert validate_bench_artifact(bad)
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    with pytest.raises(ValueError):
+        load_bench_artifact(str(p))

@@ -217,3 +217,58 @@ def test_render_escapes_nothing_unexpected():
     assert "nhd_failed_schedule_total 7" in out
     assert 'nhd_node_free_hugepages_gb{node="n0"} 0' in out  # clamped
     assert 'nhd_node_active{node="n0"} 0' in out
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: SLO families + per-(phase, shape) attribution on /metrics
+# ---------------------------------------------------------------------------
+
+def test_slo_families_exposed(metrics_stack):
+    body = _get(metrics_stack, "/metrics")
+    assert "# TYPE nhd_slo_bind_target_seconds gauge" in body
+    assert "nhd_slo_bind_observations_total" in body
+    assert 'nhd_slo_bind_burn_rate{window="5m"}' in body
+    assert 'nhd_slo_bind_burn_rate{window="1h"}' in body
+    # the batch the fixture scheduled was observed against the SLO
+    # (creation -> bound on the backend clock)
+    assert "nhd_time_to_bind_seconds_bucket" in body
+
+
+def test_round_phase_attribution_exposed(metrics_stack):
+    body = _get(metrics_stack, "/metrics")
+    # the labeled histogram family: one child per solver round phase
+    assert "# TYPE nhd_round_phase_seconds histogram" in body
+    # 'encode' runs on every path; 'solve' only on batches big enough to
+    # dodge the fast-join shortcut, so pin the always-present phase
+    assert 'nhd_round_phase_seconds_bucket{phase="encode"' in body
+    # the per-(phase, shape-bucket) counter from the jit-stats table
+    assert "# TYPE nhd_jit_phase_seconds_total counter" in body
+    assert re.search(
+        r'nhd_jit_phase_seconds_total\{phase="encode",'
+        r'shape="U\d+_K\d+_N\d+"\}',
+        body,
+    )
+
+
+def test_labeled_histogram_render_exact():
+    from nhd_tpu.obs.histo import LabeledHistogram
+
+    lh = LabeledHistogram("x_seconds", "phase", "help", buckets=(0.1, 1.0))
+    assert lh.render() == []  # no children yet: family stays silent
+    lh.observe("solve", 0.05)
+    lh.observe("solve", 0.5)
+    lh.observe("select", 2.0)
+    lines = lh.render()
+    assert 'nhd_x_seconds_bucket{phase="solve",le="0.1"} 1' in lines
+    assert 'nhd_x_seconds_bucket{phase="solve",le="+Inf"} 2' in lines
+    assert 'nhd_x_seconds_count{phase="select"} 1' in lines
+    assert 'nhd_x_seconds_bucket{phase="select",le="1"} 0' in lines
+    lh.reset()
+    assert lh.render() == []
+
+
+def test_labeled_histogram_observe_unregistered_raises():
+    from nhd_tpu.obs.histo import observe_labeled
+
+    with pytest.raises(KeyError):
+        observe_labeled("no_such_family", "solve", 1.0)

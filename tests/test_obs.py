@@ -314,3 +314,287 @@ def test_json_log_formatter_env_switch(monkeypatch):
     assert isinstance(nhd_logging._pick_formatter(), JsonFormatter)
     monkeypatch.delenv("NHD_LOG_JSON")
     assert not isinstance(nhd_logging._pick_formatter(), JsonFormatter)
+
+
+# ---------------------------------------------------------------------------
+# cross-replica journey merge + fleet observability units (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+def _replica_ring(ident: str, epoch_offset: float) -> FlightRecorder:
+    rec = FlightRecorder(capacity=64, identity=ident)
+    rec.epoch_offset = epoch_offset  # injected wall anchor: deterministic
+    return rec
+
+
+def test_merge_chrome_traces_rebases_and_attributes():
+    from nhd_tpu.obs.chrome import (
+        chrome_trace,
+        journey_replicas,
+        merge_chrome_traces,
+        pod_journeys,
+    )
+
+    a = _replica_ring("rep-a", 1000.0)
+    b = _replica_ring("rep-b", 1000.5)  # same wall domain, skewed mono clock
+    a.record("watch_event", 10.0, 0.0, corr="c1")
+    a.record("spill", 11.0, 0.5, corr="c1", shard=0, epoch=2)
+    b.record("bind", 10.0, 1.0, corr="c1", shard=1, epoch=3)
+    merged = merge_chrome_traces([chrome_trace(a), chrome_trace(b)])
+    assert validate_chrome_trace(merged) == []
+    assert merged["nhdMeta"] == {"merged": True,
+                                 "replicas": ["rep-a", "rep-b"]}
+    journeys = pod_journeys(merged)
+    assert set(journeys) == {"c1"}
+    # one corr ID, spans attributable to BOTH replicas
+    assert journey_replicas(merged, "c1") == ["rep-a", "rep-b"]
+    evs = {(e["args"]["replica"], e["name"]): e for e in journeys["c1"]}
+    # wall re-basing: both dumps' origin span starts at mono 10.0, but
+    # rep-b's wall anchor is 0.5 s later — its legs shift right by 0.5 s
+    assert (
+        evs[("rep-b", "bind")]["ts"] - evs[("rep-a", "watch_event")]["ts"]
+        == pytest.approx(0.5e6)
+    )
+    # federation coordinates survive the merge
+    assert evs[("rep-a", "spill")]["args"]["shard"] == 0
+    assert evs[("rep-b", "bind")]["args"]["epoch"] == 3
+
+
+def test_merge_without_meta_degrades_to_shared_timeline():
+    from nhd_tpu.obs.chrome import merge_chrome_traces
+
+    legacy = chrome_trace_of([Span("x", 1.0, 0.5, corr="c")])
+    assert "nhdMeta" not in legacy  # pre-federation export shape
+    merged = merge_chrome_traces([legacy, legacy])
+    assert validate_chrome_trace(merged) == []
+    assert merged["nhdMeta"]["replicas"] == ["replica-0", "replica-1"]
+    pids = {e["pid"] for e in merged["traceEvents"] if e["ph"] == "X"}
+    assert pids == {1, 2}
+
+
+def test_slo_tracker_windows_and_burn_rates():
+    from nhd_tpu.obs.slo import SloTracker
+
+    clock = {"t": 0.0}
+    t = SloTracker(
+        target_sec=30.0, good_fraction=0.9, windows=(("w", 100.0),),
+        clock=lambda: clock["t"],
+    )
+    assert t.observe(10.0) is False
+    assert t.observe(45.0) is True
+    # 1 of 2 breached: ratio 0.5 against a 0.1 error budget = 5.0
+    assert t.burn_rate(100.0) == pytest.approx(5.0)
+    clock["t"] = 200.0  # both events age out of the window
+    assert t.burn_rate(100.0) == 0.0
+    snap = t.snapshot()
+    assert snap["observations_total"] == 2
+    assert snap["breaches_total"] == 1
+    assert snap["max_seconds"] == 45.0
+    lines = t.render()
+    assert "nhd_slo_bind_breaches_total 1" in lines
+    assert 'nhd_slo_bind_burn_rate{window="w"} 0.0' in lines
+    t.reset()
+    assert t.snapshot()["observations_total"] == 0
+
+
+def test_slo_burn_window_coverage_is_rate_independent():
+    """A breach storm 30 minutes ago must still burn the 1 h window no
+    matter how much healthy traffic followed — a COUNT-capped event ring
+    silently truncates the window at high bind rates, which is exactly
+    when the page matters. Buckets make coverage rate-independent."""
+    from nhd_tpu.obs.slo import SloTracker
+
+    clock = {"t": 0.0}
+    t = SloTracker(
+        target_sec=1.0, good_fraction=0.9, windows=(("1h", 3600.0),),
+        clock=lambda: clock["t"],
+    )
+    for _ in range(100):
+        t.observe(5.0)  # the storm: 100 breaches at t=0
+    clock["t"] = 1800.0
+    for _ in range(20000):
+        t.observe(0.5)  # healthy flood that would evict any event ring
+    assert t.burn_rate(3600.0) == pytest.approx((100 / 20100) / 0.1)
+    # ...and the storm ages out once the window moves past it
+    clock["t"] = 3700.0
+    assert t.burn_rate(1800.0) == 0.0
+
+
+def test_scrape_replica_tolerates_non_json_decisions(monkeypatch):
+    """A proxy answering /decisions with a 200 HTML error page (or an
+    old build returning a bare list) must cost the decisions detail
+    only, never the whole scrape — metrics alone still merge."""
+    import io
+    import urllib.request
+
+    from nhd_tpu.obs import fleet
+
+    def fake_urlopen(url, timeout=None):
+        if "/metrics" in url:
+            return io.BytesIO(b'nhd_shard_epoch{shard="0"} 2\n')
+        return io.BytesIO(b"<html>502 Bad Gateway</html>")
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    view = fleet.scrape_replica("http://replica:9464")
+    assert view["decisions"] == []
+    assert view["shards"] == {"0": 2}
+
+
+def test_slo_tracker_rejects_bad_objective():
+    from nhd_tpu.obs.slo import SloTracker
+
+    with pytest.raises(ValueError):
+        SloTracker(target_sec=0)
+    with pytest.raises(ValueError):
+        SloTracker(good_fraction=1.0)
+
+
+def test_artifact_envelope_roundtrip(tmp_path):
+    from nhd_tpu.obs import artifact
+
+    env = artifact.make_envelope(
+        "fleet", 1, {"x": 1}, seed=7, rev="abc", created=5.0
+    )
+    assert artifact.validate_envelope(env) == []
+    path = artifact.write_artifact(env, str(tmp_path), "a.json")
+    assert artifact.load_artifact(path) == env
+    # every envelope defect is named, and the kind/version pins hold
+    assert artifact.validate_envelope({"kind": "fleet"})
+    assert artifact.validate_envelope(dict(env, schema_version="x"))
+    assert artifact.validate_envelope(env, kind="bench")
+    assert artifact.validate_envelope(env, schema_version=2)
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"not": "an artifact"}))
+        artifact.load_artifact(str(bad))
+
+
+def test_fleet_payload_from_replica_views():
+    from nhd_tpu.obs import fleet
+    from nhd_tpu.obs.slo import SloTracker
+
+    a = _replica_ring("r1", 0.0)
+    b = _replica_ring("r2", 0.0)
+    a.record("spill", 1.0, 0.0, corr="p1", shard=0, epoch=1)
+    b.record("bind", 2.0, 0.25, corr="p1", shard=1, epoch=2)
+    slo = SloTracker(clock=lambda: 100.0)
+    slo.observe(12.0)
+    views = [
+        fleet.replica_view("r1", recorder=a, slo=slo, shards={0: 1}),
+        fleet.replica_view("r2", recorder=b, shards={1: 2}),
+    ]
+    art = fleet.build_fleet_artifact(views, seed=1)
+    assert fleet.validate_fleet_artifact(art) == []
+    p = art["payload"]
+    assert p["journeys"] == {"pods_traced": 1, "cross_replica": 1}
+    assert p["spillover"]["spill_events_total"] == 1
+    assert p["spillover"]["by_shard"] == {"0": 1}
+    assert p["spillover"]["cross_replica_journeys"] == 1
+    assert p["per_shard"]["bind_latency"]["1"]["count"] == 1
+    assert p["slo"]["observations_total"] == 1
+    assert p["slo"]["worst_burn_rates"]
+    assert p["leadership"]["shard_epochs"] == {"0": 1, "1": 2}
+
+
+def test_corr_ids_scope_by_replica_identity():
+    """Locally minted corr IDs are only process-unique counters: two
+    replica PROCESSES both mint c000001, and an unscoped merge would
+    fuse their unrelated pods into one journey. The identity scope
+    makes minted IDs fleet-unique; adoption carries the full scoped ID
+    through the annotation, so journeys still keep ONE ID."""
+    a, b = obs.new_corr_id("rep-a"), obs.new_corr_id("rep-b")
+    assert a.startswith("rep-a/c") and b.startswith("rep-b/c")
+    assert a.split("/")[1] != b.split("/")[1]  # counter still monotonic
+    assert obs.new_corr_id().startswith("c")  # unscoped legacy form
+
+
+def test_pods_traced_excludes_watch_receipt_orphans():
+    """Every replica (standbys included) records a watch_event under a
+    locally minted corr; only the scheduling replica re-aliases its leg.
+    The fleet pod tally must not count the leftover one-span receipt
+    orphans — with 3 replicas that's a ~3x inflation."""
+    from nhd_tpu.obs import fleet
+
+    a = _replica_ring("r1", 0.0)
+    b = _replica_ring("r2", 0.0)
+    a.record("watch_event", 1.0, 0.0, cat="event", corr="r1/c1")
+    a.record("bind", 2.0, 0.5, corr="r1/c1", shard=0)
+    b.record("watch_event", 1.0, 0.0, cat="event", corr="r2/c1")  # orphan
+    views = [
+        fleet.replica_view("r1", recorder=a),
+        fleet.replica_view("r2", recorder=b),
+    ]
+    p = fleet.build_fleet_payload(views)
+    assert p["journeys"]["pods_traced"] == 1
+
+
+def test_fleet_payload_sources_counters_from_scraped_metrics():
+    """The scrape path has no in-process ApiCounters snapshot: the
+    fencing/spillover totals must come from each replica's parsed
+    exposition (summed across replicas), not silently read as zero —
+    that's exactly the path tools/fleet_top.py serves operators."""
+    from nhd_tpu.obs import fleet
+
+    views = [
+        {"replica": "r1", "metrics": {
+            "nhd_ha_stale_writes_rejected_total": [({}, 17.0)],
+            "nhd_shard_spillover_claims_total": [({}, 9.0)],
+        }},
+        {"replica": "r2", "metrics": {
+            "nhd_ha_stale_writes_rejected_total": [({}, 3.0)],
+            "nhd_shard_handoffs_total": [({}, 2.0)],
+        }},
+    ]
+    p = fleet.build_fleet_payload(views)
+    assert p["fencing"]["stale_writes_rejected_total"] == 20
+    assert p["fencing"]["handoffs_total"] == 2
+    assert p["spillover"]["claims_total"] == 9
+    # an explicit producer snapshot still wins over the exposition
+    p2 = fleet.build_fleet_payload(
+        views, counters={"ha_stale_writes_rejected_total": 5}
+    )
+    assert p2["fencing"]["stale_writes_rejected_total"] == 5
+
+
+def test_merge_mixed_anchored_and_legacy_never_rebases():
+    """Re-basing is all-or-none: a legacy dump has no wall anchor, so
+    mixing one into an anchored set must fall back to the shared raw
+    timeline — otherwise the anchored dumps shift by absolute wall time
+    (~epoch seconds) while the legacy one sits at 0, and the merged
+    trace spans decades in the viewer."""
+    from nhd_tpu.obs.chrome import chrome_trace, merge_chrome_traces
+
+    a = _replica_ring("rep-a", 1.7e9)  # realistic wall anchor
+    a.record("bind", 10.0, 1.0, corr="c1")
+    legacy = chrome_trace_of([Span("x", 10.0, 0.5, corr="c2")])
+    assert "nhdMeta" not in legacy
+    merged = merge_chrome_traces([chrome_trace(a), legacy])
+    ts = {e["name"]: e["ts"] for e in merged["traceEvents"]
+          if e.get("ph") == "X"}
+    # both dumps keep their raw relative timestamps (each export starts
+    # at its own origin, ts=0) — no wall shift applied to either
+    assert ts["bind"] == pytest.approx(0.0)
+    assert ts["x"] == pytest.approx(0.0)
+
+
+def test_fleet_writer_rejects_invalid(tmp_path):
+    from nhd_tpu.obs import fleet
+
+    with pytest.raises(ValueError):
+        fleet.write_fleet_artifact({"kind": "fleet"}, str(tmp_path))
+
+
+def test_parse_prometheus_exposition():
+    from nhd_tpu.obs.fleet import parse_prometheus
+
+    fams = parse_prometheus("\n".join([
+        "# HELP nhd_x stuff",
+        "# TYPE nhd_x counter",
+        "nhd_x 3",
+        "# TYPE nhd_y gauge",
+        'nhd_y{shard="0",window="5m"} 1.5',
+        "!! garbage the aggregator must tolerate",
+        "nhd_bad notanumber",
+    ]))
+    assert fams["nhd_x"] == [({}, 3.0)]
+    assert fams["nhd_y"] == [({"shard": "0", "window": "5m"}, 1.5)]
+    assert "nhd_bad" not in fams

@@ -74,6 +74,8 @@ def found_of(path: Path, packs=None) -> set:
     ("fence_out_of_scope.py", ["fencing"]),
     ("lockgraph_pos.py", ["lockgraph"]),
     ("lockgraph_neg.py", ["lockgraph"]),
+    ("metrics_pos.py", ["metrics"]),
+    ("metrics_neg.py", ["metrics"]),
 ])
 def test_fixture_exact_findings(name, packs):
     path = FIXTURES / name
@@ -82,7 +84,7 @@ def test_fixture_exact_findings(name, packs):
 
 _POS_FIXTURES = ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
                  "solver/det_pos.py", "scheduler/fence_pos.py",
-                 "lockgraph_pos.py")
+                 "lockgraph_pos.py", "metrics_pos.py")
 
 
 def test_fixtures_have_positive_coverage_for_every_pack():
@@ -450,10 +452,20 @@ def test_gate_nhd_tpu_is_clean():
 def test_gate_tools_and_tests_are_clean():
     """make lint covers tools/ and tests/ too (deliberate-violation
     fixture files excluded) — this gate keeps that surface clean in
-    tier-1, same contract as the package gate above."""
+    tier-1, same contract as the package gate above. The package is in
+    the ANALYZED set (exactly like make lint) because project packs
+    resolve cross-module facts there — the metrics pack's registration
+    registry lives in nhd_tpu/ while tests assert on the exposition
+    lines — but only tools/tests findings are judged here (the package
+    gate above owns the rest)."""
     reports = analyze_paths(
-        [REPO / "tools", REPO / "tests"], exclude=["tests/fixtures"]
+        [REPO / "nhd_tpu", REPO / "tools", REPO / "tests"],
+        exclude=["tests/fixtures"],
     )
+    reports = [
+        r for r in reports
+        if "/tools/" in r.path or "/tests/" in r.path
+    ]
     assert len(reports) > 30
     assert not any("fixtures" in r.path for r in reports)
     findings = [f for r in reports for f in r.findings]

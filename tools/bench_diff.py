@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Compare two bench artifacts; fail on regression past a threshold.
+
+The continuous-regression gate of the perf-telemetry pipeline
+(obs/perf.py, docs/OBSERVABILITY.md "Perf telemetry"): bench.py writes a
+schema-versioned artifact per run, this tool diffs two of them and exits
+nonzero when a watched figure regressed by more than ``--threshold``
+(default 10%). Legacy BENCH_rNN driver records load too (upgraded in
+memory), so a new run can be gated against history that predates the
+artifact writer.
+
+    python tools/bench_diff.py OLD.json NEW.json
+    python tools/bench_diff.py --threshold 0.05 OLD.json NEW.json
+    make bench-diff                 # two newest artifacts/bench/*.json
+
+Watched per shared config: the solve-phase seconds (the figure the
+ROADMAP's perf arc optimizes) and total wall. Watched globally: the
+headline pods/s. Phases below ``--floor`` seconds (default 5 ms) are
+skipped — at that scale the diff measures host jitter, not the solver.
+Configs present in only one artifact are reported but never fatal (the
+matrix legitimately grows).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nhd_tpu.obs.perf import load_bench_artifact  # noqa: E402
+
+#: per-config phase keys gated by default (solve is the headline; wall
+#: catches regressions that hide between phases)
+WATCHED_PHASES = ("solve",)
+
+
+def _pct(old: float, new: float) -> float:
+    return (new - old) / old if old > 0 else 0.0
+
+
+def diff_artifacts(
+    old: dict, new: dict, *, threshold: float, floor: float,
+    phases=WATCHED_PHASES,
+) -> tuple:
+    """Returns (report_lines, regressions) — regressions is the list of
+    human-readable failures past the threshold."""
+    lines = []
+    regressions = []
+    ocfg = old["payload"]["configs"]
+    ncfg = new["payload"]["configs"]
+    only_old = sorted(set(ocfg) - set(ncfg))
+    only_new = sorted(set(ncfg) - set(ocfg))
+    if only_old:
+        lines.append(f"configs only in OLD (not gated): {', '.join(only_old)}")
+    if only_new:
+        lines.append(f"configs only in NEW (not gated): {', '.join(only_new)}")
+    for name in sorted(set(ocfg) & set(ncfg)):
+        o, n = ocfg[name], ncfg[name]
+        for phase in phases:
+            op = float(o.get("phases", {}).get(phase, 0.0))
+            np_ = float(n.get("phases", {}).get(phase, 0.0))
+            if op < floor or np_ == 0.0 and op == 0.0:
+                continue
+            d = _pct(op, np_)
+            mark = " <-- REGRESSION" if d > threshold else ""
+            lines.append(
+                f"{name:>24} {phase:>8}: {op * 1e3:8.1f}ms -> "
+                f"{np_ * 1e3:8.1f}ms ({d:+.1%}){mark}"
+            )
+            if d > threshold:
+                regressions.append(
+                    f"{name} {phase} phase regressed {d:+.1%} "
+                    f"({op:.3f}s -> {np_:.3f}s, threshold {threshold:.0%})"
+                )
+        ow, nw = float(o.get("wall_seconds", 0.0)), float(
+            n.get("wall_seconds", 0.0)
+        )
+        if ow >= floor:
+            d = _pct(ow, nw)
+            mark = " <-- REGRESSION" if d > threshold else ""
+            lines.append(
+                f"{name:>24}     wall: {ow * 1e3:8.1f}ms -> "
+                f"{nw * 1e3:8.1f}ms ({d:+.1%}){mark}"
+            )
+            if d > threshold:
+                regressions.append(
+                    f"{name} wall regressed {d:+.1%} "
+                    f"({ow:.3f}s -> {nw:.3f}s, threshold {threshold:.0%})"
+                )
+    oh, nh = old["payload"].get("headline"), new["payload"].get("headline")
+    if (
+        isinstance(oh, dict) and isinstance(nh, dict)
+        and oh.get("metric") == nh.get("metric")
+        and isinstance(oh.get("value"), (int, float))
+        and isinstance(nh.get("value"), (int, float))
+        and oh["value"] > 0
+    ):
+        # headline is a RATE (higher is better): regression is a DROP
+        d = (nh["value"] - oh["value"]) / oh["value"]
+        mark = " <-- REGRESSION" if -d > threshold else ""
+        lines.append(
+            f"{'headline':>24} {oh.get('unit', ''):>8}: "
+            f"{oh['value']:.1f} -> {nh['value']:.1f} ({d:+.1%}){mark}"
+        )
+        if -d > threshold:
+            regressions.append(
+                f"headline {oh.get('metric')} dropped {d:+.1%} "
+                f"({oh['value']} -> {nh['value']}, threshold {threshold:.0%})"
+            )
+    return lines, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("old", help="baseline artifact (new format or legacy "
+                                "BENCH_rNN driver record)")
+    ap.add_argument("new", help="candidate artifact")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="fatal regression fraction (default 0.10 = 10%%)")
+    ap.add_argument("--floor", type=float, default=0.005,
+                    help="skip phases whose baseline is below this many "
+                         "seconds (default 0.005 — below it the diff "
+                         "measures host jitter)")
+    ap.add_argument("--phases", default=",".join(WATCHED_PHASES),
+                    help="comma-separated per-config phase keys to gate "
+                         f"(default {','.join(WATCHED_PHASES)})")
+    args = ap.parse_args(argv)
+
+    try:
+        old = load_bench_artifact(args.old)
+        new = load_bench_artifact(args.new)
+    except (OSError, ValueError) as exc:
+        print(f"bench-diff: cannot load artifact: {exc}")
+        return 2
+
+    phases = tuple(p.strip() for p in args.phases.split(",") if p.strip())
+    lines, regressions = diff_artifacts(
+        old, new, threshold=args.threshold, floor=args.floor, phases=phases,
+    )
+    print(f"bench-diff: {args.old} (rev {old.get('git_rev')}) -> "
+          f"{args.new} (rev {new.get('git_rev')})")
+    for line in lines:
+        print(f"  {line}")
+    if regressions:
+        print(f"bench-diff: FAILED — {len(regressions)} regression(s):")
+        for r in regressions:
+            print(f"  {r}")
+        return 1
+    print("bench-diff: OK (no watched figure regressed past "
+          f"{args.threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

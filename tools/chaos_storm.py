@@ -58,6 +58,23 @@ def _run_cell(args, profile: str, seed: int) -> dict:
     stats = sim.run(steps=args.steps)
     sim.quiesce()
     stuck = sim.stuck_pods()
+    fleet_artifact = None
+    if args.federation and args.fleet_out:
+        # one schema-validated fleet artifact per federation cell: the
+        # spillover-hop counts, SLO burn summary and leadership
+        # high-waters of exactly this (profile, seed) storm
+        from nhd_tpu.obs.fleet import write_fleet_artifact
+
+        # the artifact is a byproduct: a write failure in one cell must
+        # not abort the matrix — the --json-out summary is promised even
+        # when cells fail
+        try:
+            fleet_artifact = write_fleet_artifact(
+                sim.fleet_artifact(), args.fleet_out,
+                name=f"fleet-{profile}-seed{seed}.json",
+            )
+        except (OSError, ValueError) as exc:
+            fleet_artifact = f"WRITE FAILED: {exc}"
     record = {
         "profile": profile,
         "seed": seed,
@@ -86,6 +103,8 @@ def _run_cell(args, profile: str, seed: int) -> dict:
             "spilled": stats.spilled,
             "spillover_exhausted": stats.spillover_exhausted,
             "max_spill_age_sec": round(stats.max_spill_age_sec, 1),
+            "fleet_artifact": fleet_artifact,
+            "violation_capture": sim.violation_artifact_path,
         })
     return record
 
@@ -121,6 +140,11 @@ def main() -> int:
                     help="write the machine-readable matrix summary here "
                          "(one record per cell; written even when cells "
                          "fail, so CI diffs results instead of logs)")
+    ap.add_argument("--fleet-out", default=None, metavar="DIR",
+                    help="federation cells: write one schema-validated "
+                         "fleet artifact per (profile, seed) cell here "
+                         "(obs/fleet.py; spillover-hop + SLO burn "
+                         "summaries; make fed-chaos uses artifacts/fleet)")
     ap.add_argument("--start-seed", type=int, default=0)
     args = ap.parse_args()
 
