@@ -15,7 +15,7 @@ FED_STEPS ?= 50
 FED_SHARDS ?= 3
 FED_REPLICAS ?= 3
 
-.PHONY: test lint sanitize proto bench bench-diff wheel clean native soak chaos ha-chaos fed-chaos trace-demo fleet-demo docker docker-smoke release
+.PHONY: test lint sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos trace-demo fleet-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -57,18 +57,14 @@ sanitize:
 		tests/test_streaming.py tests/test_faults.py tests/test_ha.py \
 		tests/test_fleet.py -q
 
-# full release gate: lint + suite + benchmark smoke on the CPU backend +
-# the 3-replica fleet-observability drive (merged journey + validated
-# fleet artifact) + the perf-regression diff when a previous bench
-# artifact exists to compare against
+# full release gate: lint + suite + the seconds-scale bench-smoke leg
+# (writes a perf artifact and diffs it against the newest prior one, so
+# a solve-phase or first-bind regression fails fast without the full
+# cfg5 run — `make bench` remains the full sweep) + the 3-replica
+# fleet-observability drive (merged journey + validated fleet artifact)
 check: lint test
-	NHD_BENCH_PLATFORM=cpu python bench.py
+	$(MAKE) bench-smoke
 	$(MAKE) fleet-demo
-	@if [ $$(ls artifacts/bench/*.json 2>/dev/null | wc -l) -ge 2 ]; then \
-		$(MAKE) bench-diff; \
-	else \
-		echo "bench-diff: fewer than two artifacts; gate skipped"; \
-	fi
 
 # Regenerate protobuf message bindings. Service stubs are hand-written in
 # nhd_tpu/rpc/server.py (no grpc_python_plugin needed).
@@ -77,6 +73,24 @@ proto:
 
 bench:
 	python bench.py
+
+# seconds-scale bench leg (cold-start + AOT first-bind probes + cfg1/2)
+# on the CPU backend: writes a schema-versioned perf artifact and gates
+# it against the newest PRIOR artifact via tools/bench_diff.py — the
+# fast continuous-regression check `make check` runs (docs/PERFORMANCE.md)
+bench-smoke:
+	@prior=$$(ls -t artifacts/bench/*.json 2>/dev/null | head -1); \
+	NHD_BENCH_PLATFORM=cpu NHD_BENCH_SMOKE=1 python bench.py || exit 1; \
+	new=$$(ls -t artifacts/bench/*.json 2>/dev/null | head -1); \
+	if [ -z "$$new" ] || [ "$$new" = "$$prior" ]; then \
+		echo "bench-smoke: FAILED — bench wrote no new artifact" \
+		     "(full disk / NHD_BENCH_NO_ARTIFACT?); perf gate did not run"; \
+		exit 1; \
+	elif [ -n "$$prior" ]; then \
+		python tools/bench_diff.py "$$prior" "$$new"; \
+	else \
+		echo "bench-smoke: no prior artifact; diff gate skipped"; \
+	fi
 
 # continuous perf-regression gate (docs/OBSERVABILITY.md "Perf
 # telemetry"): diff two bench artifacts, nonzero exit on a watched

@@ -173,6 +173,12 @@ RULES: Dict[str, Tuple[str, str]] = {
                "function: clock reads execute at trace time and constant-"
                "fold — time on the host around the dispatch "
                "(nhd_tpu.utils.tracing.phase)"),
+    "NHD107": ("tracing",
+               "host-sync operation (block_until_ready, jax.device_get, "
+               "np.asarray/np.array on a device array) in a solver hot-path "
+               "module: each pull pays a full relay flush — batch with "
+               "copy_to_host_async and pull at the round's one sanctioned "
+               "flush point (intentional sites suppressed inline)"),
     "NHD201": ("locks",
                "write to lock-guarded attribute outside 'with <lock>:' in a "
                "class that owns a threading.Lock/RLock"),

@@ -404,6 +404,190 @@ def _is_mutable_literal(node: ast.AST) -> bool:
     return False
 
 
+# ---------------------------------------------------------------------------
+# NHD107 — host-sync operations in solver hot-path modules
+# ---------------------------------------------------------------------------
+#
+# Every device→host pull costs a full relay flush on the tunnel-attached
+# TPU (~65-84 ms regardless of size, docs/TPU_STATUS.md), and a stray
+# block_until_ready / device_get / np.asarray in a round loop silently
+# serializes the async dispatch pipeline the whole overhead war built.
+# Inside nhd_tpu/solver/ the contract is: batch transfers with
+# copy_to_host_async and pull at ONE sanctioned flush point per round —
+# those sites carry inline suppressions; anything else flags.
+
+import re as _re
+
+_SOLVER_SCOPE_PARTS = ("solver",)
+#: call names whose results are (or carry) device arrays — the taint
+#: seeds for the np.asarray/np.array judgement
+_DEVICE_RESULT = _re.compile(r"(solve|rank|megaround|speculat|fused)")
+_SYNC_PULLS = {
+    "np.asarray", "np.array", "np.copy",
+    "numpy.asarray", "numpy.array", "numpy.copy",
+}
+
+
+def _in_solver_scope(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(p in parts for p in _SOLVER_SCOPE_PARTS)
+
+
+class _HostSyncChecker:
+    """Per-function device-taint dataflow: which local names hold (or
+    contain) values returned by a solver dispatch.
+
+    Two tiers. STRONG taint flows through plain assignments whose value
+    is (or is derived from) a dispatch call — these names definitely
+    hold device arrays, so even scalar pulls (``int()``, ``.item()``)
+    flag on them. WEAK taint additionally flows through loop targets:
+    iterating a dispatch-derived collection often yields HOST tuples
+    whose names get reused (flow-insensitive taint cannot un-taint), so
+    only the unmistakable array pulls (np.asarray/np.array/np.copy)
+    flag at that tier — a deliberate false-negative trade to keep the
+    gate quiet on host bookkeeping loops."""
+
+    def __init__(self, fn, findings: List[Finding], path: str,
+                 device_get_names: Set[str]):
+        self.fn = fn
+        self.findings = findings
+        self.path = path
+        self.device_get_names = device_get_names
+        self.dev: Set[str] = set()      # weak OR strong
+        self.strong: Set[str] = set()
+
+    def _own_nodes(self):
+        stack = list(ast.iter_child_nodes(self.fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _is_dispatch_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        d = _dotted(node.func) or ""
+        if _DEVICE_RESULT.search(d.split(".")[-1]):
+            return True
+        return isinstance(node.func, ast.Attribute) and bool(
+            _DEVICE_RESULT.search(node.func.attr)
+        )
+
+    def _tainted(self, node: ast.AST, names: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in names
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self._tainted(node.value, names)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._tainted(e, names) for e in node.elts)
+        if isinstance(node, ast.Call):
+            return self._is_dispatch_call(node)
+        return False
+
+    def is_dev(self, node: ast.AST) -> bool:
+        return self._tainted(node, self.dev)
+
+    def is_strong(self, node: ast.AST) -> bool:
+        return self._tainted(node, self.strong)
+
+    def _taint(self, tgt: ast.AST, strong: bool) -> bool:
+        changed = False
+        if isinstance(tgt, ast.Name):
+            if tgt.id not in self.dev:
+                self.dev.add(tgt.id)
+                changed = True
+            if strong and tgt.id not in self.strong:
+                self.strong.add(tgt.id)
+                changed = True
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                changed |= self._taint(e, strong)
+        elif isinstance(tgt, ast.Starred):
+            changed = self._taint(tgt.value, strong)
+        return changed
+
+    def run(self) -> None:
+        # fixed point: taint chains (dispatch -> name -> name -> loop
+        # target) settle regardless of statement order
+        for _ in range(8):
+            changed = False
+            for node in self._own_nodes():
+                if isinstance(node, ast.Assign) and self.is_dev(node.value):
+                    for tgt in node.targets:
+                        changed |= self._taint(
+                            tgt, self.is_strong(node.value)
+                        )
+                elif isinstance(node, ast.AnnAssign) and (
+                    node.value is not None and self.is_dev(node.value)
+                ):
+                    changed |= self._taint(
+                        node.target, self.is_strong(node.value)
+                    )
+                elif isinstance(node, ast.AugAssign) and (
+                    self.is_dev(node.value) or self.is_dev(node.target)
+                ):
+                    changed |= self._taint(node.target, False)
+                elif isinstance(node, (ast.For, ast.AsyncFor)) and self.is_dev(
+                    node.iter
+                ):
+                    changed |= self._taint(node.target, False)
+            if not changed:
+                break
+        for node in self._own_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func) or ""
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr == "block_until_ready"
+            ):
+                self._emit(node, "block_until_ready() blocks the host on "
+                                 "the device pipeline")
+            elif isinstance(node.func, ast.Attribute) and (
+                node.func.attr == "item" and self.is_strong(node.func.value)
+            ):
+                self._emit(node, ".item() on a device array is a "
+                                 "synchronous host pull")
+            elif d == "jax.device_get" or d in self.device_get_names:
+                self._emit(node, "jax.device_get() forces a synchronous "
+                                 "device→host transfer")
+            elif (
+                d in ("int", "float")
+                and node.args
+                and self.is_strong(node.args[0])
+            ):
+                self._emit(node, f"{d}() on a device array blocks on the "
+                                 "dispatch to concretize the scalar")
+            elif d in _SYNC_PULLS and node.args and self.is_dev(node.args[0]):
+                self._emit(node, f"{d}() on a device array is a "
+                                 "synchronous host pull")
+
+    def _emit(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            "NHD107", self.path, node.lineno, node.col_offset,
+            f"host-sync in solver hot path '{self.fn.name}': {what} — "
+            "each pull pays a full relay flush; batch transfers with "
+            "copy_to_host_async and pull at the round's ONE sanctioned "
+            "flush point (suppress intentional flush sites inline)",
+        ))
+
+
+def _check_host_sync(tree: ast.Module, path: str) -> List[Finding]:
+    if not _in_solver_scope(path):
+        return []
+    device_get_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "device_get":
+                    device_get_names.add(alias.asname or "device_get")
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _HostSyncChecker(node, findings, path, device_get_names).run()
+    return findings
+
+
 def check_module(tree: ast.Module, src: str, path: str) -> List[Finding]:
     jit_names = _collect_jit_aliases(tree)
     index = _FunctionIndex(jit_names)
@@ -428,4 +612,5 @@ def check_module(tree: ast.Module, src: str, path: str) -> List[Finding]:
     findings.extend(
         _check_jit_construction(tree, jit_names, path, index.functions)
     )
+    findings.extend(_check_host_sync(tree, path))
     return findings

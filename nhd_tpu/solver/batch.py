@@ -1055,10 +1055,12 @@ class BatchScheduler:
                 # the async batch each pay a full ~65 ms turnaround —
                 # measured 130 ms vs 65 ms, docs/TPU_STATUS.md r4)
                 t_pull = time.perf_counter()
-                claims_np = np.asarray(spec.claims)
-                counts_np = np.asarray(spec.counts)
-                spec_need_left = int(np.asarray(spec.need_left).sum())
-                spec_it = int(np.asarray(spec.iters_used))
+                # the speculative round's ONE sanctioned flush (NHD107):
+                # all four tensors were copy_to_host_async'd at dispatch
+                claims_np = np.asarray(spec.claims)  # nhdlint: ignore[NHD107]
+                counts_np = np.asarray(spec.counts)  # nhdlint: ignore[NHD107]
+                spec_need_left = int(np.asarray(spec.need_left).sum())  # nhdlint: ignore[NHD107]
+                spec_it = int(np.asarray(spec.iters_used))  # nhdlint: ignore[NHD107]
                 stats.phase_add("spec_pull", time.perf_counter() - t_pull)
             for G, pods, out in launched:
                 try:
@@ -1076,7 +1078,9 @@ class BatchScheduler:
                 # for the round's lifetime
                 keepalive.append(out)
                 T = pods.n_types
-                arr = np.asarray(out)
+                # the classic round's ONE sanctioned flush (NHD107): the
+                # copy_to_host_async loop above batched every bucket pull
+                arr = np.asarray(out)  # nhdlint: ignore[NHD107]
                 bucket_out[G] = (pods, RankHost(*arr[:, :T]))
             stats.solve_seconds += time.perf_counter() - t0
 

@@ -32,69 +32,21 @@ from nhd_tpu.obs.jitstats import JIT_STATS
 from nhd_tpu.solver.encode import ClusterArrays
 from nhd_tpu.solver.kernel import (
     SolveOut,
+    _ARG_ORDER,
+    _MUTABLE,
+    _STATIC,
     _get_ranker,
-    _rank_body,
-    _solve,
     _pad_pow2,
+    _pad_rows_to as _pad_rows,
+    dispatch_ranked,
     get_solver,
     pad_nodes,
 )
 
-
-# node arrays that claims mutate; the rest are uploaded once and never touched
-_MUTABLE = ("busy", "hp_free", "cpu_free", "gpu_free", "nic_free", "gpu_free_sw")
-_STATIC = (
-    "numa_nodes", "smt", "active", "maintenance", "gpuless", "group_mask",
-    "nic_count", "nic_sw",
-)
-_ARG_ORDER = (
-    "numa_nodes", "smt", "active", "maintenance", "busy", "gpuless",
-    "group_mask", "hp_free", "cpu_free", "gpu_free", "nic_count",
-    "nic_free", "nic_sw", "gpu_free_sw",
-)
-
-
-def _pad_rows(a: np.ndarray, size: int) -> np.ndarray:
-    if a.shape[0] == size:
-        return a
-    return np.concatenate(
-        [a, np.zeros((size - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
-    )
-
-
-from functools import lru_cache
-
-
-@lru_cache(maxsize=None)
-def _get_fused_ranked(G, U, K, R):
-    """One jitted program = solve + top-R rank in ONE dispatch (the pull
-    of the packed rank tensor is the round's single relay flush). Cache
-    key is the bucket shape + R — a whole batch reuses one program.
-
-    Claim updates reach the device as a wholesale async re-upload of the
-    mutable arrays (see update_rows), NOT as a fused scatter: the relay
-    charges per FLUSH, uploads batch into the next flush for free, and
-    every distinct scatter-width variant used to lazily compile its own
-    program mid-run (~1 s each through the tunnel) — one stable program
-    per shape beats O(claimed-rows) upload savings outright."""
-    from nhd_tpu.solver.combos import get_tables
-
-    tables = get_tables(G, U, K)
-
-    def fn(mutable, static, *pod_args):
-        arrays = {**static, **mutable}
-        out = _solve(
-            tables,
-            *[arrays[name] for name in _ARG_ORDER],
-            *pod_args,
-        )
-        return _rank_body(
-            R, out.cand, out.pref, out.best_c, out.best_m, out.best_a,
-            out.n_picks,
-            arrays["gpu_free"], arrays["cpu_free"], arrays["hp_free"],
-        )
-
-    return jax.jit(fn)
+# _ARG_ORDER/_MUTABLE/_STATIC now live in kernel.py (the single
+# argument-order contract, shared with the fused programs and the AOT
+# layer) and are re-exported here for the speculative megaround and
+# older callers.
 
 
 class DeviceClusterState:
@@ -229,17 +181,18 @@ class DeviceClusterState:
             )
 
         self._flush_staged()  # async wholesale re-upload of dirty state
-        JIT_STATS.record_use(
-            "solve_rank_fused",
-            f"G{pods.G}_U{self.cluster.U}_K{self.cluster.K}"
-            f"_R{R}_T{_pad_pow2(pods.n_types)}_N{self.Np}",
-        )
-        fused = _get_fused_ranked(
+        # same fused program (and AOT artifact) as the host path: claim
+        # updates reach the device as a wholesale async re-upload of the
+        # mutable arrays (see update_rows), NOT as a fused scatter — the
+        # relay charges per FLUSH, uploads batch into the next flush for
+        # free, and every distinct scatter-width variant used to lazily
+        # compile its own program mid-run (~1 s each through the tunnel)
+        return dispatch_ranked(
             pods.G, self.cluster.U, self.cluster.K, R,
+            _pad_pow2(pods.n_types), self.Np,
+            [self._dev[name] for name in _ARG_ORDER]
+            + self._pod_args(pods),
         )
-        mutable = {name: self._dev[name] for name in _MUTABLE}
-        static = {name: self._dev[name] for name in _STATIC}
-        return fused(mutable, static, *self._pod_args(pods))
 
     def _rebuild_mutable(self) -> None:
         """Re-upload the claim-mutated resident arrays wholesale from the

@@ -116,12 +116,14 @@ class JaxMatcher:
         for G, pods in buckets.items():
             out = solve_bucket(cluster, pods)
             # np.array (copy): zero-copy views must not outlive the jax
-            # arrays they alias (see solver/batch.py bucket_out note)
-            cand = np.array(out.cand)
-            pref = np.array(out.pref)
-            best_c = np.array(out.best_c)
-            best_m = np.array(out.best_m)
-            best_a = np.array(out.best_a)
+            # arrays they alias (see solver/batch.py bucket_out note).
+            # NHD107-suppressed: find_nodes is the oracle-parity surface,
+            # one pull per bucket per call, not a round loop
+            cand = np.array(out.cand)  # nhdlint: ignore[NHD107]
+            pref = np.array(out.pref)  # nhdlint: ignore[NHD107]
+            best_c = np.array(out.best_c)  # nhdlint: ignore[NHD107]
+            best_m = np.array(out.best_m)  # nhdlint: ignore[NHD107]
+            best_a = np.array(out.best_a)  # nhdlint: ignore[NHD107]
 
             N = cand.shape[1]
             # lexicographic (pref desc, node index asc) via one argmax

@@ -49,9 +49,7 @@ def _solve(
 ) -> SolveOut:
     C, A, U, K = tables.C, tables.A, tables.U, tables.K
     combo_onehot = jnp.asarray(tables.combo_onehot)          # [C,G,U]
-    choose_onehot = jnp.asarray(tables.choose_onehot)        # [C,A,G,U,K]
     need_max = jnp.asarray(tables.need_max)                  # [C,A,U]
-    chosen_cnt = jnp.asarray(tables.chosen_cnt)              # [C,A,U,K]
     maxdig = jnp.asarray(tables.combo_maxdig)                # [C]
     skew = jnp.asarray(tables.skew)                          # [C]
 
@@ -94,20 +92,38 @@ def _solve(
     cpu_any = jnp.any(cpu_ok, axis=-1)  # [T, N, C]
 
     # ---- NIC predicate (reference: Matcher.py:224-276) ----
-    # demand each (numa, nic) accumulates under combo c / pick a — groups
-    # sharing a NIC sum jointly, the reference's in-pod sharing semantics
-    dem_rx = jnp.einsum("tg,caguk->tcauk", rx, choose_onehot)
-    dem_tx = jnp.einsum("tg,caguk->tcauk", tx, choose_onehot)
-    # only (numa, nic) slots some group actually chose constrain the fit —
-    # unchosen slots are padded with free = -1 and must not veto
-    unchosen = (chosen_cnt == 0)[None, None]  # [1, 1, C, A, U, K]
+    # Group-indexed form (r8): each group chooses exactly ONE (numa, nic)
+    # slot per (combo, pick) — slot_u = combo[c, g], slot_k = pick[a, g]
+    # — so the feasibility 'all' over the dense [U, K] slot grid reduces
+    # to an 'all' over the G chosen slots. Unchosen slots only ever
+    # contributed True rows, and groups of one pod sharing a NIC compare
+    # the same joint demand against the same slot twice (idempotent
+    # under 'all'), so every verdict is bit-identical to the dense form
+    # while the dominant lattice shrinks from [T, N, C, A, U, K] to
+    # [T, N, C, A, G] (7x fewer element ops at the headline K=7 shape —
+    # the fusion-aware-mapper move: never build state the reduction
+    # doesn't need). combo/pick index tables are static constants the
+    # compiler folds; all derived one-hots fold with them.
+    combo_idx = jnp.asarray(tables.combo, jnp.int32)  # [C, G]
+    pick_idx = jnp.asarray(tables.pick, jnp.int32)    # [A, G]
+    # joint demand per group's slot: groups g, h share bandwidth iff they
+    # chose the same (numa, nic) — the reference's in-pod sharing
+    # semantics (Matcher.py:253-262)
+    same_u = (
+        combo_idx[:, :, None] == combo_idx[:, None, :]
+    ).astype(jnp.float32)  # [C, G, G]
+    same_k = (
+        pick_idx[:, :, None] == pick_idx[:, None, :]
+    ).astype(jnp.float32)  # [A, G, G]
+    dem_rx_g = jnp.einsum("th,cgh,agh->tcag", rx, same_u, same_k)
+    dem_tx_g = jnp.einsum("th,cgh,agh->tcag", tx, same_u, same_k)
+    u_idx = combo_idx[:, None, :]  # [C, 1, G] — broadcast against...
+    k_idx = pick_idx[None, :, :]   # [1, A, G]
+    free_at = nic_free[:, u_idx, k_idx, :]  # [N, C, A, G, 2]
     fit = jnp.all(
-        unchosen
-        | (
-            (dem_rx[:, None] <= nic_free[None, :, None, None, :, :, 0])
-            & (dem_tx[:, None] <= nic_free[None, :, None, None, :, :, 1])
-        ),
-        axis=(-2, -1),
+        (dem_rx_g[:, None] <= free_at[None, ..., 0])
+        & (dem_tx_g[:, None] <= free_at[None, ..., 1]),
+        axis=-1,
     )  # [T, N, C, A]
 
     # every chosen ordinal must exist on the node
@@ -117,13 +133,25 @@ def _solve(
 
     # PCI map mode: chosen NICs need matching free GPUs on their PCIe switch
     # (reference: Matcher.py:295-335 — counts NICs per switch, see oracle.py
-    # module docstring for the kept quirk)
-    S = gpu_free_sw.shape[-1]
-    sw_onehot = (
-        nic_sw[:, :, :, None] == jnp.arange(S)[None, None, None, :]
-    ).astype(jnp.float32)  # [N, U, K, S]
-    sw_need = jnp.einsum("cauk,nuks->ncas", chosen_cnt, sw_onehot)
-    pci_ok = jnp.all(sw_need <= gpu_free_sw[:, None, None, :], axis=-1)  # [N,C,A]
+    # module docstring for the kept quirk). Group-indexed like the fit:
+    # sw_need is nonzero only at the <= G switches the chosen slots sit
+    # on, so "all switches satisfy need <= free" splits into (a) every
+    # group's switch has free >= the count of groups sharing it and
+    # (b) every OTHER switch has free >= 0 — term (b) is one per-node
+    # reduction instead of the [N, C, A, S] one-hot einsum (S = 14 at
+    # the headline shape made that einsum the second-hottest op).
+    sw_at = nic_sw[:, u_idx, k_idx]  # [N, C, A, G] — switch per group slot
+    share_sw = jnp.sum(
+        (sw_at[..., :, None] == sw_at[..., None, :]).astype(jnp.float32),
+        axis=-1,
+    )  # [N, C, A, G] — groups whose slot sits on this group's switch
+    free_sw_at = jnp.take_along_axis(
+        gpu_free_sw, sw_at.reshape(sw_at.shape[0], -1), axis=1
+    ).reshape(sw_at.shape)
+    sw_nonneg = jnp.all(gpu_free_sw >= 0, axis=-1)  # [N]
+    pci_ok = (
+        jnp.all(share_sw <= free_sw_at, axis=-1) & sw_nonneg[:, None, None]
+    )  # [N, C, A]
 
     # the [T, N, C, A] lattice fuses into these reductions (XLA never
     # materializes it in HBM). A Pallas VMEM-streaming variant of this
@@ -169,6 +197,26 @@ def _solve(
 
     return SolveOut(cand, pref, best_c, best_m, best_a, n_combos, n_picks)
 
+
+# The single node-array argument-order contract every solve entry shares:
+# kernel dispatches, device-resident state (solver/device_state.py), the
+# speculative megaround (solver/speculate.py) and the AOT export/prewarm
+# layer (solver/aot.py) all build their argument lists from these tuples,
+# so the 23-array positional signature cannot drift between them.
+_MUTABLE = ("busy", "hp_free", "cpu_free", "gpu_free", "nic_free", "gpu_free_sw")
+_STATIC = (
+    "numa_nodes", "smt", "active", "maintenance", "gpuless", "group_mask",
+    "nic_count", "nic_sw",
+)
+_ARG_ORDER = (
+    "numa_nodes", "smt", "active", "maintenance", "busy", "gpuless",
+    "group_mask", "hp_free", "cpu_free", "gpu_free", "nic_count",
+    "nic_free", "nic_sw", "gpu_free_sw",
+)
+_POD_ARG_ORDER = (
+    "cpu_dem_smt", "cpu_dem_raw", "gpu_dem", "rx", "tx", "hp", "needs_gpu",
+    "map_pci", "group_mask",
+)
 
 # combo-lattice ceiling: (U^G) * (K^G) above this routes the bucket to the
 # serial oracle instead of enumerating a huge static axis (a 6-group pod on
@@ -302,78 +350,133 @@ def rank_cap(accelerator: bool) -> int:
 
 
 def rank_budget(max_need: int, n_padded: int, *, accelerator: bool = False) -> int:
-    """The R for a batch: covers the largest per-type pod count (every
-    candidate carries capacity >= 1, so R >= need never costs extra
-    rounds), bucketed to a power of two for jit-cache reuse, under the
-    platform cap (see rank_cap)."""
+    """The R for a batch, bucketed for jit-cache reuse under the
+    platform cap (see rank_cap).
+
+    CPU backend: R is a pure function of CLUSTER size — min(nodes, cap)
+    — never of batch composition. Pulls are zero-copy there, so a
+    need-proportional R only bought a smaller top_k; but with the solve
+    and rank fused into ONE program (r8) R became a specializing dim of
+    the whole megaround, and a max-need change re-traced the entire
+    fused solve (measured: the cfg5 streaming run recompiled every
+    bucket x tile program mid-measurement because its warmup batch had
+    a different largest-type count). A fixed R per cluster also makes
+    the zero-recompile invariant hold by construction on the serving
+    path. Accelerator backend: the need-proportional budget stands —
+    the [T, R] pull crosses the relay, so covering the largest per-type
+    pod count (every candidate carries capacity >= 1, so R >= need
+    never costs extra rounds) at minimal width still wins."""
     cap = rank_cap(accelerator)
+    if not accelerator:
+        # pow2-bucket the node bound exactly like the Np padding
+        # (floor 8): callers pass the RAW node count, and an unbucketed
+        # min would move R — re-tracing every fused program and missing
+        # every AOT artifact — each time a node joins or leaves
+        return min(_pad_pow2(max(n_padded, 1), floor=8), cap)
     return min(n_padded, _pad_pow2(min(max(max_need, 1), cap), floor=64))
 
 
-def solve_bucket_ranked(cluster, pods, R: int) -> jax.Array:
-    """solve_bucket + on-device top-R ranking, without materializing the
-    [T, N] outputs on host. Returns the packed [9, Tp, R] tensor —
-    callers slice [:, :T]."""
-    N = cluster.n_nodes
-    Np = _pad_pow2(N, floor=8)
+@lru_cache(maxsize=None)
+def get_ranked_solver(G: int, U: int, K: int, R: int):
+    """ONE jitted program: the bucket solve FUSED with the top-R ranking
+    (r8 megaround fusion). The [T, N] feasibility/score/choice tensors
+    never leave the program — XLA fuses the solve reductions straight
+    into the rank's top_k/gather inputs and dead-code-eliminates outputs
+    the rank never reads (n_combos), where the old two-program pipeline
+    materialized all seven SolveOut tensors between dispatches. Takes
+    the 14 node arrays (``_ARG_ORDER``) followed by the 9 pod-type
+    arrays (``_POD_ARG_ORDER``); returns the packed [9, T, R] int32 rank
+    tensor (RankOut order). This is THE production program — the AOT
+    layer (solver/aot.py) exports and prewarm-loads exactly this
+    signature, and tools/export_tpu.py pins it as the TPU artifact."""
+    tables = get_tables(G, U, K)
+    i_hp = _ARG_ORDER.index("hp_free")
+    i_cpu = _ARG_ORDER.index("cpu_free")
+    i_gpu = _ARG_ORDER.index("gpu_free")
 
-    def pad_n(a):
-        if a.shape[0] == Np:
-            return a
-        return np.concatenate(
-            [a, np.zeros((Np - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
+    def fn(*args):
+        out = _solve(tables, *args)
+        return _rank_body(
+            R, out.cand, out.pref, out.best_c, out.best_m, out.best_a,
+            out.n_picks, args[i_gpu], args[i_cpu], args[i_hp],
         )
 
-    out = _solve_padded(cluster, pods)
-    # recompile accounting: the ranker specializes on (R, padded T)
-    JIT_STATS.record_use(
-        "rank", f"R{min(R, Np)}_T{_pad_pow2(pods.n_types)}_N{Np}"
+    return jax.jit(fn)
+
+
+def ranked_shape_key(G, U, K, R, Tp, Np) -> str:
+    """The jit-stats shape key of one fused solve+rank program — every
+    dim the compiled program specializes on. Shared by the dispatch
+    sites and the AOT prewarm loader so a prewarmed program's first real
+    use counts as a cache hit, never a compile."""
+    return f"G{G}_U{U}_K{K}_R{R}_T{Tp}_N{Np}"
+
+
+def dispatch_ranked(G, U, K, R, Tp, Np, args) -> jax.Array:
+    """Resolve + invoke the fused solve+rank program for one padded
+    shape: the AOT prewarm cache first (zero-cold-start — the program
+    was deserialized from StableHLO and compiled at daemon start), else
+    the live jit, which is exported back to the AOT artifact cache when
+    saving is on (solver/aot.py). ``args`` is the full 23-array
+    positional list; host and device-resident callers share this single
+    entry so their programs (and AOT artifacts) are one and the same."""
+    # recompile accounting (obs/jitstats.py): a first-seen key IS a
+    # fresh trace+compile (or a prewarm load), the silent stall the
+    # nhd_jit_* metrics make scrapeable
+    JIT_STATS.record_use("solve_ranked", ranked_shape_key(G, U, K, R, Tp, Np))
+    from nhd_tpu.solver import aot
+
+    prog = aot.lookup(aot.ShapeKey("ranked", G, U, K, R, Tp, Np))
+    if prog is not None:
+        return prog(*args)
+    fn = get_ranked_solver(G, U, K, R)
+    aot.maybe_export(aot.ShapeKey("ranked", G, U, K, R, Tp, Np), fn, args)
+    return fn(*args)
+
+
+def _pad_rows_to(a: np.ndarray, size: int) -> np.ndarray:
+    if a.shape[0] == size:
+        return a
+    return np.concatenate(
+        [a, np.zeros((size - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
     )
-    ranker = _get_ranker(min(R, Np))
-    return ranker(
-        out.cand, out.pref, out.best_c, out.best_m, out.best_a, out.n_picks,
-        pad_n(cluster.gpu_free), pad_n(cluster.cpu_free),
-        pad_n(cluster.hp_free),
+
+
+def padded_args(cluster, pods, Tp: int, Np: int) -> list:
+    """The 23 padded solver arguments (node arrays in ``_ARG_ORDER``,
+    then pod arrays in ``_POD_ARG_ORDER``) — the one place the host
+    padding rule lives."""
+    return [
+        _pad_rows_to(getattr(cluster, name), Np) for name in _ARG_ORDER
+    ] + [
+        _pad_rows_to(getattr(pods, name), Tp) for name in _POD_ARG_ORDER
+    ]
+
+
+def solve_bucket_ranked(cluster, pods, R: int) -> jax.Array:
+    """Solve + top-R ranking in ONE fused dispatch (get_ranked_solver):
+    feasibility masks, scores and the ranked gathers never materialize
+    between programs, on host or in HBM. Returns the packed [9, Tp, R]
+    tensor — callers slice [:, :T]."""
+    T, N = pods.n_types, cluster.n_nodes
+    Tp, Np = _pad_pow2(T), _pad_pow2(N, floor=8)
+    return dispatch_ranked(
+        pods.G, cluster.U, cluster.K, min(R, Np), Tp, Np,
+        padded_args(cluster, pods, Tp, Np),
     )
 
 
 def _solve_padded(cluster, pods) -> SolveOut:
-    """The padded solver call (full [Tp, Np] outputs, no host slicing)."""
-    T, N = pods.n_types, cluster.n_nodes
-    Tp, Np = _pad_pow2(T), _pad_pow2(N, floor=8)
-
-    def pad_n(a):
-        if a.shape[0] == Np:
-            return a
-        return np.concatenate(
-            [a, np.zeros((Np - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
-        )
-
-    def pad_t(a):
-        if a.shape[0] == Tp:
-            return a
-        return np.concatenate(
-            [a, np.zeros((Tp - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
-        )
-
-    # recompile accounting (obs/jitstats.py): the compiled program is
-    # keyed by the bucket (G, U, K) plus the padded axes XLA specializes
-    # on — a first-seen key here IS a fresh trace+compile, the silent
-    # stall the nhd_jit_* metrics make scrapeable
+    """The padded plain-solve call (full [Tp, Np] SolveOut, no host
+    slicing) — the parity/debug surface; production rounds go through
+    the fused ``solve_bucket_ranked``."""
+    Tp = _pad_pow2(pods.n_types)
+    Np = _pad_pow2(cluster.n_nodes, floor=8)
     JIT_STATS.record_use(
         "solve", f"G{pods.G}_U{cluster.U}_K{cluster.K}_T{Tp}_N{Np}"
     )
     solver = get_solver(pods.G, cluster.U, cluster.K)
-    return solver(
-        pad_n(cluster.numa_nodes), pad_n(cluster.smt), pad_n(cluster.active),
-        pad_n(cluster.maintenance), pad_n(cluster.busy), pad_n(cluster.gpuless),
-        pad_n(cluster.group_mask), pad_n(cluster.hp_free), pad_n(cluster.cpu_free),
-        pad_n(cluster.gpu_free), pad_n(cluster.nic_count), pad_n(cluster.nic_free),
-        pad_n(cluster.nic_sw), pad_n(cluster.gpu_free_sw),
-        pad_t(pods.cpu_dem_smt), pad_t(pods.cpu_dem_raw), pad_t(pods.gpu_dem),
-        pad_t(pods.rx), pad_t(pods.tx), pad_t(pods.hp), pad_t(pods.needs_gpu),
-        pad_t(pods.map_pci), pad_t(pods.group_mask),
-    )
+    return solver(*padded_args(cluster, pods, Tp, Np))
 
 
 def solve_bucket(cluster, pods, *, device=None) -> SolveOut:

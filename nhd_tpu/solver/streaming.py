@@ -246,10 +246,18 @@ class StreamingScheduler:
         # the same rule every tile's encode uses (encode.cluster_dims), so
         # nothing deemed tractable here can be oversized inside a tile.
         U, K, _ = cluster_dims(nodes)
-        oversized = [
-            i for i in schedulable
-            if not bucket_tractable(items[i].request.n_groups, U, K)
-        ]
+        # tractability memoized per group count (one bucket verdict
+        # covers a whole gang): the per-pod power computation was 0.26 s
+        # of serial preamble at the 100k federation scale
+        _tract: Dict[int, bool] = {}
+        oversized = []
+        for i in schedulable:
+            G = items[i].request.n_groups
+            v = _tract.get(G)
+            if v is None:
+                v = _tract[G] = bucket_tractable(G, U, K)
+            if not v:
+                oversized.append(i)
         if oversized:
             self.batch._schedule_serial(
                 nodes, items, oversized, results, stats, now, True
@@ -501,12 +509,24 @@ class StreamingScheduler:
                 if submit_next:
                     pool.submit(run_tile, nxt)
 
-        # default 4 workers regardless of core count: tile stages spend
-        # much of their wall blocked on accelerator relay flushes and XLA
-        # solves (both release the GIL), so concurrent stages overlap
-        # those waits even on a 1-core host (measured cfg5 6.1→5.7 s);
-        # pure-Python stages serialize on the GIL either way
-        default_workers = 4
+        # default workers: on an accelerator, 4 regardless of core count —
+        # tile stages spend much of their wall blocked on relay flushes
+        # (GIL released), so concurrent stages overlap those waits even
+        # on a 1-core host (measured cfg5 6.1→5.7 s r4). On the CPU
+        # backend the r8 fused solve left the host phases as the
+        # critical path, and oversubscribing cores just stretches every
+        # GIL-bound select/assign span (measured cfg5: 4 workers 4.87 s
+        # vs 2 workers 4.47 s on a 2-core box) — cap at the core count,
+        # floor 2 so solve/host still overlap
+        import jax
+
+        try:
+            accel = jax.default_backend() != "cpu"
+        except Exception:
+            accel = False
+        default_workers = (
+            4 if accel else min(4, max(2, os.cpu_count() or 2))
+        )
         n_workers = max(
             1,
             min(

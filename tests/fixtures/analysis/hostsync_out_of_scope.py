@@ -1,0 +1,13 @@
+# nhdlint fixture: the same host-sync shapes OUTSIDE a solver path — the
+# NHD107 pack is path-scoped and must stay silent here (tools, tests and
+# obs code pull results synchronously by design).
+import numpy as np
+import jax
+
+
+def scrape(dev, pods):
+    out = dev.solve_ranked(pods, 64)
+    arr = np.asarray(out)
+    out.block_until_ready()
+    host = jax.device_get(out)
+    return arr, host

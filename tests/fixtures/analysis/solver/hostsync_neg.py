@@ -1,0 +1,26 @@
+# nhdlint fixture: NHD107 negatives — sanctioned transfer patterns and
+# plain host numpy that must NOT flag inside solver scope.
+import numpy as np
+
+
+def batched_round_pull(dev, pods):
+    out = dev.solve_ranked(pods, 64)
+    # async prefetch is the sanctioned pattern: starts the flush without
+    # blocking the host
+    out.copy_to_host_async()
+    return out
+
+
+def host_only_math(items):
+    # np on plain host data: no device value involved
+    pending = np.asarray([i for i in range(len(items))], np.int64)
+    blocked = np.array([1, 2, 3], np.int64)
+    caps = np.copy(blocked)
+    return pending, blocked, caps
+
+
+def suppressed_flush(dev, pods):
+    out = dev.solve_ranked(pods, 64)
+    # an intentional single-flush site carries an inline suppression
+    arr = np.asarray(out)  # nhdlint: ignore[NHD107]
+    return arr

@@ -1,0 +1,43 @@
+# nhdlint fixture: NHD107 host-sync hazards in solver hot-path modules
+# (this file sits under a "solver" path segment, so the pack is in
+# scope). Flagged lines carry EXPECT markers; analyzed as text only.
+import numpy as np
+import jax
+from jax import device_get as dg
+
+
+def round_pull(dev, pods):
+    out = dev.solve_ranked(pods, 64)
+    arr = np.asarray(out)  # EXPECT[NHD107]
+    out.block_until_ready()  # EXPECT[NHD107]
+    host = jax.device_get(out)  # EXPECT[NHD107]
+    host2 = dg(out)  # EXPECT[NHD107]
+    return arr, host, host2
+
+
+def megaround_pull(dev):
+    claims, counts, need, it = dev.megaround([], [], True)
+    c = np.array(claims)  # EXPECT[NHD107]
+    n = int(np.asarray(need).sum())  # EXPECT[NHD107]
+    k = int(it)  # EXPECT[NHD107] — direct scalar concretization
+    f = float(need)  # EXPECT[NHD107]
+    s = counts.item()  # EXPECT[NHD107]
+    return c, n, k, f, s
+
+
+def annotated_assign(dev, pods):
+    out: object = dev.solve_ranked(pods, 64)
+    return np.asarray(out)  # EXPECT[NHD107] — AnnAssign propagates taint
+
+
+def chained_taint(cluster, pods):
+    # taint must survive name-to-name assignment and loop unpacking
+    launched = _dispatch_solves(cluster, pods)
+    prelaunched = launched
+    for G, out in prelaunched:
+        arr = np.asarray(out)  # EXPECT[NHD107]
+    return arr
+
+
+def _dispatch_solves(cluster, pods):
+    return [(1, object())]

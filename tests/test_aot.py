@@ -1,0 +1,272 @@
+"""AOT StableHLO program cache (solver/aot.py): export-on-first-trace,
+versioned cache keys with quarantine, prewarm serving parity, and the
+zero-recompile invariant under a seeded chaos storm.
+
+The cache is process-global (like the jit cache it fronts), so every
+test runs against a fresh tmp directory via the ``aot_cache`` fixture
+and resets the program table afterwards."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from nhd_tpu.obs.jitstats import JIT_STATS
+from nhd_tpu.solver import aot
+from nhd_tpu.solver.kernel import (
+    get_ranked_solver,
+    get_solver,
+    solve_bucket_ranked,
+)
+
+
+@pytest.fixture
+def aot_cache(tmp_path):
+    aot.reset()
+    aot.configure(directory=str(tmp_path), save=True)
+    yield str(tmp_path)
+    aot.reset()
+
+
+def _small_problem(n_nodes=16, n_pods=24):
+    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+    from nhd_tpu.solver.encode import encode_cluster, encode_pods
+
+    nodes = cap_cluster(n_nodes, ["default"])
+    reqs = workload_mix(n_pods, ["default"])
+    cluster = encode_cluster(nodes, now=0.0)
+    return cluster, encode_pods(reqs, cluster.interner)
+
+
+def _seed_cache(aot_cache):
+    """Run the live path with saving on; returns {G: packed tensor}."""
+    cluster, buckets = _small_problem()
+    outs = {
+        G: np.asarray(solve_bucket_ranked(cluster, pods, 64))
+        for G, pods in sorted(buckets.items())
+    }
+    aot.AOT.drain()
+    return cluster, buckets, outs
+
+
+def test_export_on_first_trace_writes_versioned_artifacts(aot_cache):
+    _seed_cache(aot_cache)
+    metas = sorted(f for f in os.listdir(aot_cache) if f.endswith(".json"))
+    bins = sorted(
+        f for f in os.listdir(aot_cache) if f.endswith(".stablehlo.bin")
+    )
+    assert metas and len(metas) == len(bins)
+    for fname in metas:
+        meta = json.load(open(os.path.join(aot_cache, fname)))
+        # the versioned cache key: jax/jaxlib versions + platform list +
+        # program fingerprint + every specializing dim
+        import jax
+
+        assert meta["jax_version"] == jax.__version__
+        assert meta["fingerprint"] == aot.program_fingerprint()
+        assert "cpu" in meta["platforms"]
+        for dim in ("G", "U", "K", "R", "Tp", "Np"):
+            assert isinstance(meta[dim], int)
+
+
+def test_prewarm_serves_bit_identical_results(aot_cache):
+    cluster, buckets, outs = _seed_cache(aot_cache)
+    # fresh program table: disk is now the only source
+    aot.reset()
+    aot.configure(directory=aot_cache, save=False)
+    summary = aot.prewarm()
+    assert summary["loaded"] == len(outs)
+    assert summary["quarantined"] == 0
+    for G, pods in sorted(buckets.items()):
+        got = np.asarray(solve_bucket_ranked(cluster, pods, 64))
+        assert np.array_equal(got, outs[G])
+
+
+def test_stale_artifact_quarantined_not_deleted(aot_cache):
+    cluster, buckets, outs = _seed_cache(aot_cache)
+    # a jaxlib upgrade happened: every meta reports the old version
+    metas = [f for f in os.listdir(aot_cache) if f.endswith(".json")]
+    for fname in metas:
+        path = os.path.join(aot_cache, fname)
+        meta = json.load(open(path))
+        meta["jax_version"] = "0.0.0-stale"
+        json.dump(meta, open(path, "w"))
+    aot.reset()
+    aot.configure(directory=aot_cache, save=False)
+    # nhd loggers don't propagate to root (caplog-invisible): capture
+    # with a handler on the module logger itself
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger = logging.getLogger("nhd_tpu.solver.aot")
+    logger.addHandler(handler)
+    try:
+        summary = aot.prewarm()
+    finally:
+        logger.removeHandler(handler)
+    assert summary["loaded"] == 0
+    assert summary["quarantined"] == len(metas)
+    # quarantined, never deleted: both files of every pair moved intact
+    qdir = os.path.join(aot_cache, "quarantine")
+    moved = sorted(os.listdir(qdir))
+    assert len(moved) == 2 * len(metas)
+    assert not any(f.endswith(".json") for f in os.listdir(aot_cache))
+    # exactly ONE warning covers the whole stale set
+    warnings = [
+        r for r in records
+        if r.levelno >= logging.WARNING and "quarantined" in r.getMessage()
+    ]
+    assert len(warnings) == 1
+    # and serving falls back to a live re-trace, bit-identical
+    for G, pods in sorted(buckets.items()):
+        got = np.asarray(solve_bucket_ranked(cluster, pods, 64))
+        assert np.array_equal(got, outs[G])
+
+
+def test_fingerprint_mismatch_and_corrupt_blob_quarantined(aot_cache):
+    _seed_cache(aot_cache)
+    metas = sorted(f for f in os.listdir(aot_cache) if f.endswith(".json"))
+    # artifact 0: solver code changed under the artifact
+    p0 = os.path.join(aot_cache, metas[0])
+    meta = json.load(open(p0))
+    meta["fingerprint"] = "deadbeefdeadbeef"
+    json.dump(meta, open(p0, "w"))
+    if len(metas) > 1:
+        # artifact 1: truncated blob (deserialize must fail gracefully)
+        b1 = os.path.join(
+            aot_cache, metas[1].replace(".json", ".stablehlo.bin")
+        )
+        open(b1, "wb").write(b"\x00\x01not-stablehlo")
+    aot.reset()
+    aot.configure(directory=aot_cache, save=False)
+    summary = aot.prewarm()
+    assert summary["loaded"] == 0
+    assert summary["quarantined"] == len(metas)
+
+
+def test_zero_recompile_invariant_under_chaos(aot_cache, monkeypatch):
+    """The acceptance pin: with prewarm on, a seeded ChaosSim storm
+    dispatches ONLY prewarmed shapes — the nhd_jit_* compile counters
+    stay flat after warmup, and any shape-bucket escape fails the test
+    NAMING the escaped shape key."""
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import PROFILES
+
+    # the production CPU-daemon posture: single-device host solves (the
+    # conftest's 8-virtual-device mesh would route to the SPMD path,
+    # which a real CPU daemon never takes)
+    monkeypatch.setenv("NHD_TPU_DEVICE_STATE", "0")
+
+    # warmup/seed phase: the same seeded profile (and step span) the
+    # steady-state phase replays — every bucketed shape it produces gets
+    # traced AND exported to the AOT cache. Identical seed + span means
+    # an escape below is a prewarm coverage hole, never workload drift.
+    sim = ChaosSim(seed=11, n_nodes=4, api_faults=PROFILES["light"])
+    sim.run(60)
+    sim.quiesce()
+    aot.AOT.drain()
+    assert any(f.endswith(".stablehlo.bin") for f in os.listdir(aot_cache))
+
+    # restart-equivalent: drop every live program, then prewarm from the
+    # artifact cache alone (this is what `nhd-tpu --prewarm` does)
+    get_ranked_solver.cache_clear()
+    get_solver.cache_clear()
+    JIT_STATS.reset()
+    aot.reset()
+    aot.configure(directory=aot_cache, save=False)
+    summary = aot.prewarm()
+    assert summary["loaded"] > 0
+    warm = JIT_STATS.snapshot()
+    warm_shapes = set(warm["shapes"])
+
+    # steady state: more storm + convergence against the same sim
+    sim2 = ChaosSim(seed=11, n_nodes=4, api_faults=PROFILES["light"])
+    sim2.run(60)
+    sim2.quiesce()
+    steady = JIT_STATS.snapshot()
+    escaped = sorted(set(steady["shapes"]) - warm_shapes)
+    assert steady["compiles_total"] == warm["compiles_total"], (
+        f"shape-bucket escape at steady state: {escaped} "
+        f"(prewarmed: {sorted(warm_shapes)})"
+    )
+    # and the storm actually dispatched (hits, not silence)
+    assert steady["cache_hits_total"] > warm["cache_hits_total"]
+
+
+def test_bench_diff_gates_first_bind_phases():
+    """The perf pipeline wiring: a first_bind_prewarmed regression past
+    the (doubled) latency threshold fails the diff; an improvement or
+    in-band drift passes."""
+    from nhd_tpu.obs.perf import build_bench_artifact, config_record
+    from tools.bench_diff import WATCHED_PHASES, diff_artifacts
+
+    assert "first_bind_prewarmed" in WATCHED_PHASES
+    assert "prewarm" in WATCHED_PHASES
+
+    def artifact(first_bind):
+        return build_bench_artifact(
+            {
+                "first-bind": config_record(
+                    wall_seconds=2.5, placed=1, speedup=10.0, rounds=1,
+                    phases={
+                        "first_bind_cold": 2.5,
+                        "prewarm": 1.0,
+                        "first_bind_prewarmed": first_bind,
+                    },
+                ),
+            },
+            headline={"metric": "m", "value": 1.0, "unit": "pods/s"},
+            platform="cpu",
+        )
+
+    old = artifact(0.100)
+    _, regressions = diff_artifacts(
+        old, artifact(0.300), threshold=0.10, floor=0.005,
+        phases=WATCHED_PHASES,
+    )
+    assert any("first_bind_prewarmed" in r for r in regressions)
+    # 15% drift on a latency config stays under the doubled threshold,
+    # and the cold wall (subprocess compile jitter) is never gated
+    _, regressions = diff_artifacts(
+        old, artifact(0.115), threshold=0.10, floor=0.005,
+        phases=WATCHED_PHASES,
+    )
+    assert regressions == []
+    _, regressions = diff_artifacts(
+        old, artifact(0.050), threshold=0.10, floor=0.005,
+        phases=WATCHED_PHASES,
+    )
+    assert regressions == []
+
+
+def test_bench_diff_wall_gate_absolute_and_relative():
+    """A wall regression is fatal only past BOTH bounds: a jitter-scale
+    blip on a tiny config passes, a sub-floor baseline blowing up to
+    seconds fails."""
+    from nhd_tpu.obs.perf import build_bench_artifact, config_record
+    from tools.bench_diff import diff_artifacts
+
+    def artifact(wall):
+        return build_bench_artifact(
+            {"cfg1:100x32": config_record(
+                wall_seconds=wall, placed=100, speedup=1.0,
+                phases={"solve": 0.002},
+            )},
+            headline={"metric": "m", "value": 1.0, "unit": "pods/s"},
+            platform="cpu",
+        )
+
+    # +21% on a 13 ms wall = 3 ms growth: under the absolute floor
+    _, regressions = diff_artifacts(
+        artifact(0.013), artifact(0.0158), threshold=0.10, floor=0.005,
+    )
+    assert regressions == []
+    # 45 ms -> 5 s: sub-floor baseline, but the growth is real
+    _, regressions = diff_artifacts(
+        artifact(0.045), artifact(5.0), threshold=0.10, floor=0.005,
+    )
+    assert any("wall regressed" in r for r in regressions)

@@ -60,6 +60,9 @@ def found_of(path: Path, packs=None) -> set:
 @pytest.mark.parametrize("name,packs", [
     ("tracing_pos.py", ["tracing"]),
     ("tracing_neg.py", ["tracing"]),
+    ("solver/hostsync_pos.py", ["tracing"]),
+    ("solver/hostsync_neg.py", ["tracing"]),
+    ("hostsync_out_of_scope.py", ["tracing"]),
     ("locks_pos.py", ["locks"]),
     ("locks_neg.py", ["locks"]),
     ("excepts_pos.py", ["excepts"]),

@@ -32,17 +32,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from nhd_tpu.obs.perf import load_bench_artifact  # noqa: E402
 
 #: per-config phase keys gated by default (solve is the headline; wall
-#: catches regressions that hide between phases)
-WATCHED_PHASES = ("solve",)
+#: catches regressions that hide between phases; prewarm and
+#: first_bind_prewarmed are the zero-cold-start serving promise —
+#: present only in the synthetic "first-bind" config, absent phases are
+#: simply skipped elsewhere)
+WATCHED_PHASES = ("solve", "prewarm", "first_bind_prewarmed")
+
+#: configs whose figures are subprocess LATENCY measurements, not solver
+#: throughput: their cold wall is dominated by trace/compile jitter, so
+#: the wall gate is skipped and the phase gate runs at a doubled
+#: threshold (the promise is "stays in its latency class", not "+-10%")
+LATENCY_CONFIGS = frozenset({"first-bind"})
 
 
 def _pct(old: float, new: float) -> float:
     return (new - old) / old if old > 0 else 0.0
 
 
+#: a wall regression is fatal only when BOTH the relative threshold and
+#: this absolute growth (seconds) are exceeded: at small scales the
+#: figure is scheduler fixed overhead + host jitter (a 3 ms blip on a
+#: 15 ms config reads as +20%), so percentage alone over-fires — while
+#: a sub-floor baseline that blows up to seconds still exceeds the
+#: absolute bound and fails. Per-phase gates watch such configs' solve
+#: time regardless.
+WALL_FLOOR = 0.05
+
+
 def diff_artifacts(
     old: dict, new: dict, *, threshold: float, floor: float,
-    phases=WATCHED_PHASES,
+    phases=WATCHED_PHASES, wall_floor: float = WALL_FLOOR,
 ) -> tuple:
     """Returns (report_lines, regressions) — regressions is the list of
     human-readable failures past the threshold."""
@@ -58,36 +77,45 @@ def diff_artifacts(
         lines.append(f"configs only in NEW (not gated): {', '.join(only_new)}")
     for name in sorted(set(ocfg) & set(ncfg)):
         o, n = ocfg[name], ncfg[name]
+        cfg_threshold = (
+            threshold * 2 if name in LATENCY_CONFIGS else threshold
+        )
         for phase in phases:
             op = float(o.get("phases", {}).get(phase, 0.0))
             np_ = float(n.get("phases", {}).get(phase, 0.0))
             if op < floor or np_ == 0.0 and op == 0.0:
                 continue
             d = _pct(op, np_)
-            mark = " <-- REGRESSION" if d > threshold else ""
+            mark = " <-- REGRESSION" if d > cfg_threshold else ""
             lines.append(
                 f"{name:>24} {phase:>8}: {op * 1e3:8.1f}ms -> "
                 f"{np_ * 1e3:8.1f}ms ({d:+.1%}){mark}"
             )
-            if d > threshold:
+            if d > cfg_threshold:
                 regressions.append(
                     f"{name} {phase} phase regressed {d:+.1%} "
-                    f"({op:.3f}s -> {np_:.3f}s, threshold {threshold:.0%})"
+                    f"({op:.3f}s -> {np_:.3f}s, threshold "
+                    f"{cfg_threshold:.0%})"
                 )
         ow, nw = float(o.get("wall_seconds", 0.0)), float(
             n.get("wall_seconds", 0.0)
         )
-        if ow >= floor:
+        if ow >= floor and name not in LATENCY_CONFIGS:
             d = _pct(ow, nw)
-            mark = " <-- REGRESSION" if d > threshold else ""
+            fatal = d > threshold and (nw - ow) >= wall_floor
+            mark = " <-- REGRESSION" if fatal else (
+                " (growth below wall floor, not gated)"
+                if d > threshold else ""
+            )
             lines.append(
                 f"{name:>24}     wall: {ow * 1e3:8.1f}ms -> "
                 f"{nw * 1e3:8.1f}ms ({d:+.1%}){mark}"
             )
-            if d > threshold:
+            if fatal:
                 regressions.append(
                     f"{name} wall regressed {d:+.1%} "
-                    f"({ow:.3f}s -> {nw:.3f}s, threshold {threshold:.0%})"
+                    f"({ow:.3f}s -> {nw:.3f}s, threshold {threshold:.0%} "
+                    f"and +{wall_floor * 1e3:.0f}ms)"
                 )
     oh, nh = old["payload"].get("headline"), new["payload"].get("headline")
     if (

@@ -133,42 +133,25 @@ def export_solver(outdir: str, buckets=None) -> list:
 
 
 def export_ranked_solver(outdir: str, buckets=None) -> list:
-    """Export the PRODUCTION path: solve fused with the on-device top-R
-    ranking (solver/batch.py routes every round through this), at the
-    accelerator rank cap so the pinned TPU program is the one a healthy
-    tunnel would run."""
-    import jax
-
-    from nhd_tpu.solver.device_state import _ARG_ORDER
-    from nhd_tpu.solver.kernel import _get_ranker, get_solver, rank_cap
+    """Export the PRODUCTION path: the fused solve+rank megaround program
+    (kernel.get_ranked_solver — solver/batch.py routes every round
+    through this exact jitted function), at the accelerator rank cap so
+    the pinned TPU program is the one a healthy tunnel would run."""
+    from nhd_tpu.solver.kernel import get_ranked_solver, rank_cap
 
     register_solveout_serialization()
     os.makedirs(outdir, exist_ok=True)
-    # free-array positions derived from the single argument-order contract
-    i_hp = _ARG_ORDER.index("hp_free")
-    i_cpu = _ARG_ORDER.index("cpu_free")
-    i_gpu = _ARG_ORDER.index("gpu_free")
     metas = []
     R = rank_cap(accelerator=True)
     for args, meta in (buckets or build_headline_buckets()):
         b = meta["bucket"]
-        solver = get_solver(b["G"], b["U"], b["K"])
-        ranker = _get_ranker(R)
-
-        def fused(*a):
-            out = solver(*a)
-            return ranker(
-                out.cand, out.pref, out.best_c, out.best_m, out.best_a,
-                out.n_picks, a[i_gpu], a[i_cpu], a[i_hp],
-            )
-
+        fused = get_ranked_solver(b["G"], b["U"], b["K"], R)
         name = (
             f"solver_ranked_g{b['G']}_u{b['U']}_k{b['K']}"
             f"_t{meta['shape']['Tp']}_n{meta['shape']['Np']}_r{R}"
         )
         metas.append(_write_artifact(
-            # one-shot export: each bucket's program compiles exactly once
-            outdir, name, jax.jit(fused), args, meta,  # nhdlint: ignore[NHD104]
+            outdir, name, fused, args, meta,
             extra_meta={"rank_width": R},
         ))
     return metas
