@@ -179,6 +179,12 @@ RULES: Dict[str, Tuple[str, str]] = {
                "module: each pull pays a full relay flush — batch with "
                "copy_to_host_async and pull at the round's one sanctioned "
                "flush point (intentional sites suppressed inline)"),
+    "NHD108": ("tracing",
+               "full encode_cluster() call on a per-event/per-round hot "
+               "path in solver/scheduler code outside the sanctioned "
+               "rebuild chokepoint (ClusterDelta._rebuild/make_context): "
+               "steady paths must get-or-apply row deltas through the "
+               "incremental cluster state"),
     "NHD201": ("locks",
                "write to lock-guarded attribute outside 'with <lock>:' in a "
                "class that owns a threading.Lock/RLock"),

@@ -588,6 +588,66 @@ def _check_host_sync(tree: ast.Module, path: str) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# NHD108 — full cluster re-encode on a per-event / per-round hot path
+# ---------------------------------------------------------------------------
+#
+# encode_cluster() re-projects EVERY node (O(N) host work); the
+# incremental state layer (solver/encode.py ClusterDelta) exists so that
+# per-event and per-round paths pay O(changed rows) instead — full
+# rebuilds are fallback events that belong to the sanctioned chokepoints.
+# Inside nhd_tpu/solver/ and nhd_tpu/scheduler/ any other call flags;
+# deliberate one-shot batch sites carry inline suppressions (same
+# contract shape as NHD107's sanctioned flush points).
+
+_ENCODE_SCOPE_PARTS = ("solver", "scheduler")
+#: enclosing functions allowed to issue the full re-encode: the delta
+#: layer's rebuild chokepoint, its parity checker, and the one-shot
+#: context builder
+_ENCODE_SANCTIONED = {"_rebuild", "rebuild", "make_context", "parity_errors"}
+
+
+def _check_encode_calls(tree: ast.Module, path: str) -> List[Finding]:
+    parts = path.replace("\\", "/").split("/")
+    if not any(p in parts for p in _ENCODE_SCOPE_PARTS):
+        return []
+    if parts[-1] == "encode.py":
+        return []  # the chokepoint module itself defines the rebuild
+    findings: List[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self._stack: List[str] = []
+
+        def _visit_func(self, node) -> None:
+            self._stack.append(node.name)
+            self.generic_visit(node)
+            self._stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+        def visit_Call(self, node: ast.Call) -> None:
+            d = _dotted(node.func) or ""
+            if d == "encode_cluster" or d.endswith(".encode_cluster"):
+                fn = self._stack[-1] if self._stack else "<module>"
+                if fn not in _ENCODE_SANCTIONED:
+                    findings.append(Finding(
+                        "NHD108", path, node.lineno, node.col_offset,
+                        f"full encode_cluster() in '{fn}' re-projects "
+                        "every node (O(N) host work) on a per-event/"
+                        "per-round path: get-or-apply row deltas through "
+                        "the incremental state (solver/encode.py "
+                        "ClusterDelta + refresh_context) instead — full "
+                        "rebuilds belong to the sanctioned chokepoints; "
+                        "suppress deliberate one-shot batch sites inline",
+                    ))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return findings
+
+
 def check_module(tree: ast.Module, src: str, path: str) -> List[Finding]:
     jit_names = _collect_jit_aliases(tree)
     index = _FunctionIndex(jit_names)
@@ -613,4 +673,5 @@ def check_module(tree: ast.Module, src: str, path: str) -> List[Finding]:
         _check_jit_construction(tree, jit_names, path, index.functions)
     )
     findings.extend(_check_host_sync(tree, path))
+    findings.extend(_check_encode_calls(tree, path))
     return findings

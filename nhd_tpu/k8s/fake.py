@@ -123,14 +123,35 @@ class FakeClusterBackend(ClusterBackend):
             }
 
     def add_node(self, name: str, labels: Dict[str, str], *,
-                 hugepages_gb: int = 64, addr: str = "") -> FakeNode:
+                 hugepages_gb: int = 64, addr: str = "",
+                 emit_watch: bool = False) -> FakeNode:
         with self._lock:
             node = FakeNode(
                 name=name, labels=dict(labels), addr=addr or f"10.0.1.{len(self.nodes) + 1}",
                 hugepages_capacity_gb=hugepages_gb, hugepages_allocatable_gb=hugepages_gb,
             )
             self.nodes[name] = node
+            if emit_watch:
+                # live node arrival (cluster scale-up): the controller
+                # translates this into WatchType.NODE_ADD and the
+                # scheduler folds the node in without a restart
+                self._emit_watch(
+                    WatchEvent(kind="node_add", name=name,
+                               labels=dict(node.labels))
+                )
             return node
+
+    def remove_node(self, name: str, *, emit_watch: bool = True) -> None:
+        """Drop a node from the inventory (decommission/scale-down).
+        Emits a ``node_delete`` watch event so the scheduler can retire
+        the mirror entry (and its packed row) without a restart."""
+        with self._lock:
+            node = self.nodes.pop(name, None)
+            if node is not None and emit_watch:
+                self._emit_watch(
+                    WatchEvent(kind="node_delete", name=name,
+                               labels=dict(node.labels))
+                )
 
     def create_pod(
         self,

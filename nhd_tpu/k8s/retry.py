@@ -97,6 +97,27 @@ class ApiCounters:
              "Scheduler run-loop passes isolated (mirror rebuilt after)"),
         "bind_requeues_total":
             ("counter", "Pods requeued after a transient commit failure"),
+        # incremental device-resident cluster state (solver/encode.py
+        # ClusterDelta + solver/device_state.py row scatters,
+        # docs/PERFORMANCE.md "Incremental device-resident state"). The
+        # labeled complement nhd_device_state_rebuilds_total{reason=...}
+        # is rendered from encode.rebuild_reasons_snapshot() in
+        # rpc/metrics.py (bounded reason vocabulary, NHD603).
+        "device_state_events_total":
+            ("counter", "Watch/claim events folded into the incremental "
+                        "cluster state as deltas"),
+        "device_state_deltas_total":
+            ("counter", "Row patches applied to the host-resident packed "
+                        "cluster arrays"),
+        "device_state_rows_uploaded_total":
+            ("counter", "Node rows scattered/uploaded to the "
+                        "device-resident arrays"),
+        "device_state_full_rebuilds_total":
+            ("counter", "Incremental-state fallbacks to a full "
+                        "encode_cluster rebuild"),
+        "device_state_resident_age_seconds":
+            ("gauge", "Seconds since the resident cluster state was "
+                      "last fully rebuilt"),
         # HA plane (k8s/lease.py, docs/RESILIENCE.md "HA & fencing").
         # Under the sharded federation the single-leader gauges
         # generalize: ha_is_leader means "holds at least one shard" and

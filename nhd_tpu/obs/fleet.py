@@ -291,6 +291,10 @@ def build_fleet_payload(
             "shard_handoffs_total",
             "shard_spillover_claims_total",
             "shard_spillover_exhausted_total",
+            "device_state_events_total",
+            "device_state_deltas_total",
+            "device_state_rows_uploaded_total",
+            "device_state_full_rebuilds_total",
         ):
             total, seen = 0.0, False
             for v in views:
@@ -317,6 +321,20 @@ def build_fleet_payload(
         "claims_total": counters.get("shard_spillover_claims_total", 0),
         "exhausted_total": counters.get(
             "shard_spillover_exhausted_total", 0
+        ),
+    }
+
+    # incremental device-resident cluster state: the fleet-wide delta
+    # economy (how much host/upload work the event stream actually cost
+    # vs how often state fell back to a full rebuild)
+    device_state = {
+        "events_total": counters.get("device_state_events_total", 0),
+        "deltas_total": counters.get("device_state_deltas_total", 0),
+        "rows_uploaded_total": counters.get(
+            "device_state_rows_uploaded_total", 0
+        ),
+        "full_rebuilds_total": counters.get(
+            "device_state_full_rebuilds_total", 0
         ),
     }
 
@@ -347,6 +365,7 @@ def build_fleet_payload(
         "spillover": spillover,
         "slo": slo_summary,
         "fencing": fencing,
+        "device_state": device_state,
         "leadership": lead,
         "violations": list(violations or []),
         "journeys": {

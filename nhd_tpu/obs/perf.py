@@ -61,10 +61,13 @@ def config_record(
     rounds: int = 0,
     phases: Optional[Dict[str, float]] = None,
     p99_bind_ms: Optional[float] = None,
+    extra: Optional[dict] = None,
 ) -> dict:
     """One config's result in the canonical shape (bench.py builds these;
-    the legacy upgrader synthesizes the same shape from log lines)."""
-    return {
+    the legacy upgrader synthesizes the same shape from log lines).
+    ``extra``: additional named sections (e.g. the sustained-churn leg's
+    ``churn`` figures, gated by tools/bench_diff.py)."""
+    rec = {
         "wall_seconds": wall_seconds,
         "placed": placed,
         "pods_per_sec": (placed / wall_seconds) if wall_seconds > 0 else 0.0,
@@ -73,6 +76,9 @@ def config_record(
         "phases": dict(phases or {}),
         "p99_bind_ms": p99_bind_ms,
     }
+    for key, value in (extra or {}).items():
+        rec[key] = value
+    return rec
 
 
 def build_bench_artifact(

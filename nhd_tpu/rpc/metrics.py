@@ -102,6 +102,23 @@ def render_metrics(
         for shard, epoch in sorted(shard_status["owned"].items()):
             lines.append(f'nhd_shard_epoch{{shard="{shard}"}} {epoch}')
 
+    # incremental cluster state: full-rebuild fallbacks by reason
+    # (solver/encode.py ClusterDelta; the vocabulary is bounded —
+    # encode.REBUILD_REASONS — so the label cardinality is too)
+    from nhd_tpu.solver.encode import rebuild_reasons_snapshot
+
+    reasons = rebuild_reasons_snapshot()
+    if reasons:
+        lines += [
+            "# HELP nhd_device_state_rebuilds_total Incremental-state "
+            "full rebuilds by fallback reason",
+            "# TYPE nhd_device_state_rebuilds_total counter",
+        ]
+        for reason, n in sorted(reasons.items()):
+            lines.append(
+                f'nhd_device_state_rebuilds_total{{reason="{reason}"}} {n}'
+            )
+
     # latency distributions (obs/histo.py) — the last_* gauge replacement
     lines += render_histograms()
 

@@ -220,6 +220,10 @@ class Controller(threading.Thread):
             self.handle_node_update(ev)
         elif ev.kind in ("pod_create", "pod_delete"):
             self.handle_pod_event(ev)
+        elif ev.kind == "node_add":
+            self.queue.put(WatchItem(WatchType.NODE_ADD, node=ev.name))
+        elif ev.kind == "node_delete":
+            self.queue.put(WatchItem(WatchType.NODE_REMOVE, node=ev.name))
 
     def run_once(
         self, now: Optional[float] = None, timeout: float = 0.0

@@ -101,6 +101,15 @@ if STREAM_PLACEMENT not in ("first-fit", "routed"):
 # (API-server round trips dominate gang bind latency on real clusters)
 COMMIT_WORKERS = int(os.environ.get("NHD_COMMIT_WORKERS", "1"))
 
+# incremental device-resident cluster state (solver/encode.py
+# ClusterDelta, docs/PERFORMANCE.md "Incremental device-resident
+# state"): the scheduler keeps ONE packed encode + FastCluster +
+# device-resident context alive across batches and folds watch/claim
+# events in as row deltas — a steady round pays host encode + upload
+# proportional to changed rows, not cluster size. NHD_DELTA_STATE=0
+# restores the per-batch full re-encode.
+DELTA_STATE = os.environ.get("NHD_DELTA_STATE", "1") == "1"
+
 # a transiently-failing commit (TransientBackendError: the backend's retry
 # budget spent on a 429/5xx/network fault) requeues the pod instead of
 # marking it failed — but only this many times in a row, so a persistent
@@ -292,6 +301,12 @@ class Scheduler(threading.Thread):
         self.failed_schedule_count = 0
         self.batch = BatchScheduler(respect_busy=respect_busy)
         self._stream = None   # built lazily past STREAM_NODE_THRESH
+        # incremental cluster state (NHD_DELTA_STATE): the ClusterDelta
+        # over self.nodes plus its delta-built ScheduleContext, reused
+        # across batches; None until the first batch (and after
+        # restart-grade events invalidate it)
+        self._delta = None
+        self._delta_ctx = None
         # vanished-pod suspects from the previous reconcile scan
         # (reconcile_deleted_pods two-scan release rule)
         self._missing_once: set = set()
@@ -323,25 +338,98 @@ class Scheduler(threading.Thread):
     # startup / node inventory
     # ------------------------------------------------------------------
 
+    def _init_node(self, name: str) -> HostNode:
+        """Discover one node: labels, address, hugepages (reference:
+        NHDScheduler.py:61-105). Shared by the startup inventory build
+        and the live NODE_ADD event path."""
+        node = HostNode(name, self.backend.is_node_active(name))
+        self.nodes[name] = node
+        try:
+            node.addr = self.backend.get_node_addr(name)
+            if not node.parse_labels(self.backend.get_node_labels(name)):
+                self.logger.error(f"label parse failed for {name}; deactivating")
+                node.active = False
+                return node
+            alloc, free = self.backend.get_node_hugepage_resources(name)
+            if alloc == 0 or not node.set_hugepages(alloc, free):
+                self.logger.error(f"no hugepages on {name}; deactivating")
+                node.active = False
+        except Exception as exc:
+            self.logger.error(f"node setup failed for {name}: {exc}")
+            node.active = False
+        return node
+
     def build_initial_node_list(self) -> None:
         """Discover nodes, parse labels, read hugepages
         (reference: NHDScheduler.py:61-105)."""
         for name in self.backend.get_nodes():
-            node = HostNode(name, self.backend.is_node_active(name))
-            self.nodes[name] = node
-            try:
-                node.addr = self.backend.get_node_addr(name)
-                if not node.parse_labels(self.backend.get_node_labels(name)):
-                    self.logger.error(f"label parse failed for {name}; deactivating")
-                    node.active = False
-                    continue
-                alloc, free = self.backend.get_node_hugepage_resources(name)
-                if alloc == 0 or not node.set_hugepages(alloc, free):
-                    self.logger.error(f"no hugepages on {name}; deactivating")
-                    node.active = False
-            except Exception as exc:
-                self.logger.error(f"node setup failed for {name}: {exc}")
-                node.active = False
+            self._init_node(name)
+
+    # ------------------------------------------------------------------
+    # incremental cluster state (solver/encode.py ClusterDelta)
+    # ------------------------------------------------------------------
+
+    def _note_node(self, name: Optional[str]) -> None:
+        """Tell the incremental cluster state an event touched *name*:
+        the next batch folds it in as a row patch (and a device row
+        scatter) instead of paying a full re-encode. Every mirror
+        mutation site calls this; a missed site is caught by the
+        delta's continuous parity check (chaos wires it as a sim
+        invariant)."""
+        if not name:
+            return
+        if self._delta is not None:
+            self._delta.note(name)
+        if self._stream is not None:
+            self._stream.note_nodes((name,))
+
+    def _invalidate_delta(self) -> None:
+        """Drop the incremental context entirely — for restart-grade
+        events (promotion replay, mirror rebuild after an isolated loop
+        failure) that replace node OBJECTS wholesale: row patches have
+        nothing stable to patch, so the next batch re-derives from the
+        fresh mirror."""
+        self._delta = None
+        self._delta_ctx = None
+        if self._stream is not None:
+            self._stream.reset_state()
+
+    def _delta_context(self, nodes_view: Dict[str, HostNode]):
+        """The delta-built ScheduleContext for this batch, or None when
+        the incremental path does not apply (disabled; a federation node
+        slice, whose membership is leadership-dependent). Never fails
+        the batch: any maintenance error degrades to the contextless
+        full re-encode."""
+        if (
+            not DELTA_STATE
+            or self.sharded is not None
+            or nodes_view is not self.nodes
+            or not nodes_view
+        ):
+            return None
+        from nhd_tpu.solver.encode import ClusterDelta
+
+        try:
+            if self._delta is None or self._delta.nodes is not nodes_view:
+                self._delta = ClusterDelta(
+                    nodes_view, respect_busy=self.batch.respect_busy
+                )
+                self._delta_ctx = self.batch.make_context(
+                    nodes_view, delta=self._delta
+                )
+            else:
+                self.batch.refresh_context(self._delta_ctx)
+        except Exception:
+            # the incremental state is an optimization; failing to
+            # maintain it must cost this batch a full encode, never the
+            # batch itself
+            self.logger.exception(
+                "delta context refresh failed; dropping incremental state"
+            )
+            self._delta = None
+            self._delta_ctx = None
+            return None
+        return self._delta_ctx
 
     # ------------------------------------------------------------------
     # claim / release (restart replay)
@@ -384,6 +472,7 @@ class Scheduler(threading.Thread):
         if not node.claim_from_topology(top):
             return
         node.add_scheduled_pod(pod, ns, top)
+        self._note_node(node_name)
         self.pod_state[(ns, pod)] = {
             "state": PodStatus.SCHEDULED, "time": time.time(), "uid": uid
         }
@@ -401,6 +490,15 @@ class Scheduler(threading.Thread):
             node.reset_resources()
         self.pod_state.clear()
         self.load_deployed_configs()
+        if self._delta is not None:
+            # every row changed: one sanctioned full rebuild beats N
+            # row patches (the node OBJECTS survived, so the delta's
+            # view stays structurally valid)
+            self._delta.rebuild("manual")
+        if self._stream is not None:
+            # the streaming tiler's persistent per-tile contexts have no
+            # note trail for a wholesale claim rebuild — drop them
+            self._stream.reset_state()
 
     def release_pod_resources(
         self,
@@ -443,6 +541,7 @@ class Scheduler(threading.Thread):
         node.release_from_topology(top)
         node.remove_scheduled_pod(pod, ns)
         node.set_busy()
+        self._note_node(node_name)
 
     # ------------------------------------------------------------------
     # scheduling
@@ -635,6 +734,7 @@ class Scheduler(threading.Thread):
         # commits onto them are fenceable; everything else is another
         # replica's control plane
         nodes_view = self._solve_nodes()
+        batch_items = [item for _, item in prepared]
         if len(nodes_view) > STREAM_NODE_THRESH:
             from nhd_tpu.solver.streaming import StreamingScheduler
 
@@ -644,13 +744,22 @@ class Scheduler(threading.Thread):
                     chunk_pods=STREAM_CHUNK_PODS,
                     placement=STREAM_PLACEMENT,
                     respect_busy=self.batch.respect_busy,
+                    persistent=DELTA_STATE,
                 )
-            solver = self._stream
+            results, bstats = self._stream.schedule(nodes_view, batch_items)
         else:
-            solver = self.batch
-        results, bstats = solver.schedule(
-            nodes_view, [item for _, item in prepared]
-        )
+            context = self._delta_context(nodes_view)
+            if context is not None:
+                # incremental path: the persistent context absorbed this
+                # inter-batch churn as row deltas; solve over its
+                # row-aligned view (live dict order + tombstone slots)
+                results, bstats = self.batch.schedule(
+                    context.nodes, batch_items, context=context
+                )
+            else:
+                results, bstats = self.batch.schedule(
+                    nodes_view, batch_items
+                )
         self._beat()   # one solve finished: loop progress, not a wedge
         self.perf["batches_total"] += 1
         self.perf["solve_seconds_total"] += bstats.solve_seconds
@@ -1292,6 +1401,7 @@ class Scheduler(threading.Thread):
             node.release_from_topology(item.topology)
         node.remove_scheduled_pod(pod, ns)
         node.set_busy()
+        self._note_node(node.name)
 
     # ------------------------------------------------------------------
     # reconciliation
@@ -1383,6 +1493,7 @@ class Scheduler(threading.Thread):
                 top = node.pod_info[(pod, ns)]
                 node.release_from_topology(top)
                 node.remove_scheduled_pod(pod, ns)
+                self._note_node(node.name)
                 self.pod_state.pop(key, None)
         # rebuilt every scan: a pod that reappears in a later listing
         # drops back out of the suspect set
@@ -1535,21 +1646,41 @@ class Scheduler(threading.Thread):
             node = self.nodes.get(item.node)
             if node is not None:
                 node.active = item.type == WatchType.NODE_UNCORDON
+                self._note_node(item.node)
 
         elif item.type == WatchType.NODE_MAINT_START:
             node = self.nodes.get(item.node)
             if node is not None:
                 node.maintenance = True
+                self._note_node(item.node)
 
         elif item.type == WatchType.NODE_MAINT_END:
             node = self.nodes.get(item.node)
             if node is not None:
                 node.maintenance = False
+                self._note_node(item.node)
 
         elif item.type == WatchType.GROUP_UPDATE:
             node = self.nodes.get(item.node)
             if node is not None:
                 node.set_groups(item.groups)
+                self._note_node(item.node)
+
+        elif item.type == WatchType.NODE_ADD:
+            # live scale-up: fold the node into the mirror (and, as a
+            # padded-slot row append, into the incremental state) —
+            # the reference only discovers nodes at restart
+            if item.node and item.node not in self.nodes:
+                self._init_node(item.node)
+                self._note_node(item.node)
+
+        elif item.type == WatchType.NODE_REMOVE:
+            # decommission: drop the mirror entry; the incremental state
+            # tombstones its row in place (compaction reclaims it). Any
+            # pods the mirror still holds on it are released by the
+            # periodic reconcile net as their deletes surface.
+            if item.node and self.nodes.pop(item.node, None) is not None:
+                self._note_node(item.node)
 
     # ------------------------------------------------------------------
     # main loop
@@ -1646,6 +1777,7 @@ class Scheduler(threading.Thread):
         # replay can outlast the watchdog's whole-turn budget, and a
         # crash mid-promotion would hand the NEXT replica the same wall
         self.nodes.clear()
+        self._invalidate_delta()  # node objects replaced wholesale
         self.build_initial_node_list()
         self._beat()
         self.pod_state.clear()
@@ -1730,6 +1862,7 @@ class Scheduler(threading.Thread):
                 else:
                     merged[name] = node
             self.nodes = merged
+            self._invalidate_delta()  # the mirror dict was replaced
             self._missing_once.clear()
             for pod, ns, uid, phase in self.backend.get_scheduled_pods(
                 self.sched_name

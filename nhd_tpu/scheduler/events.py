@@ -27,6 +27,13 @@ class WatchType(Enum):
     NODE_MAINT_END = auto()
     GROUP_UPDATE = auto()
     TRIADSET_UPDATE = auto()
+    # structural node inventory changes (rebuild addition: the reference
+    # only rebuilds its node list at restart). The scheduler folds these
+    # into its mirror — and into the incremental cluster state
+    # (solver/encode.py ClusterDelta) as padded-slot adds / in-place
+    # tombstones — without a restart.
+    NODE_ADD = auto()
+    NODE_REMOVE = auto()
 
 
 @dataclass

@@ -98,6 +98,13 @@ class ChaosStats:
     restarts: int = 0
     group_moves: int = 0
     silent_deletes: int = 0
+    # structural node churn (NODE_ADD/NODE_REMOVE through the live watch
+    # path — the incremental cluster state absorbs these as padded-slot
+    # rows / tombstones, or falls back to a logged rebuild)
+    node_flaps: int = 0
+    # incremental-state rebuilds observed across the run (the
+    # delta/rebuild invariant: faults may COST rebuilds, never parity)
+    delta_rebuilds: int = 0
     # HA mode: lease epoch high-water mark (== total acquisitions) and
     # the longest stretch of steps with no replica believing it leads
     lease_epoch: int = 0
@@ -348,6 +355,11 @@ class ChaosSim:
             )
         self.stats = ChaosStats()
         self._pod_seq = 0
+        self._node_seq = 0
+        # structural node churn rides its OWN seeded stream so adding it
+        # (PR 9) left every existing seed's action sequence — and the
+        # regressions pinned against them — bit-identical
+        self._flap_rng = random.Random(seed + 104729)
         self._leader_gap = 0
         if self.federation:
             self._peers = [f"fed-{chr(ord('a') + i)}" for i in range(n_replicas)]
@@ -738,6 +750,38 @@ class ChaosSim:
             self._check_restart_equivalence(pre_claims, pre_snap, self.sched)
         self.stats.restarts += 1
 
+    def _act_node_flap(self) -> None:
+        """Structural churn (solo mode): add a fresh node, or
+        decommission one, through the live NODE_ADD/NODE_REMOVE watch
+        path. The incremental cluster state absorbs adds as padded-slot
+        row appends and removals as in-place tombstones — or falls back
+        to a logged rebuild (capacity/compaction/re-add) — and the
+        parity invariant vets the result either way. Removal only fires
+        when nothing is pending and the victim holds no bound pods, so
+        a pod can never race a vanishing node mid-step (a real cluster
+        hazard, but not the invariant under test here)."""
+        rng = self._flap_rng
+        bound_nodes = {p.node for p in self.backend.pods.values() if p.node}
+        pending = any(p.node is None for p in self.backend.pods.values())
+        removable = [
+            n for n in self.backend.nodes
+            if n not in bound_nodes and not n.startswith("node")
+        ]
+        if (
+            removable and not pending
+            and len(self.backend.nodes) > 2
+            and rng.random() < 0.5
+        ):
+            self.backend.remove_node(rng.choice(removable))
+        else:
+            self._node_seq += 1
+            spec = SynthNodeSpec(name=f"flap{self._node_seq}")
+            self.backend.add_node(
+                spec.name, make_node_labels(spec),
+                hugepages_gb=spec.hugepages_gb, emit_watch=True,
+            )
+        self.stats.node_flaps += 1
+
     def _act_kill_wave(self) -> None:
         """Federation-only: take 1..N-1 replicas down simultaneously for
         a couple of steps — their shards must expire, rebalance onto the
@@ -801,6 +845,12 @@ class ChaosSim:
             weights.append(4)
         action = self.rng.choices(actions, weights=weights)[0]
         action()
+        if not self.federation and not self.ha and (
+            self._flap_rng.random() < 0.08
+        ):
+            # solo mode drives the incremental-state path: structural
+            # node churn exercises its padded-slot/tombstone machinery
+            self._act_node_flap()
         self._drive_control_plane()
         # clear one-shot bind failures so pods eventually land
         self.backend.fail_bind_for.clear()
@@ -973,6 +1023,41 @@ class ChaosSim:
                     f"step {self.stats.steps}: {name} leaked {used} cores "
                     f"with no pods"
                 )
+
+        # the delta/rebuild invariant (ISSUE 9): whatever this step's
+        # faults cost — a dropped event, a poisoned one, a forced full
+        # rebuild — the incremental cluster state must remain bit-exact
+        # re-derivable from the live mirror. A fault may buy a rebuild;
+        # it may never buy divergence.
+        delta = getattr(sched, "_delta", None)
+        if delta is not None and only_nodes is None:
+            for err in delta.parity_errors():
+                v.append(
+                    f"step {self.stats.steps}: resident-state parity: {err}"
+                )
+            self.stats.delta_rebuilds = max(
+                self.stats.delta_rebuilds, delta.rebuilds
+            )
+        # streaming path: every persistent tile context carries its own
+        # delta — same invariant, per tile, judged net of the pending
+        # note trail. A membership change condemns the whole state (it
+        # resets at the next schedule), so there is nothing to judge.
+        stream = getattr(sched, "_stream", None)
+        pstate = getattr(stream, "_pstate", None) if stream else None
+        if (
+            pstate is not None
+            and only_nodes is None
+            and pstate["names"] == list(sched.nodes.keys())
+        ):
+            stream.route_notes()
+            for ti, tile_delta in enumerate(pstate["deltas"]):
+                if tile_delta is None:
+                    continue
+                for err in tile_delta.parity_errors():
+                    v.append(
+                        f"step {self.stats.steps}: tile {ti} "
+                        f"resident-state parity: {err}"
+                    )
 
         # backend and mirror agree on placements
         bound = self._backend_bound()

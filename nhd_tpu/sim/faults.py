@@ -105,6 +105,15 @@ PROFILES: Dict[str, FaultProfile] = {
         transient_bind=0.15, transient_annotate=0.10,
         poison_watch_event=0.05,
     ),
+    # incremental-state churn storm: heavy event loss/poisoning plus
+    # transient commits, aimed at the delta/rebuild invariant — a
+    # dropped or poisoned event may cost the incremental cluster state
+    # a full rebuild, but NEVER a divergent resident state (ChaosSim
+    # wires ClusterDelta.parity_errors as a per-step invariant)
+    "churn": FaultProfile(
+        name="churn", drop_watch_event=0.25, poison_watch_event=0.20,
+        transient_bind=0.15, transient_annotate=0.10,
+    ),
     # federation storms (ChaosSim federation=S, `make fed-chaos`): the
     # ha-* fault surface PLUS asymmetric partitions; kill/restart waves
     # are a chaos ACTION in federation mode, not a profile probability

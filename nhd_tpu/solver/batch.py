@@ -44,7 +44,12 @@ from nhd_tpu.core.node import AssignmentError, HostNode
 from nhd_tpu.core.request import PodRequest
 from nhd_tpu.core.topology import MapMode, NicDir, PodTopology
 from nhd_tpu.solver.device_state import DeviceClusterState
-from nhd_tpu.solver.encode import encode_cluster, encode_pods, refresh_node_row
+from nhd_tpu.solver.encode import (
+    ClusterDelta,
+    encode_cluster,
+    encode_pods,
+    refresh_node_row,
+)
 from nhd_tpu.solver.kernel import bucket_tractable
 from nhd_tpu.solver.oracle import find_node as oracle_find_node
 from nhd_tpu.solver.fast_assign import (
@@ -113,6 +118,15 @@ class ScheduleContext:
     (solver/streaming.py) pays O(claimed rows), not O(tile), per chunk.
     The HostNode mirror stays in sync (FastCluster.sync_to_nodes is
     incremental over touched nodes).
+
+    With a ``delta`` (solver/encode.py ClusterDelta) the context also
+    survives CHURN between calls: watch events noted on the delta fold
+    into the packed arrays as row patches at the next refresh_context,
+    FastCluster rows re-read, and the device-resident arrays take the
+    same rows as one donated scatter — a steady round pays host encode
+    + upload proportional to changed rows, not cluster size. ``nodes``
+    is then the delta's row-aligned VIEW (live dict order plus in-place
+    tombstones), not the live dict itself.
     """
 
     nodes: Dict[str, "HostNode"]
@@ -120,6 +134,7 @@ class ScheduleContext:
     fast: Optional["FastCluster"]
     dev: Optional["DeviceClusterState"]
     now: float
+    delta: Optional["ClusterDelta"] = None
 
 
 _FC_EXECUTOR = None
@@ -688,7 +703,7 @@ class BatchScheduler:
 
     def make_context(
         self, nodes: Dict[str, HostNode], *, now: Optional[float] = None,
-        interner=None,
+        interner=None, delta: Optional[ClusterDelta] = None,
     ) -> ScheduleContext:
         """Encode *nodes* once into a reusable ScheduleContext.
 
@@ -700,12 +715,30 @@ class BatchScheduler:
         several contexts so pod encodes (group_mask bit positions) are
         valid against every one of them — the streaming tiler passes its
         batch-wide interner here.
+
+        ``delta``: build the context over an incrementally-maintained
+        ClusterDelta instead of a fresh encode — the context then
+        survives churn between calls (refresh_context folds noted events
+        in as row patches). The delta must have been created over
+        *nodes*; the context's ``nodes`` becomes the delta's row-aligned
+        view.
         """
         if now is None:
             now = time.monotonic()
-        cluster = encode_cluster(nodes, now=now, interner=interner)
-        if not self.respect_busy:
-            cluster.busy[:] = False
+        if delta is not None:
+            if delta.nodes is not nodes:
+                raise ValueError(
+                    "delta was built over a different nodes dict"
+                )
+            delta.refresh(now)
+            delta.consume_full()
+            delta.drain_dirty()  # fresh fast/dev below derive from arrays
+            cluster = delta.arrays
+            nodes = delta.view
+        else:
+            cluster = encode_cluster(nodes, now=now, interner=interner)
+            if not self.respect_busy:
+                cluster.busy[:] = False
         fast = (
             FastCluster(nodes, cluster.U, cluster.K, arrays=cluster,
                         static_cache=self._fc_static)
@@ -720,8 +753,67 @@ class BatchScheduler:
                 and (_accelerator_backend() or mesh is not None)
             )
         )
-        dev = DeviceClusterState(cluster, mesh) if use_dev else None
-        return ScheduleContext(nodes, cluster, fast, dev, now)
+        dev = (
+            DeviceClusterState(
+                cluster, mesh,
+                capacity=delta.capacity if delta is not None else None,
+            )
+            if use_dev else None
+        )
+        return ScheduleContext(nodes, cluster, fast, dev, now, delta)
+
+    def refresh_context(
+        self, ctx: ScheduleContext, *, now: Optional[float] = None,
+    ) -> ScheduleContext:
+        """Bring a delta-built ScheduleContext current between batches:
+        busy decay plus every noted event fold into the packed arrays as
+        row patches, the same rows re-read into FastCluster and scatter
+        into the device-resident arrays — O(changed rows) end to end.
+        A fallback rebuild inside the delta (new group bit, padding or
+        capacity overflow, compaction...) re-derives FastCluster and the
+        resident device state wholesale; the ClusterArrays object (and
+        the view dict) keep their identity, so the context stays valid
+        either way."""
+        delta = ctx.delta
+        if delta is None:
+            raise ValueError("refresh_context needs a delta-built context")
+        if now is None:
+            now = time.monotonic()
+        delta.refresh(now)
+        ctx.now = now
+        if delta.consume_full():
+            delta.drain_dirty()
+            ctx.fast = (
+                FastCluster(
+                    ctx.nodes, ctx.cluster.U, ctx.cluster.K,
+                    arrays=ctx.cluster, static_cache=self._fc_static,
+                )
+                if self.use_fast else None
+            )
+            if ctx.dev is not None:
+                ctx.dev = DeviceClusterState(
+                    ctx.cluster, ctx.dev.mesh, capacity=delta.capacity
+                )
+            return ctx
+        rows = delta.drain_dirty()
+        if rows.size:
+            if ctx.fast is not None:
+                if len(ctx.fast.names) != delta.n_rows:
+                    # rows appended into padded-capacity slots: the
+                    # packed solver arrays grew in place, FastCluster's
+                    # fixed-N matrices cannot — rebuild it
+                    ctx.fast = FastCluster(
+                        ctx.nodes, ctx.cluster.U, ctx.cluster.K,
+                        arrays=ctx.cluster, static_cache=self._fc_static,
+                    )
+                else:
+                    for i in rows.tolist():
+                        ctx.fast.refresh_node(i)
+            if ctx.dev is not None:
+                ctx.dev.scatter_rows(rows)
+        elif ctx.dev is not None:
+            ctx.dev.scatter_rows(rows)  # still syncs row-count growth
+        return ctx
 
     @_gc_pinned
     def schedule(
@@ -781,7 +873,9 @@ class BatchScheduler:
         node_list = list(nodes.values())
         cluster = (
             context.cluster if context is not None
-            else encode_cluster(nodes, now=now)
+            # contextless one-shot batch (bench/tests): the production
+            # round paths reuse a delta-built context instead
+            else encode_cluster(nodes, now=now)  # nhdlint: ignore[NHD108]
         )
         if context is None and not self.respect_busy:
             cluster.busy[:] = False
@@ -815,9 +909,10 @@ class BatchScheduler:
         pending = np.asarray(pending_l, np.int64)
         del pending_l
         stats.phase_add("prepass", time.perf_counter() - t_pre)
-        if oversized and context is not None:
+        if oversized and context is not None and context.delta is None:
             # serial claims would mutate the HostNode mirror behind the
-            # context's packed arrays
+            # context's packed arrays (a delta-built context absorbs them
+            # as row patches below)
             raise ValueError(
                 "combo-oversized pods cannot be scheduled through a "
                 "persistent context; route them to the serial path first"
@@ -832,8 +927,18 @@ class BatchScheduler:
                 nodes, items, oversized, results, stats, now, apply
             )
             pending = pending[~np.isin(pending, oversized)]
-            if apply:  # serial claims mutated the mirror: re-project
-                cluster = encode_cluster(
+            if apply and context is not None:
+                # the serial claims touched O(winners) rows: fold them in
+                # as delta patches + a device row scatter — the
+                # get-or-apply-deltas form of the full re-encode below
+                for i in oversized:
+                    r = results[i]
+                    if r is not None and r.node is not None:
+                        context.delta.note(r.node)
+                self.refresh_context(context, now=now)
+            elif apply:  # serial claims mutated the mirror: re-project
+                # (contextless one-shot batch, not a per-round hot path)
+                cluster = encode_cluster(  # nhdlint: ignore[NHD108]
                     nodes, now=now, interner=cluster.interner
                 )
                 if not self.respect_busy:
@@ -1406,8 +1511,21 @@ class BatchScheduler:
                         )
                         ok_idx = None
                     n_ok = len(w_pod_l) if all_ok else len(ok_idx)
-                    BA = BatchAssignment
+                    # BatchAssignment construction runs once per winner
+                    # (100k/round at federation scale): _make feeds
+                    # tuple.__new__ directly (the generated __new__ is a
+                    # Python frame, ~2x the cost), and the consumed-NIC
+                    # tuples are memoized per (type, per-group NIC row)
+                    # — a round draws them from a handful of distinct
+                    # combos, so the per-pod list build (formerly ~45%
+                    # of the materialize phase, r8 profile) collapses to
+                    # a dict hit. The memoized nic_list is a shared
+                    # immutable TUPLE by design; the record path keeps
+                    # its per-pod list from the assignment record.
+                    BA_make = BatchAssignment._make
                     memo_get = memo.get
+                    nic_memo: Dict[tuple, tuple] = {}
+                    nic_memo_get = nic_memo.get
                     for w, pod_i, n, t, c_, m_, pk, row in winner_iter:
                         item = items[pod_i]
                         # the NIC pick is re-selected against live state
@@ -1423,13 +1541,17 @@ class BatchScheduler:
                             records[pod_i] = rec
                             nic_list = rec.nic_list
                         else:
-                            nic_list = [
-                                (row[g], bw, d) for g, bw, d in nic_tmpl[t]
-                            ]
-                        results[pod_i] = BA(
+                            nk = (t, *row)
+                            nic_list = nic_memo_get(nk)
+                            if nic_list is None:
+                                nic_list = nic_memo[nk] = tuple(
+                                    (row[g], bw, d)
+                                    for g, bw, d in nic_tmpl[t]
+                                )
+                        results[pod_i] = BA_make((
                             item.key, names[n], mapping, nic_list,
-                            round_no,
-                        )
+                            round_no, False,
+                        ))
                     stats.scheduled += n_ok
                 stats.phase_add("materialize", time.perf_counter() - t_mat)
                 stats.assign_seconds += time.perf_counter() - t0

@@ -22,6 +22,8 @@ the jit cache.
 
 from __future__ import annotations
 
+import os
+from functools import lru_cache
 from typing import Dict, Iterable, Optional
 
 import jax
@@ -43,6 +45,45 @@ from nhd_tpu.solver.kernel import (
     pad_nodes,
 )
 
+
+def _pad_own(a: np.ndarray, size: int) -> np.ndarray:
+    """_pad_rows_to, but NEVER aliasing the input: when no padding is
+    needed, _pad_rows returns the host array itself, and the CPU
+    backend's jnp.asarray can be ZERO-COPY — a donated dispatch (the
+    speculative megaround) would then mutate the HOST mirror through
+    the alias, double-applying every claim the native verify applies
+    again (caught by the ClusterDelta parity invariant: the delta
+    layer's capacity == the device padding made rows == Np the norm,
+    where the old per-batch flow only hit it on exact-power-of-two
+    clusters)."""
+    if a.shape[0] == size:
+        return a.copy()
+    return _pad_rows(a, size)
+
+
+def _delta_enabled() -> bool:
+    """Row-scatter delta uploads (default on). NHD_DEVICE_DELTA=0 keeps
+    the wholesale async re-upload instead — the right call on a relay
+    that charges per FLUSH and nothing per byte (docs/TPU_STATUS.md r4),
+    where one stable re-upload program beats scatter-width variants."""
+    return os.environ.get("NHD_DEVICE_DELTA", "1") == "1"
+
+
+@lru_cache(maxsize=None)
+def _get_row_scatter(n_arrays: int, donate: bool):
+    """ONE jitted program scattering W rows into *n_arrays* resident
+    arrays jointly (donated on accelerators — the update is in place in
+    HBM). The index vector is padded to a power-of-two width with the
+    last index repeated (idempotent for row `set`), so churn rounds of
+    different delta sizes reuse ~log2(N) compiled variants instead of
+    one per width."""
+
+    def fn(arrays, idx, rows):
+        return tuple(a.at[idx].set(r) for a, r in zip(arrays, rows))
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(fn, **kwargs)
+
 # _ARG_ORDER/_MUTABLE/_STATIC now live in kernel.py (the single
 # argument-order contract, shared with the fused programs and the AOT
 # layer) and are re-exported here for the speculative megaround and
@@ -58,24 +99,37 @@ class DeviceClusterState:
     everything lives on the default single device.
     """
 
-    def __init__(self, cluster: ClusterArrays, mesh: Optional["jax.sharding.Mesh"] = None):
+    def __init__(
+        self,
+        cluster: ClusterArrays,
+        mesh: Optional["jax.sharding.Mesh"] = None,
+        *,
+        capacity: Optional[int] = None,
+    ):
         self.cluster = cluster
         self.N = cluster.n_nodes
         self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
         n_dev = self.mesh.devices.size if self.mesh else 1
-        self.Np = pad_nodes(self.N, n_dev, floor=8)
+        # ``capacity``: the delta layer's padded row bucket (encode.py
+        # ClusterDelta) — sizing the resident arrays to it means node
+        # adds inside the bucket reach the device as row scatters, never
+        # a reallocation; crossing the bucket rebuilds this object
+        self.Np = pad_nodes(max(self.N, capacity or 0), n_dev, floor=8)
         self._node_sharding = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             self._node_sharding = NamedSharding(self.mesh, P("nodes"))
         self._dev: Dict[str, jax.Array] = {}
-        # claim-dirty flag: the mutable arrays re-upload wholesale (async)
-        # before the next solve dispatch — see update_rows
+        # claim-dirty state: the touched row set (scattered before the
+        # next solve dispatch when the delta path is on) or, with
+        # NHD_DEVICE_DELTA=0 / a mesh, a plain flag driving the wholesale
+        # async re-upload — see stage_rows
         self._staged: bool = False
+        self._staged_rows: set = set()
         for name in _ARG_ORDER:
             self._dev[name] = self._put(
-                _pad_rows(getattr(cluster, name), self.Np)
+                _pad_own(getattr(cluster, name), self.Np)
             )
 
     def _put(self, padded: np.ndarray) -> jax.Array:
@@ -88,21 +142,113 @@ class DeviceClusterState:
         return jnp.asarray(padded)
 
     def stage_rows(self, indices: Iterable[int]) -> None:
-        """Mark the resident mutable arrays claim-dirty: the host mirror
-        re-uploads wholesale (async device_put, batched into the next
-        flush) before the next solve dispatch. The per-row scatter this
-        replaces was O(claimed-rows) on upload bytes but lazily compiled
-        a fresh program per scatter-width bucket — on the tunnel relay,
-        which charges ~65 ms per FLUSH and nothing per byte, the stable
-        single program wins outright (docs/TPU_STATUS.md r4)."""
-        for _ in indices:
+        """Mark claim-mutated rows dirty; they reach the device before
+        the next solve dispatch. Default (NHD_DEVICE_DELTA=1): ONE
+        donated row-scatter over the pow-2-padded index bucket updates
+        exactly the claimed rows of the mutable arrays — per-round
+        upload is O(claimed rows), not O(cluster). With the delta path
+        off (or on a mesh), the mutable arrays re-upload wholesale
+        (async device_put, batched into the next flush) — the right
+        trade on a relay that charges ~65 ms per FLUSH and nothing per
+        byte (docs/TPU_STATUS.md r4), where scatter-width program
+        variants cost more than the bytes they save."""
+        for i in indices:
             self._staged = True
-            return
+            self._staged_rows.add(int(i))
+        if self._staged_rows and not (
+            _delta_enabled() and self.mesh is None
+        ):
+            self._staged_rows.clear()  # flag-only mode: wholesale flush
 
     def _flush_staged(self) -> None:
-        if self._staged:
-            self._staged = False
+        if not self._staged:
+            return
+        self._staged = False
+        rows, self._staged_rows = self._staged_rows, set()
+        if rows and _delta_enabled() and self.mesh is None and (
+            len(rows) < self.N
+        ):
+            self._scatter(
+                _MUTABLE,
+                np.fromiter(sorted(rows), np.int64, len(rows)),
+            )
+        else:
             self._rebuild_mutable()
+
+    def _scatter(self, names, rows: np.ndarray) -> None:
+        """Donated row-scatter of *rows* (host-mirror truth) into the
+        named resident arrays — ONE dispatch whatever the array count.
+        The index vector pads to its power-of-two bucket by repeating
+        the last row (idempotent), so ~log2(N) program variants cover
+        every delta size."""
+        W = len(rows)
+        Wp = _pad_pow2(W, floor=8)
+        idx = np.empty(Wp, np.int32)
+        idx[:W] = rows
+        idx[W:] = rows[-1]
+        JIT_STATS.record_use(
+            "row_scatter", f"A{len(names)}_W{Wp}_N{self.Np}"
+        )
+        donate = False
+        try:
+            donate = jax.default_backend() != "cpu"
+        except Exception:  # nhdlint: ignore[NHD302]
+            pass  # backend probe only decides donation, never correctness
+        fn = _get_row_scatter(len(names), donate)
+        arrays = tuple(self._dev[name] for name in names)
+        host_rows = tuple(
+            jnp.asarray(np.ascontiguousarray(getattr(self.cluster, name)[idx]))
+            for name in names
+        )
+        try:
+            out = fn(arrays, jnp.asarray(idx), host_rows)
+        except BaseException:
+            # the dispatch may have donated the resident arrays: restore
+            # them from the host mirror (source of truth)
+            for name in names:
+                self._dev[name] = self._put(
+                    _pad_own(getattr(self.cluster, name), self.Np)
+                )
+            raise
+        for name, arr in zip(names, out):
+            self._dev[name] = arr
+        from nhd_tpu.k8s.retry import API_COUNTERS
+
+        API_COUNTERS.inc("device_state_rows_uploaded_total", W)
+
+    def scatter_rows(self, rows: np.ndarray) -> None:
+        """Delta-layer sync (encode.ClusterDelta.drain_dirty → here):
+        scatter the changed rows of ALL resident arrays — watch events
+        touch arrays the claim path never does (active, maintenance,
+        group_mask) — and pick up any row growth inside the capacity
+        bucket. A mesh falls back to the wholesale sharded re-upload
+        (a host-indexed scatter would gather across shards)."""
+        self.N = self.cluster.n_nodes
+        if self.N > self.Np:
+            raise ValueError(
+                f"cluster grew past the resident capacity bucket "
+                f"({self.N} > {self.Np}); rebuild DeviceClusterState"
+            )
+        if rows.size == 0:
+            return
+        self._flush_staged()  # claim updates first, in their own mode
+        if (
+            self.mesh is not None
+            or not _delta_enabled()
+            or rows.size >= self.N // 2
+        ):
+            # storm-sized deltas: past ~half the rows, one contiguous
+            # re-upload beats gathering scattered rows host-side (the
+            # gather + index conversion costs more than the bytes saved)
+            for name in _ARG_ORDER:
+                self._dev[name] = self._put(
+                    _pad_own(getattr(self.cluster, name), self.Np)
+                )
+            from nhd_tpu.k8s.retry import API_COUNTERS
+
+            API_COUNTERS.inc("device_state_rows_uploaded_total", self.N)
+            return
+        self._scatter(_ARG_ORDER, rows.astype(np.int64))
 
     def _pod_args(self, pods) -> list:
         """The 9 pod-type arrays padded to the pow-2 type bucket, in
@@ -196,12 +342,19 @@ class DeviceClusterState:
 
     def _rebuild_mutable(self) -> None:
         """Re-upload the claim-mutated resident arrays wholesale from the
-        host mirror (source of truth) — the recovery path when a dispatch
-        that donated them fails midway."""
+        host mirror (source of truth) — the staged-claim fallback mode
+        (NHD_DEVICE_DELTA=0 / mesh) and the recovery path when a dispatch
+        that donated them fails midway. Counts its full row set so the
+        upload economy stays honest in wholesale mode — an O(changed)
+        assertion judged on a counter this path skipped would be
+        vacuously green exactly where uploads are heaviest."""
         for name in _MUTABLE:
             self._dev[name] = self._put(
-                _pad_rows(getattr(self.cluster, name), self.Np)
+                _pad_own(getattr(self.cluster, name), self.Np)
             )
+        from nhd_tpu.k8s.retry import API_COUNTERS
+
+        API_COUNTERS.inc("device_state_rows_uploaded_total", self.N)
 
     def megaround(self, bucket_pods: list, needs: list, respect_busy: bool):
         """Run the speculative on-device multi-round (solver/speculate.py)

@@ -274,6 +274,37 @@ class FastCluster:
         base, stride = self._addr[name]
         return base + n * stride
 
+    def refresh_node(self, i: int) -> None:
+        """Re-read node *i*'s dynamic allocation state from its HostNode
+        — the inverse of sync_to_nodes, for one row. The delta layer
+        (solver/encode.py ClusterDelta) patches a persistent context's
+        FastCluster through this after out-of-band churn (pod release,
+        restart replay, watch events) mutated the host mirror between
+        batches; everything static is untouched, so the call is a few
+        vector writes. Callers must have ruled out a packed-topology
+        rebuild (pack generation change) — that invalidates the static
+        matrices and demands a full FastCluster rebuild."""
+        node = self.node_objs[i]
+        self.core_used[i] = True
+        if node._core_used is not None:
+            self.core_used[i, : len(node.cores)] = node._core_used
+        else:
+            for c in node.cores:
+                self.core_used[i, c.core] = c.used
+        self.gpu_used[i] = True
+        m = len(node.gpus)
+        if m:
+            self.gpu_used[i, :m] = node._gpu_used
+        self.nic_rx_used[i] = 0.0
+        self.nic_tx_used[i] = 0.0
+        self.nic_pods[i] = 0
+        uu, kk, valid = self._nic_idx[i]
+        if uu is not None:
+            self.nic_rx_used[i, uu, kk] = node._nic_bw[valid, 0]
+            self.nic_tx_used[i, uu, kk] = node._nic_bw[valid, 1]
+            self.nic_pods[i, uu, kk] = node._nic_pods[valid]
+        self.hp_free[i] = node.mem.free_hugepages_gb
+
     # ------------------------------------------------------------------
     # round-level native assignment
     # ------------------------------------------------------------------

@@ -94,7 +94,9 @@ class JaxMatcher:
         if not valid_idx:
             return results
 
-        cluster = encode_cluster(nodes, now=now)
+        # one-shot snapshot evaluation (the reference-parity surface):
+        # no rounds, no events — a delta would have nothing to reuse
+        cluster = encode_cluster(nodes, now=now)  # nhdlint: ignore[NHD108]
         if not respect_busy:
             cluster.busy[:] = False
 

@@ -92,6 +92,7 @@ class StreamingScheduler:
         tile_nodes: int = 2048,
         chunk_pods: int = 16384,
         placement: str = "first-fit",
+        persistent: bool = False,
         **batch_kwargs,
     ):
         if tile_nodes < 1 or chunk_pods < 1:
@@ -103,6 +104,19 @@ class StreamingScheduler:
         self.logger = get_logger(__name__)
         self.tile_nodes = tile_nodes
         self.chunk_pods = chunk_pods
+        # ``persistent``: keep every tile's ScheduleContext (packed
+        # arrays + FastCluster + device-resident state) alive ACROSS
+        # schedule() calls, maintained incrementally by a per-tile
+        # ClusterDelta — the scheduler routes inter-call churn in via
+        # note_nodes(), and each tile's first offer of a call folds its
+        # noted rows in as patches + device row scatters instead of a
+        # fresh make_context (O(tile) encode per tile per call → O(
+        # changed rows)). Membership or interner-budget changes drop the
+        # whole state (counted as delta rebuilds). Single-caller
+        # contract: note_nodes/schedule run on the scheduler thread.
+        self.persistent = persistent
+        self._pstate: Optional[dict] = None
+        self._pstale: set = set()
         # 'first-fit': every chunk enters at tile 0 and spills forward —
         # placement identical to the serial sweep (and, on homogeneous
         # clusters, to the untiled scheduler). 'routed': pods are
@@ -114,6 +128,39 @@ class StreamingScheduler:
         # conservation is unaffected (claims are re-verified as always).
         self.placement = placement
         self.batch = BatchScheduler(**batch_kwargs)
+
+    def note_nodes(self, names) -> None:
+        """An event touched these nodes: their tiles' persistent
+        contexts patch the rows in at the next schedule() call."""
+        if self.persistent:
+            self._pstale.update(names)
+
+    def reset_state(self) -> None:
+        """Drop the persistent tile contexts (restart-grade mirror
+        events: promotion replay, drift repair)."""
+        self._pstate = None
+        self._pstale.clear()
+
+    def route_notes(self) -> None:
+        """Fold pending inter-call churn notes into their owning tiles'
+        deltas. schedule() calls this before refreshing contexts; the
+        chaos parity invariant calls it so tile state is judged net of
+        the note trail, not mid-flight. Notes naming nodes outside the
+        persisted membership stay pending (that membership change
+        condemns the whole state at the next schedule)."""
+        ps = self._pstate
+        if ps is None or not self._pstale:
+            return
+        tile_of = ps["tile_of"]
+        keep = set()
+        stale, self._pstale = self._pstale, set()
+        for name in stale:
+            ti = tile_of.get(name)
+            if ti is None:
+                keep.add(name)
+            elif ps["deltas"][ti] is not None:
+                ps["deltas"][ti].note(name)
+        self._pstale |= keep
 
     @staticmethod
     def _batch_demand(items, indices) -> Tuple[float, float, float]:
@@ -217,10 +264,26 @@ class StreamingScheduler:
         # one compatible tile of exactly-matching capacity, and the lost
         # spill alternatives cost contention-retry rounds.)
         names = list(nodes.keys())
-        tiles: List[Dict[str, HostNode]] = [
-            {n: nodes[n] for n in names[i : i + self.tile_nodes]}
-            for i in range(0, len(names), self.tile_nodes)
-        ]
+        ps = self._pstate if self.persistent else None
+        if ps is not None and (
+            ps["names"] != names
+            or any(
+                nodes[n] is not node
+                for tile in ps["tiles"]
+                for n, node in tile.items()
+            )
+        ):
+            # membership (or the node objects behind it) changed: the
+            # persistent tile contexts have nothing stable to patch
+            ps = self._pstate = None
+            self._pstale.clear()
+        if ps is not None:
+            tiles: List[Dict[str, HostNode]] = ps["tiles"]
+        else:
+            tiles = [
+                {n: nodes[n] for n in names[i : i + self.tile_nodes]}
+                for i in range(0, len(names), self.tile_nodes)
+            ]
         if not tiles:
             # empty node set (e.g. a multihost rank whose region slice is
             # empty): everything stays unschedulable, like the serial
@@ -264,6 +327,13 @@ class StreamingScheduler:
             )
             ov = set(oversized)
             schedulable = [i for i in schedulable if i not in ov]
+            # persistent tile contexts may already exist (prior calls):
+            # their claimed rows fold in as deltas at the context refresh
+            # below, exactly like any other inter-batch churn
+            self.note_nodes(
+                results[i].node for i in oversized
+                if results[i] is not None and results[i].node is not None
+            )
             stats.round_end_seconds.append(time.perf_counter() - t_stream)
             for i in oversized:
                 if results[i] is not None and results[i].node is not None:
@@ -288,7 +358,26 @@ class StreamingScheduler:
             all_groups |= items[i].request.node_groups
         share_enc = len(all_groups) <= 48
         interner = None
-        if share_enc:
+        if ps is not None and (
+            ps["share_enc"] != share_enc
+            or (
+                share_enc
+                and not ps["interner"].known(all_groups)
+                and ps["interner"].n_bits + len(all_groups) > 56
+            )
+        ):
+            # encode-sharing mode flipped, or the persisted interner
+            # would overflow its bit budget absorbing this batch's new
+            # groups — rebuild the tile state from scratch
+            ps = self._pstate = None
+            self._pstale.clear()
+        if share_enc and ps is not None:
+            # reuse the persisted interner (tile arrays bake its bit
+            # positions); new groups intern HERE, sorted, on the main
+            # thread — workers still never mutate it
+            interner = ps["interner"]
+            interner.mask(sorted(all_groups))
+        elif share_enc:
             interner = GroupInterner()
             interner.mask(sorted(all_groups))
         # per-chunk encode cache: cid -> (items, buckets, global->local);
@@ -334,7 +423,22 @@ class StreamingScheduler:
             else contextlib.nullcontext()
         )
 
-        contexts: List[Optional[ScheduleContext]] = [None] * len(tiles)
+        if ps is not None:
+            contexts: List[Optional[ScheduleContext]] = ps["ctxs"]
+            deltas = ps["deltas"]
+            # route inter-call churn notes to their owning tiles' deltas
+            # (a tile with no built context yet has nothing to patch —
+            # its eventual make_context reads live nodes)
+            self.route_notes()
+        else:
+            contexts = [None] * len(tiles)
+            deltas = [None] * len(tiles)
+            self._pstale.clear()
+        # persistent contexts refresh ONCE per call, at their first
+        # offer (busy decay + noted rows fold in); within-call reuse
+        # needs none — claims maintain the arrays as they apply. Each
+        # slot is only touched by its tile's single worker.
+        refreshed = [False] * len(tiles)
         # per-tile saturation certificates: a request type that came back
         # unschedulable from a tile stays unschedulable there for the rest
         # of this call (resources only shrink within one schedule()), so
@@ -375,9 +479,30 @@ class StreamingScheduler:
                 return pending
             if contexts[ti] is None:
                 with solve_gate:
-                    contexts[ti] = self.batch.make_context(
-                        tiles[ti], now=now, interner=interner
-                    )
+                    if self.persistent:
+                        from nhd_tpu.solver.encode import ClusterDelta
+
+                        deltas[ti] = ClusterDelta(
+                            tiles[ti], now=now, interner=interner,
+                            respect_busy=self.batch.respect_busy,
+                        )
+                        contexts[ti] = self.batch.make_context(
+                            tiles[ti], now=now, delta=deltas[ti]
+                        )
+                    else:
+                        contexts[ti] = self.batch.make_context(
+                            tiles[ti], now=now, interner=interner
+                        )
+                refreshed[ti] = True
+            elif not refreshed[ti]:
+                # a persistent context from an earlier call: fold the
+                # inter-call churn in (row patches + device scatters)
+                with solve_gate:
+                    self.batch.refresh_context(contexts[ti], now=now)
+                refreshed[ti] = True
+            # delta-built contexts solve over their row-aligned view
+            # dict; plain contexts' nodes IS tiles[ti]
+            sub_nodes = contexts[ti].nodes
             t_sub = time.perf_counter()
             if share_enc:
                 sub_items, encoded, local_of = chunk_encoded(
@@ -389,7 +514,7 @@ class StreamingScheduler:
                 identity = len(offer) == len(sub_items)
                 with solve_gate:
                     sub_results, sub_stats = self.batch.schedule(
-                        tiles[ti], sub_items, now=now, context=contexts[ti],
+                        sub_nodes, sub_items, now=now, context=contexts[ti],
                         encoded=encoded,
                         offer=(
                             None if identity
@@ -404,7 +529,7 @@ class StreamingScheduler:
                 sub_items = [items[i] for i in offer]
                 with solve_gate:
                     sub_results, sub_stats = self.batch.schedule(
-                        tiles[ti], sub_items, now=now, context=contexts[ti]
+                        sub_nodes, sub_items, now=now, context=contexts[ti]
                     )
             # merge: remap round numbers into the streaming timeline
             with lock:
@@ -513,11 +638,14 @@ class StreamingScheduler:
         # tile stages spend much of their wall blocked on relay flushes
         # (GIL released), so concurrent stages overlap those waits even
         # on a 1-core host (measured cfg5 6.1→5.7 s r4). On the CPU
-        # backend the r8 fused solve left the host phases as the
-        # critical path, and oversubscribing cores just stretches every
-        # GIL-bound select/assign span (measured cfg5: 4 workers 4.87 s
-        # vs 2 workers 4.47 s on a 2-core box) — cap at the core count,
-        # floor 2 so solve/host still overlap
+        # backend the host-side spans keep shrinking (r8 fused solve;
+        # r9 memoized winner materialization) while XLA's own thread
+        # pool already spreads each solve across the cores — so extra
+        # pipeline workers now buy GIL contention, not overlap: measured
+        # cfg5 on a 2-core box, r8: 4 workers 4.87 s vs 2 workers
+        # 4.47 s; r9: 2 workers 4.37 s vs ONE worker 3.75 s with every
+        # host phase halving (no interleave inflation). Default to one
+        # worker per two cores, floor 1.
         import jax
 
         try:
@@ -525,7 +653,7 @@ class StreamingScheduler:
         except Exception:
             accel = False
         default_workers = (
-            4 if accel else min(4, max(2, os.cpu_count() or 2))
+            4 if accel else min(4, max(1, (os.cpu_count() or 2) // 2))
         )
         n_workers = max(
             1,
@@ -602,6 +730,20 @@ class StreamingScheduler:
                     done.wait()
         if errors:
             raise errors[0]
+        if self.persistent and self._pstate is None:
+            # bank this call's tile contexts for the next one (an errored
+            # call never saves — it rebuilds from the live mirror)
+            self._pstate = {
+                "names": names,
+                "tiles": tiles,
+                "tile_of": {
+                    n: ti for ti, tile in enumerate(tiles) for n in tile
+                },
+                "ctxs": contexts,
+                "deltas": deltas,
+                "share_enc": share_enc,
+                "interner": interner,
+            }
         # back-fill the lazy result slots (never-offered / unplaced pods)
         for i, it in enumerate(items):
             if results[i] is None:

@@ -1,0 +1,14 @@
+# nhdlint fixture: the same full-re-encode shapes OUTSIDE solver /
+# scheduler paths — the NHD108 pack is path-scoped and must stay silent
+# here (tools, tests and sim code re-encode one-shot by design).
+from nhd_tpu.solver.encode import encode_cluster
+
+
+def per_round_reencode(nodes, rounds):
+    for _ in range(rounds):
+        cluster = encode_cluster(nodes)
+    return cluster
+
+
+def helper(nodes):
+    return encode_cluster(nodes, now=0.0)

@@ -1,0 +1,27 @@
+# nhdlint fixture: NHD108 negatives — delta-path idioms inside solver
+# scope that must stay silent.
+from nhd_tpu.solver.encode import ClusterDelta, refresh_node_row
+
+
+def per_event_delta(delta, event):
+    # the sanctioned hot-path shape: note + refresh (row patches)
+    delta.note(event.node)
+    delta.refresh(0.0)
+    return delta.drain_dirty()
+
+
+def per_round_patch(arrays, i, node):
+    # a single-row re-projection is exactly the delta the rule wants
+    refresh_node_row(arrays, i, node, now=0.0)
+
+
+def build_delta(nodes):
+    # constructing the delta (its init runs the one sanctioned rebuild)
+    return ClusterDelta(nodes, now=0.0)
+
+
+def parity_errors(delta):
+    # the continuous re-derivability check re-encodes by design
+    from nhd_tpu.solver.encode import encode_cluster
+
+    return encode_cluster(delta.nodes, dims=delta.dims)

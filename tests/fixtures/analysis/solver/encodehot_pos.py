@@ -1,0 +1,38 @@
+# nhdlint fixture: NHD108 full cluster re-encode on a per-event /
+# per-round hot path (this file sits under a "solver" path segment, so
+# the pack is in scope). Flagged lines carry EXPECT markers; analyzed as
+# text only.
+from nhd_tpu.solver.encode import encode_cluster
+from nhd_tpu.solver import encode
+
+
+def per_round_reencode(nodes, rounds):
+    for _ in range(rounds):
+        cluster = encode_cluster(nodes)  # EXPECT[NHD108]
+    return cluster
+
+
+def per_event_reencode(nodes, event):
+    nodes[event.node].active = False
+    return encode.encode_cluster(nodes, now=0.0)  # EXPECT[NHD108]
+
+
+class Loop:
+    def handle(self, nodes, interner):
+        self.cluster = encode_cluster(  # EXPECT[NHD108]
+            nodes, interner=interner
+        )
+
+
+def make_context(nodes):
+    # the sanctioned one-shot context builder: silent
+    return encode_cluster(nodes)
+
+
+def _rebuild(nodes):
+    # the delta layer's rebuild chokepoint shape: silent
+    return encode_cluster(nodes)
+
+
+def suppressed_one_shot(nodes):
+    return encode_cluster(nodes)  # nhdlint: ignore[NHD108]

@@ -1,0 +1,338 @@
+"""Incremental cluster state (solver/encode.py ClusterDelta): the
+randomized delta-parity property test plus the forced-fallback and
+device-row pins (ISSUE 9).
+
+The contract under test is SURVEY §5.4's: host HostNode objects stay the
+source of truth and the incrementally-maintained resident state must
+remain RE-DERIVABLE — after every event batch, the delta's live rows are
+bit-exact with a from-scratch ``encode_cluster`` of the same nodes, and
+(with device state on) the resident device arrays are bit-exact with the
+host arrays. Fallback events (new group bit, padding/capacity overflow,
+tombstone re-add, compaction) may cost a logged full rebuild; they may
+never cost parity.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from nhd_tpu.sim.requests import request_to_topology
+from nhd_tpu.sim.synth import SynthNodeSpec, make_node, make_node_labels
+from nhd_tpu.sim.workloads import make_cluster, workload_mix
+from nhd_tpu.solver.batch import BatchItem, BatchScheduler
+from nhd_tpu.solver.encode import (
+    ClusterDelta,
+    encode_cluster,
+    rebuild_reasons_snapshot,
+    reset_delta_metrics,
+)
+from nhd_tpu.solver.kernel import _ARG_ORDER
+
+GROUPS = ["default", "edge", "batch"]
+
+
+def _cluster(n=12, seed=0):
+    return make_cluster(
+        n, SynthNodeSpec(phys_cores=8, gpus_per_numa=1, nics_per_numa=1,
+                         hugepages_gb=32),
+        groups=GROUPS, seed=seed,
+    )
+
+
+def _assert_parity(delta, where):
+    errs = delta.parity_errors()
+    assert not errs, f"{where}: {errs}"
+
+
+def _spec(i, **kw):
+    kw.setdefault("phys_cores", 8)
+    kw.setdefault("gpus_per_numa", 1)
+    kw.setdefault("nics_per_numa", 1)
+    kw.setdefault("hugepages_gb", 32)
+    return SynthNodeSpec(name=f"fresh{i}", **kw)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_delta_parity_random_event_stream(seed):
+    """Seeded event streams — claim/release-style mutations, cordon /
+    maintenance / group flips, busy stamps, structural adds/removes, and
+    FORCED fallback events — folded through the delta path; the arrays
+    must be bit-exact with a from-scratch encode after every batch.
+
+    The stream mutates HostNodes directly (claims through the solver are
+    pinned separately below — parity is about host-state folding, and a
+    solver dispatch per random shape would spend the tier-1 budget on
+    XLA compiles, not on the property)."""
+    rng = random.Random(seed)
+    nodes = _cluster(10, seed=seed)
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=True)
+    fresh_seq = 0
+    now = 0.0
+
+    for batch_no in range(40):
+        now += 1.0
+        for _ in range(rng.randint(1, 6)):
+            ev = rng.random()
+            name = rng.choice(list(nodes))
+            node = nodes[name]
+            if ev < 0.20:
+                # claim-shaped mutation: burn a GPU + cores + pages,
+                # stamp busy (what an applied assignment does)
+                for gpu in node.gpus:
+                    if not gpu.used:
+                        gpu.used = True
+                        break
+                for core in node.cores:
+                    if not core.used:
+                        core.used = True
+                        break
+                node.mem.free_hugepages_gb = max(
+                    node.mem.free_hugepages_gb - 2, 0
+                )
+                node.set_busy(now)
+                delta.note(name)
+            elif ev < 0.35:
+                # release-shaped mutation
+                for gpu in node.gpus:
+                    if gpu.used:
+                        gpu.used = False
+                        break
+                for core in node.cores:
+                    if core.used:
+                        core.used = False
+                        break
+                node.mem.free_hugepages_gb += 1
+                node.set_busy(now)
+                delta.note(name)
+            elif ev < 0.50:
+                node.active = not node.active
+                delta.note(name)
+            elif ev < 0.60:
+                node.maintenance = not node.maintenance
+                delta.note(name)
+            elif ev < 0.72:
+                node.set_groups(rng.choice(GROUPS))
+                delta.note(name)
+            elif ev < 0.80:
+                # structural add within known dims
+                fresh_seq += 1
+                spec = _spec(fresh_seq)
+                nodes[spec.name] = make_node(spec)
+                delta.note(spec.name)
+            elif ev < 0.90 and len(nodes) > 4:
+                victim = rng.choice(list(nodes))
+                del nodes[victim]
+                delta.note(victim)
+            elif ev < 0.96:
+                # FORCED fallback: new group bit (uninterned name)
+                node.set_groups(f"novel{batch_no}")
+                delta.note(name)
+            else:
+                # FORCED fallback: padding overflow (more NUMA nodes /
+                # NICs than the current U/K can hold)
+                fresh_seq += 1
+                spec = _spec(fresh_seq, sockets=4, nics_per_numa=3)
+                nodes[spec.name] = make_node(spec)
+                delta.note(spec.name)
+
+        delta.refresh(now)
+        _assert_parity(delta, f"seed {seed} batch {batch_no}")
+        delta.drain_dirty()
+
+
+def test_delta_parity_through_scheduled_batches():
+    """Claims applied by the SOLVER (FastCluster maintaining the packed
+    arrays in place) keep parity too — the fixed-membership pin, one
+    compiled shape family."""
+    nodes = _cluster(8)
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=True)
+    sched = BatchScheduler(respect_busy=True, register_pods=True)
+    ctx = sched.make_context(nodes, now=0.0, delta=delta)
+    catalog = workload_mix(8, GROUPS)
+    placed = []
+    for batch_no in range(3):
+        now = float(batch_no)
+        sched.refresh_context(ctx, now=now)
+        creates = [
+            BatchItem(("t", f"p{batch_no}-{i}"), catalog[i],
+                      topology=request_to_topology(catalog[i]))
+            for i in range(4)
+        ]
+        results, _ = sched.schedule(ctx.nodes, creates, context=ctx)
+        for item, r in zip(creates, results):
+            if r.node is not None:
+                placed.append((item.key, r.node, item.topology))
+        _assert_parity(delta, f"batch {batch_no} post-solve")
+        # release one placed pod between batches (the event path)
+        if placed:
+            key, node_name, top = placed.pop()
+            node = ctx.nodes[node_name]
+            node.release_from_topology(top)
+            node.remove_scheduled_pod(key[1], key[0])
+            node.set_busy(now)
+            delta.note(node_name)
+            delta.refresh(now + 0.5)
+            _assert_parity(delta, f"batch {batch_no} post-release")
+
+
+def test_delta_device_rows_bit_exact(monkeypatch):
+    """With device-resident state on, the scattered device rows must be
+    bit-exact with the host arrays after every refresh — including rows
+    appended into padded-capacity slots."""
+    monkeypatch.setenv("NHD_TPU_DEVICE_STATE", "1")
+    rng = random.Random(3)
+    nodes = _cluster(6)
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    sched = BatchScheduler(respect_busy=False, register_pods=False)
+    ctx = sched.make_context(nodes, now=0.0, delta=delta)
+    assert ctx.dev is not None
+    catalog = workload_mix(16, GROUPS)
+
+    def check_device():
+        for arg in _ARG_ORDER:
+            dev_rows = np.asarray(ctx.dev._dev[arg])[: delta.n_rows]
+            host = getattr(ctx.cluster, arg)
+            assert np.array_equal(dev_rows, host), f"{arg} diverged"
+
+    for step in range(4):
+        name = rng.choice(list(nodes))
+        nodes[name].active = not nodes[name].active
+        delta.note(name)
+        if step == 2 and len(nodes) < delta.capacity:
+            # padded-slot append must reach the device as a row scatter
+            spec = _spec(100 + step)
+            nodes[spec.name] = make_node(spec)
+            delta.note(spec.name)
+        sched.refresh_context(ctx, now=float(step))
+        check_device()
+        if step % 2 == 0:
+            items = [
+                BatchItem(("d", f"q{step}-{i}"), catalog[i])
+                for i in range(3)
+            ]
+            sched.schedule(ctx.nodes, items, context=ctx)
+            # claims stage rows; flush and compare the resident arrays
+            sched.refresh_context(ctx, now=float(step) + 0.5)
+            ctx.dev._flush_staged()
+            check_device()
+        assert not delta.parity_errors()
+
+
+def test_forced_fallbacks_rebuild_with_reason():
+    """Each fallback trigger rebuilds (never diverges) and records its
+    bounded-vocabulary reason."""
+    reset_delta_metrics()
+    nodes = _cluster(6)
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    base = delta.rebuilds
+
+    # new group bit
+    name = list(nodes)[0]
+    nodes[name].set_groups("brand-new-group")
+    delta.note(name)
+    delta.refresh(1.0)
+    assert delta.rebuilds == base + 1
+    assert not delta.parity_errors()
+
+    # dims overflow (a node with more NUMA nodes than U)
+    big = make_node(SynthNodeSpec(name="big", sockets=4, phys_cores=16,
+                                  gpus_per_numa=1, nics_per_numa=3,
+                                  hugepages_gb=32))
+    nodes["big"] = big
+    delta.note("big")
+    delta.refresh(2.0)
+    assert delta.rebuilds == base + 2
+    assert not delta.parity_errors()
+
+    # tombstone re-add: remove, flush, then re-add the same name
+    del nodes["big"]
+    delta.note("big")
+    delta.refresh(3.0)
+    assert delta.rebuilds == base + 2  # a remove is a patch, not a rebuild
+    nodes["big"] = make_node(SynthNodeSpec(
+        name="big", sockets=4, phys_cores=16, gpus_per_numa=1,
+        nics_per_numa=3, hugepages_gb=32,
+    ))
+    delta.note("big")
+    delta.refresh(4.0)
+    assert delta.rebuilds == base + 3
+    assert not delta.parity_errors()
+
+    # capacity overflow: append past the power-of-two bucket (each
+    # rebuild doubles the bucket, so gate on the recorded reason)
+    cap_before = rebuild_reasons_snapshot().get("capacity", 0)
+    added = 0
+    while rebuild_reasons_snapshot().get("capacity", 0) == cap_before:
+        added += 1
+        assert added <= delta.capacity + 2, "capacity fallback never fired"
+        spec = _spec(1000 + added)
+        nodes[spec.name] = make_node(spec)
+        delta.note(spec.name)
+        delta.refresh(5.0 + added)
+        assert not delta.parity_errors()
+    assert delta.rebuilds > base + 3
+
+    # generation change (label reparse rebuilds packed topology)
+    name = list(nodes)[1]
+    nodes[name].parse_labels(make_node_labels(SynthNodeSpec(
+        name=name, phys_cores=8, gpus_per_numa=1, nics_per_numa=1,
+        hugepages_gb=32,
+    )))
+    nodes[name].set_hugepages(32, 32)
+    delta.note(name)
+    pre = delta.rebuilds
+    delta.refresh(20.0)
+    assert delta.rebuilds == pre + 1
+    assert not delta.parity_errors()
+
+    reasons = rebuild_reasons_snapshot()
+    for expected in ("new-group", "dims-overflow", "tombstone-readd",
+                     "capacity", "generation"):
+        assert reasons.get(expected, 0) >= 1, (expected, reasons)
+
+
+def test_compaction_reclaims_tombstones():
+    reset_delta_metrics()
+    nodes = _cluster(12)
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    # remove enough nodes to cross the tombstone threshold
+    victims = list(nodes)[:5]
+    for v in victims:
+        del nodes[v]
+        delta.note(v)
+    delta.refresh(1.0)
+    assert rebuild_reasons_snapshot().get("compaction", 0) >= 1
+    assert delta.n_rows == len(nodes)  # compacted: no tombstones left
+    assert not delta.parity_errors()
+
+
+def test_dirty_rows_are_exactly_the_changed_rows():
+    nodes = _cluster(8)
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    delta.drain_dirty()
+    names = list(nodes)
+    nodes[names[2]].active = False
+    nodes[names[5]].maintenance = True
+    delta.note(names[2])
+    delta.note(names[5])
+    delta.refresh(1.0)
+    assert delta.drain_dirty().tolist() == [2, 5]
+    # a second drain is empty (no new changes)
+    assert delta.drain_dirty().size == 0
+
+
+def test_snapshot_matches_plain_encode_bit_for_bit():
+    nodes = _cluster(9)
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    snap = delta.snapshot()
+    ref = encode_cluster(nodes, now=0.0, interner=delta.interner,
+                         dims=delta.dims)
+    ref.busy[:] = False
+    assert snap.names == ref.names
+    from nhd_tpu.solver.encode import DELTA_FIELDS
+
+    for f in DELTA_FIELDS:
+        assert np.array_equal(getattr(snap, f), getattr(ref, f)), f

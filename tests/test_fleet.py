@@ -405,8 +405,14 @@ def test_bench_diff_fails_on_injected_solve_regression(tmp_path):
     # within threshold passes
     ok = _mk_bench(tmp_path, "ok.json", solve=0.52)  # +4%
     assert _bench_diff(old, ok).returncode == 0
-    # and the threshold is a knob
-    assert _bench_diff(old, ok, "--threshold", "0.01").returncode == 1
+    # and the threshold is a knob (+8% = +40ms: past the 1% threshold
+    # AND the 30ms absolute phase floor — a +20ms blip alone no longer
+    # fires, r9's jitter floor)
+    knob = _mk_bench(tmp_path, "knob.json", solve=0.54)
+    assert _bench_diff(old, knob).returncode == 0
+    assert _bench_diff(old, knob, "--threshold", "0.01").returncode == 1
+    # sub-floor growth is never fatal, whatever the percentage says
+    assert _bench_diff(old, ok, "--threshold", "0.01").returncode == 0
 
 
 def test_bench_diff_reads_legacy_driver_records():

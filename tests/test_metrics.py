@@ -250,6 +250,51 @@ def test_round_phase_attribution_exposed(metrics_stack):
     )
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 9: incremental device-resident state families
+# ---------------------------------------------------------------------------
+
+def test_device_state_families_exposed(metrics_stack):
+    """The nhd_device_state_* counters ride the ApiCounters.KNOWN loop
+    (pre-seeded to 0, visible from process start) and the labeled
+    rebuild-reason family renders from the bounded-vocabulary registry."""
+    from nhd_tpu.solver.encode import _count_rebuild
+
+    _count_rebuild("compaction")
+    body = _get(metrics_stack, "/metrics")
+    for fam, kind in (
+        ("nhd_device_state_events_total", "counter"),
+        ("nhd_device_state_deltas_total", "counter"),
+        ("nhd_device_state_rows_uploaded_total", "counter"),
+        ("nhd_device_state_full_rebuilds_total", "counter"),
+        ("nhd_device_state_resident_age_seconds", "gauge"),
+    ):
+        assert f"# TYPE {fam} {kind}" in body, fam
+    assert "# TYPE nhd_device_state_rebuilds_total counter" in body
+    assert re.search(
+        r'nhd_device_state_rebuilds_total\{reason="compaction"\} \d+', body
+    )
+
+
+def test_device_state_rebuild_reason_vocabulary_is_bounded():
+    """Novel reasons fold into 'other' — the NHD603 cardinality stance."""
+    from nhd_tpu.solver.encode import (
+        REBUILD_REASONS,
+        _count_rebuild,
+        rebuild_reasons_snapshot,
+        reset_delta_metrics,
+    )
+
+    reset_delta_metrics()
+    _count_rebuild("totally-made-up-reason")
+    _count_rebuild("new-group")
+    snap = rebuild_reasons_snapshot()
+    assert snap.get("other") == 1
+    assert snap.get("new-group") == 1
+    assert set(snap) <= set(REBUILD_REASONS) | {"other"}
+    reset_delta_metrics()
+
+
 def test_labeled_histogram_render_exact():
     from nhd_tpu.obs.histo import LabeledHistogram
 

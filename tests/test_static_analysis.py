@@ -63,6 +63,9 @@ def found_of(path: Path, packs=None) -> set:
     ("solver/hostsync_pos.py", ["tracing"]),
     ("solver/hostsync_neg.py", ["tracing"]),
     ("hostsync_out_of_scope.py", ["tracing"]),
+    ("solver/encodehot_pos.py", ["tracing"]),
+    ("solver/encodehot_neg.py", ["tracing"]),
+    ("encodehot_out_of_scope.py", ["tracing"]),
     ("locks_pos.py", ["locks"]),
     ("locks_neg.py", ["locks"]),
     ("excepts_pos.py", ["excepts"]),

@@ -117,9 +117,9 @@ def main() -> int:
                     help="churn steps per run (default 60)")
     ap.add_argument("--nodes", type=int, default=4,
                     help="cluster size per run (default 4)")
-    ap.add_argument("--profiles", default="light,storm,heavy",
+    ap.add_argument("--profiles", default="light,storm,heavy,churn",
                     help="comma-separated profile names (sim/faults.py "
-                         "PROFILES; default light,storm,heavy)")
+                         "PROFILES; default light,storm,heavy,churn)")
     ap.add_argument("--ha", action="store_true",
                     help="split-brain mode: two scheduler replicas under "
                          "leader election share each cell's cluster; adds "
