@@ -74,10 +74,12 @@ proto:
 bench:
 	python bench.py
 
-# seconds-scale bench leg (cold-start + AOT first-bind probes + cfg1/2)
-# on the CPU backend: writes a schema-versioned perf artifact and gates
-# it against the newest PRIOR artifact via tools/bench_diff.py — the
-# fast continuous-regression check `make check` runs (docs/PERFORMANCE.md)
+# seconds-scale bench leg (cold-start + AOT first-bind probes + cfg1/2
+# + churn-smoke + the spmd-smoke SPMD megaround cell: mesh parity,
+# per-shard upload economy, sharded prewarm) on the CPU backend: writes
+# a schema-versioned perf artifact and gates it against the newest
+# PRIOR artifact via tools/bench_diff.py — the fast
+# continuous-regression check `make check` runs (docs/PERFORMANCE.md)
 bench-smoke:
 	@prior=$$(ls -t artifacts/bench/*.json 2>/dev/null | head -1); \
 	NHD_BENCH_PLATFORM=cpu NHD_BENCH_SMOKE=1 python bench.py || exit 1; \

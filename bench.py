@@ -600,6 +600,62 @@ def bench_first_bind_aot(platform: str) -> dict:
     }
 
 
+def bench_spmd(platform: str, smoke: bool) -> tuple:
+    """cfg6 SPMD leg (docs/PERFORMANCE.md "SPMD megaround"): the sharded
+    fused megaround driven end to end in a FRESH subprocess — the probe
+    forces a virtual N-device mesh via XLA_FLAGS, which must not leak
+    into this process (with >1 visible device every other leg would
+    silently go SPMD and stop being comparable to prior artifacts). On
+    CPU CI the shape is scaled down; the tunnel runs it full-scale via
+    NHD_SPMD_PODS/NODES/DEVICES. The probe itself asserts bit-exact
+    parity vs the single-device solver, O(changed rows) mesh uploads
+    with zero wholesale fallbacks, and a compiles-flat sharded prewarm —
+    a violated claim is a probe failure, not a quietly worse number.
+    Returns (config name, record)."""
+    import subprocess
+
+    n_dev = int(os.environ.get("NHD_SPMD_DEVICES", "8"))
+    n_pods = int(os.environ.get(
+        "NHD_SPMD_PODS", "512" if smoke else "4096"
+    ))
+    n_nodes = int(os.environ.get(
+        "NHD_SPMD_NODES", "256" if smoke else "1024"
+    ))
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n_dev}"
+            ).strip()
+    p = subprocess.run(
+        [sys.executable, "-m", "nhd_tpu.parallel.spmd_bench",
+         "--pods", str(n_pods), "--nodes", str(n_nodes),
+         "--devices", str(n_dev)],
+        capture_output=True, text=True, env=env, timeout=2400,
+    )
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"spmd probe failed: {p.stderr.strip()[-600:]}"
+        )
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    name = "spmd-smoke" if smoke else "cfg6:4kx1k-spmd"
+    s = rec["spmd"]
+    _log(
+        f"bench[{name}]: {n_pods} pods x {n_nodes} nodes over a "
+        f"{n_dev}-device mesh -> placed {rec['placed']} in "
+        f"{rec['wall']:.3f}s (rounds={rec['rounds']}, "
+        f"solve={rec['phases']['solve']:.3f}s); parity bit-exact; churn "
+        f"upload {s['rows_uploaded']:.0f} rows vs budget "
+        f"{s['upload_budget']:.0f} ({s['rows_per_round']}/round, "
+        f"{s['wholesale_uploads']:.0f} wholesale); prewarm "
+        f"{s['prewarm_loaded']} program(s), {s['mesh_programs_loaded']} "
+        f"sharded, compiles flat"
+    )
+    return name, rec
+
+
 def bench_daemon(n_pods: int = 150) -> None:
     """Daemon-mode steady-state create→bind latency: the REAL process
     harness — controller + scheduler + RPC + metrics threads from
@@ -837,6 +893,12 @@ def main() -> None:
             sim_seconds=3, groups=["default", "edge"], tile_nodes=512,
             round_dt=1.0,
         )
+        # seconds-scale SPMD smoke: parity + upload economy + sharded
+        # prewarm of the mesh megaround, subprocess-isolated (a smoke
+        # probe failure is fatal, same stance as first-bind)
+        if not os.environ.get("NHD_BENCH_SKIP_SPMD"):
+            name, rec = bench_spmd(platform, smoke=True)
+            configs[name] = rec
 
     if not smoke:
         # cfg3: NIC-saturated contention shape (places ~4k of 10k — the
@@ -882,6 +944,18 @@ def main() -> None:
                 sim_seconds=60,
                 groups=["default", "edge", "batch", "fed1", "fed2"],
             )
+
+        # cfg6: the SPMD megaround leg (ISSUE 11) — sharded solve
+        # parity, mesh delta-upload economy and sharded AOT prewarm in a
+        # subprocess-isolated virtual mesh; full-scale shape for the
+        # tunnel via NHD_SPMD_PODS/NODES/DEVICES. Reported-but-skipped
+        # on failure like the other full-bench probe legs.
+        if not os.environ.get("NHD_BENCH_SKIP_SPMD"):
+            try:
+                name, rec = bench_spmd(platform, smoke=False)
+                configs[name] = rec
+            except Exception as exc:
+                _log(f"bench[cfg6-spmd]: probe failed (leg skipped): {exc}")
 
     headline = {
         # the smoke leg's headline is cfg2 under its own metric name, so

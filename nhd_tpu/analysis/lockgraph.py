@@ -26,7 +26,7 @@ Rules emitted:
   contains both L→M and M→L. Reported at both witness sites.
 * **NHD211** blocking call while a lock is held: an unbounded
   ``.get()``/``.join()``/``.wait()``, a socket ``recv``/``accept``, or a
-  solver/pjit entry point (``solve_bucket``/``solve_bucket_sharded``)
+  solver/pjit entry point (``solve_bucket``/``solve_bucket_ranked_sharded``)
   executes — directly or through the call graph — under a held lock.
   ``Condition.wait`` releases *its own* lock, so that lock is subtracted
   before judging.
@@ -58,7 +58,9 @@ from nhd_tpu.analysis.core import Finding, ModuleSource, _dotted
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}
 # names that dispatch a (potentially unbounded) sharded/pjit solve — the
 # scheduler's own "collective rendezvous" entry points
-_SOLVER_ENTRYPOINTS = {"solve_bucket", "solve_bucket_sharded"}
+_SOLVER_ENTRYPOINTS = {
+    "solve_bucket", "solve_bucket_ranked", "solve_bucket_ranked_sharded",
+}
 _MAX_CHAIN = 4          # witness chains are truncated for readability
 
 

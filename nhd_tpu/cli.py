@@ -36,6 +36,7 @@ def build_threads(
     shards: int = 1,
     shard_peers=None,
     on_demote=None,
+    mesh=None,
 ):
     """Wire up the thread set for a backend; returns (threads, rpc_queue).
 
@@ -75,7 +76,7 @@ def build_threads(
 
     scheduler = Scheduler(
         backend, watch_q, rpc_q, respect_busy=respect_busy,
-        elector=elector, sharded=sharded,
+        elector=elector, sharded=sharded, mesh=mesh,
     )
     controller = Controller(backend, watch_q, elector=sharded or elector)
     threads = [controller, scheduler]
@@ -250,6 +251,14 @@ def main(argv=None) -> int:
                              "the deterministic rendezvous shard assignment "
                              "and handoff protocol run over; requires "
                              "--shards > 1 and a stable --ha-identity")
+    parser.add_argument("--mesh", default=os.environ.get("NHD_MESH", "auto"),
+                        help="multi-chip SPMD solve posture: 'auto' "
+                             "(default — shard the fused megaround over "
+                             "every local device when more than one "
+                             "exists), an explicit device count N, or "
+                             "'off' to force single-device solves "
+                             "(docs/PERFORMANCE.md 'SPMD megaround'; env "
+                             "NHD_MESH)")
     parser.add_argument("--prewarm", action="store_true",
                         help="pre-compile every solver program in the AOT "
                              "StableHLO artifact cache (NHD_AOT_DIR, default "
@@ -386,6 +395,7 @@ def main(argv=None) -> int:
         backend, rpc_port=args.rpc_port, metrics_port=args.metrics_port,
         trace_dir=args.trace_out, ha_identity=ha_identity,
         shards=args.shards, shard_peers=shard_peers, on_demote=on_demote,
+        mesh=args.mesh,
     )
     for t in threads:
         t.start()

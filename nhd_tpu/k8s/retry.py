@@ -118,6 +118,24 @@ class ApiCounters:
         "device_state_resident_age_seconds":
             ("gauge", "Seconds since the resident cluster state was "
                       "last fully rebuilt"),
+        # SPMD mesh plane (kernel.get_ranked_solver_mesh +
+        # device_state._scatter_mesh, docs/PERFORMANCE.md "SPMD
+        # megaround"): the sharded-solve posture and its upload economy
+        "mesh_devices":
+            ("gauge", "Devices in the scheduler's solve mesh "
+                      "(0 = single-device posture)"),
+        "mesh_shard_rows":
+            ("gauge", "Padded node rows resident per mesh shard"),
+        "mesh_solves_total":
+            ("counter", "Fused ranked megarounds dispatched SPMD over "
+                        "the mesh"),
+        "mesh_rows_uploaded_total":
+            ("counter", "Node rows scattered into mesh-sharded resident "
+                        "arrays via per-shard delta scatters"),
+        "mesh_wholesale_uploads_total":
+            ("counter", "Mesh resident-state uploads that fell back to "
+                        "a wholesale re-shard (storm-sized delta or "
+                        "NHD_DEVICE_DELTA=0)"),
         # HA plane (k8s/lease.py, docs/RESILIENCE.md "HA & fencing").
         # Under the sharded federation the single-leader gauges
         # generalize: ha_is_leader means "holds at least one shard" and

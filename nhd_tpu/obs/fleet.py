@@ -295,6 +295,9 @@ def build_fleet_payload(
             "device_state_deltas_total",
             "device_state_rows_uploaded_total",
             "device_state_full_rebuilds_total",
+            "mesh_solves_total",
+            "mesh_rows_uploaded_total",
+            "mesh_wholesale_uploads_total",
         ):
             total, seen = 0.0, False
             for v in views:
@@ -336,6 +339,17 @@ def build_fleet_payload(
         "full_rebuilds_total": counters.get(
             "device_state_full_rebuilds_total", 0
         ),
+        # SPMD mesh posture (ISSUE 11): sharded megarounds dispatched
+        # and the per-shard upload economy, fleet-summed like the rest
+        "mesh": {
+            "solves_total": counters.get("mesh_solves_total", 0),
+            "rows_uploaded_total": counters.get(
+                "mesh_rows_uploaded_total", 0
+            ),
+            "wholesale_uploads_total": counters.get(
+                "mesh_wholesale_uploads_total", 0
+            ),
+        },
     }
 
     shard_epochs: Dict[str, int] = {}

@@ -5,14 +5,15 @@ scheduler the data-parallel axis is the *cluster itself* (SURVEY §2): the
 feasibility tensor [types × nodes × combos × picks] shards along the node
 axis, pod types replicate, and selection is a cross-device reduction.
 
-* sharding  — pjit solve over a 1-D ``nodes`` Mesh (single- or multi-host)
+* sharding  — the fused solve+rank megaround over a 1-D ``nodes`` Mesh
+  (single- or multi-host), plus the NHD_MESH operator-knob resolver
 * multihost — jax.distributed bootstrap helpers for DCN-spanning meshes
 """
 
 from nhd_tpu.parallel.sharding import (
-    get_sharded_solver,
     make_mesh,
-    solve_bucket_sharded,
+    resolve_mesh_spec,
+    solve_bucket_ranked_sharded,
 )
 
-__all__ = ["get_sharded_solver", "make_mesh", "solve_bucket_sharded"]
+__all__ = ["make_mesh", "resolve_mesh_spec", "solve_bucket_ranked_sharded"]

@@ -67,8 +67,9 @@ def initialize(
 
 def node_slice(n_nodes: int, process_id: int, process_count: int) -> slice:
     """The contiguous node-index range a given process owns under a 1-D
-    nodes mesh (block layout, matching sharding.solve_bucket_sharded
-    padding). Exposed by rank so a survivor can compute a DEAD rank's
+    nodes mesh (block layout, matching the fused sharded megaround's
+    padding — sharding.solve_bucket_ranked_sharded). Exposed by rank so
+    a survivor can compute a DEAD rank's
     region for elastic takeover (tests/test_distributed.py failure leg)."""
     per = -(-n_nodes // process_count)  # ceil division
     start = per * process_id

@@ -5,25 +5,40 @@ embarrassingly parallel along the *node* axis — the natural mesh layout for
 a scheduler (SURVEY §2: "data parallelism over pods and nodes"). Node-state
 arrays shard along axis 0 of a 1-D ``nodes`` mesh; pod-type arrays are
 replicated (they are tiny after gang dedup). Each device evaluates its node
-shard; the per-(type, node) outputs come back sharded the same way, and the
-final argmax-over-nodes selection is a cheap reduction XLA lowers onto the
-mesh (an all-gather of [T, N_shard] rows over ICI).
+shard; the fused megaround's top-R rank reduction lowers onto the mesh
+(one all-gather class collective over ICI) and the packed [9, T, R]
+decision tensor comes back replicated.
+
+The production program is kernel.get_ranked_solver_mesh — the SAME fused
+solve+rank megaround the single-device path runs, jitted with node-sharded
+in/out shardings, reached through the one kernel.dispatch_ranked seam
+(which also serves its AOT StableHLO export/prewarm). The legacy unfused
+``get_sharded_solver`` + separate-ranker split is gone: intermediate
+[T, N] SolveOut tensors no longer materialize between dispatches on a
+mesh any more than they do on one chip.
 
 Scaling shape for the 100k federation config (BASELINE config 5): shard
 nodes over the mesh, stream pod-type chunks through (solver/streaming.py).
+Operator knob: ``NHD_MESH`` / ``nhd-tpu --mesh`` (auto / N / off),
+resolved by ``resolve_mesh_spec`` below.
 """
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from nhd_tpu.solver.combos import get_tables
-from nhd_tpu.solver.kernel import SolveOut, _pad_pow2, _solve, pad_nodes
+from nhd_tpu.solver.kernel import (
+    _ARG_ORDER,
+    _pad_pow2,
+    dispatch_ranked,
+    mesh_shardings,
+    pad_nodes,
+    padded_args,
+)
 
 
 def make_mesh(devices=None, axis: str = "nodes") -> Mesh:
@@ -32,66 +47,76 @@ def make_mesh(devices=None, axis: str = "nodes") -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
-# sharding layout per solver argument: True → shard along the node axis
-_NODE_ARGS = [True] * 14 + [False] * 9
+def resolve_mesh_spec(spec):
+    """Operator mesh knob (``NHD_MESH`` / ``--mesh``) → a BatchScheduler
+    ``mesh`` argument:
+
+    * ``"auto"`` (default) — shard over every local device whenever more
+      than one exists (BatchScheduler._resolve_mesh)
+    * ``"off"`` / ``"0"`` / ``"none"`` — force the single-device path
+    * ``"N"`` (an integer) — an explicit 1-D ``nodes`` mesh over the
+      first N local devices; fewer available devices is a refused
+      misconfiguration, not a silent downgrade
+    """
+    if spec is None:
+        return "auto"
+    if isinstance(spec, Mesh):
+        return spec
+    s = str(spec).strip().lower()
+    if s in ("", "auto"):
+        return "auto"
+    if s in ("off", "0", "none"):
+        return None
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"mesh spec must be 'auto', 'off'/'0'/'none' or a device "
+            f"count, got {spec!r}"
+        )
+    devices = jax.local_devices()
+    if n < 2:
+        return None
+    if n > len(devices):
+        raise ValueError(
+            f"mesh spec asks for {n} devices but only {len(devices)} are "
+            f"local (JAX_PLATFORMS/XLA_FLAGS decide the device set)"
+        )
+    return make_mesh(devices[:n])
 
 
-@lru_cache(maxsize=None)
-def get_sharded_solver(n_groups: int, n_numa: int, max_nic: int, mesh: Mesh):
-    """A pjit-compiled solver with node-sharded inputs/outputs on *mesh*."""
-    tables = get_tables(n_groups, n_numa, max_nic)
-    node_spec = NamedSharding(mesh, P("nodes"))
-    repl_spec = NamedSharding(mesh, P())
-    in_shardings = tuple(
-        node_spec if is_node else repl_spec for is_node in _NODE_ARGS
-    )
-    # outputs are [T, N]: sharded along the node axis (dim 1)
-    out_sharding = NamedSharding(mesh, P(None, "nodes"))
-
-    def fn(*args):
-        return _solve(tables, *args)
-
-    return jax.jit(
-        fn,
-        in_shardings=in_shardings,
-        out_shardings=SolveOut(*([out_sharding] * len(SolveOut._fields))),
-    )
+def _replicated_to_host(out) -> np.ndarray:
+    """A replicated mesh output as one OWNED host copy (np.array — a
+    zero-copy view would dangle once the jax array is dropped at return,
+    the solver/batch.py bucket_out rule). Single-controller arrays are
+    fully addressable; in multi-controller SPMD every process still
+    holds a full copy per local device — read shard 0 instead of
+    demanding global addressability."""
+    if getattr(out, "is_fully_addressable", True):
+        return np.array(out)
+    return np.array(out.addressable_shards[0].data)
 
 
-def solve_bucket_sharded(cluster, pods, mesh: Optional[Mesh] = None) -> SolveOut:
-    """Sharded counterpart of kernel.solve_bucket: same inputs/outputs,
-    node axis split across the mesh devices."""
+def solve_bucket_ranked_sharded(
+    cluster, pods, R: Optional[int] = None, mesh: Optional[Mesh] = None,
+) -> np.ndarray:
+    """Sharded counterpart of kernel.solve_bucket_ranked: the fused
+    solve+rank megaround over *mesh*, same packed [9, Tp, R] int32
+    contract, node axis split across the mesh devices. ``R`` defaults to
+    the padded node count (every node ranked — the parity-harness
+    posture; production callers pass their rank budget).
+
+    Bit-exactness with the single-device fused program is the contract
+    (tests/test_spmd.py, tests/test_distributed.py): same program text,
+    GSPMD only re-partitions it.
+    """
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
     T, N = pods.n_types, cluster.n_nodes
-
-    # pad N to a multiple of the mesh size (and a power-of-two bucket so
-    # re-solves reuse the jit cache); padded rows are inactive
     Np = pad_nodes(N, n_dev)
     Tp = _pad_pow2(T)
-
-    def pad(a, size):
-        if a.shape[0] == size:
-            return a
-        return np.concatenate(
-            [a, np.zeros((size - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
-        )
-
-    node_args = [
-        pad(cluster.numa_nodes, Np), pad(cluster.smt, Np), pad(cluster.active, Np),
-        pad(cluster.maintenance, Np), pad(cluster.busy, Np), pad(cluster.gpuless, Np),
-        pad(cluster.group_mask, Np), pad(cluster.hp_free, Np),
-        pad(cluster.cpu_free, Np), pad(cluster.gpu_free, Np),
-        pad(cluster.nic_count, Np), pad(cluster.nic_free, Np),
-        pad(cluster.nic_sw, Np), pad(cluster.gpu_free_sw, Np),
-    ]
-    pod_args = [
-        pad(pods.cpu_dem_smt, Tp), pad(pods.cpu_dem_raw, Tp), pad(pods.gpu_dem, Tp),
-        pad(pods.rx, Tp), pad(pods.tx, Tp), pad(pods.hp, Tp),
-        pad(pods.needs_gpu, Tp), pad(pods.map_pci, Tp), pad(pods.group_mask, Tp),
-    ]
-
-    solver = get_sharded_solver(pods.G, cluster.U, cluster.K, mesh)
+    R = min(R or Np, Np)
+    args = padded_args(cluster, pods, Tp, Np)
 
     multiproc = any(
         d.process_index != jax.process_index() for d in mesh.devices.flat
@@ -100,30 +125,23 @@ def solve_bucket_sharded(cluster, pods, mesh: Optional[Mesh] = None) -> SolveOut
         # multi-controller SPMD: every process holds the SAME global numpy
         # state (the scheduler's host mirror is replicated by contract) and
         # jit cannot shard raw numpy across processes — build global Arrays
-        # explicitly, then gather the compact decision tensors back to
-        # every host
-        from jax.experimental import multihost_utils
-
-        node_spec = NamedSharding(mesh, P("nodes"))
-        repl_spec = NamedSharding(mesh, P())
+        # explicitly before the one fused dispatch
+        node_spec, repl_spec = mesh_shardings(mesh)
+        n_node = len(_ARG_ORDER)
 
         def globalize(a, spec):
             return jax.make_array_from_callback(
                 a.shape, spec, lambda idx: a[idx]
             )
 
-        out = solver(
-            *[globalize(a, node_spec) for a in node_args],
-            *[globalize(a, repl_spec) for a in pod_args],
-        )
-        # one pytree allgather (a single cross-host collective round), and
-        # np.array copies per this function's no-dangling-views rule
-        gathered = multihost_utils.process_allgather(
-            tuple(x[:T, :N] for x in out), tiled=True
-        )
-        return SolveOut(*(np.array(x) for x in gathered))
+        args = [
+            globalize(a, node_spec if i < n_node else repl_spec)
+            for i, a in enumerate(args)
+        ]
 
-    out = solver(*node_args, *pod_args)
-    # np.array (copy): a zero-copy view would dangle once the jax arrays
-    # are dropped at return (see solver/batch.py bucket_out note)
-    return SolveOut(*(np.array(x[:T, :N]) for x in out))
+    out = dispatch_ranked(
+        pods.G, cluster.U, cluster.K, R, Tp, Np, args, mesh=mesh
+    )
+    # np.array (copy): a zero-copy view would dangle once the jax array
+    # is dropped at return (see solver/batch.py bucket_out note)
+    return _replicated_to_host(out)

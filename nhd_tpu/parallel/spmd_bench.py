@@ -1,0 +1,289 @@
+"""cfg6 SPMD bench probe: the sharded fused megaround, end to end.
+
+Runs in a FRESH subprocess (bench.py spawns it with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` on CPU CI — the
+virtual mesh must not leak into the parent bench's backend, and with >1
+visible device the parent's every leg would silently go SPMD). On a real
+TPU slice the same probe runs against the physical devices; the shape is
+parameterized (``NHD_SPMD_PODS`` / ``NHD_SPMD_NODES`` /
+``NHD_SPMD_DEVICES``) so the tunnel can run it full-scale.
+
+Three identical drives of the same workload prove the three SPMD claims:
+
+1. **parity** — every bucket's fused ranked solve over the mesh is
+   bit-exact with the single-device fused program (the dryrun-harness
+   assertion, now a bench gate);
+2. **timed** (jit-warm) — the cfg6 figure: a gang schedule through the
+   mesh-sharded device-resident path, then steady churn rounds whose
+   per-round upload is asserted O(changed rows) via the
+   ``nhd_device_state_*`` / ``nhd_mesh_*`` counters with ZERO wholesale
+   fallbacks;
+3. **prewarm** — restart-equivalent: live programs dropped, the AOT
+   cache alone prewarmed (sharded artifacts included), the same drive
+   replayed with the ``solve_ranked`` compile set provably flat.
+
+Prints exactly ONE JSON line (a bench config record with an ``spmd``
+section tools/bench_diff.py gates on); any violated claim raises — a
+broken mesh path must fail the bench, not ship a numberless artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+
+def _drive(sched, nodes, catalog, n_pods: int, churn_rounds: int):
+    """One deterministic workload pass: a gang batch through a
+    delta-built mesh context, then ``churn_rounds`` steady rounds of
+    node churn + small create batches folded in as row deltas. Shape
+    stability across drives is the contract (the prewarm leg replays
+    this exactly and asserts zero new solve_ranked programs)."""
+    from nhd_tpu.solver.batch import BatchItem
+    from nhd_tpu.solver.encode import ClusterDelta
+
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    ctx = sched.make_context(nodes, now=0.0, delta=delta)
+    items = [
+        BatchItem(("spmd", f"p{i}"), catalog[i % len(catalog)])
+        for i in range(n_pods)
+    ]
+    t0 = time.perf_counter()
+    results, stats = sched.schedule(ctx.nodes, items, context=ctx)
+    wall = time.perf_counter() - t0
+    placed = sum(1 for r in results if r.node)
+
+    names = list(nodes.keys())
+    churn_binds = 0
+    flip = max(len(names) // 16, 1)
+    for r in range(churn_rounds):
+        # deterministic node churn: toggle a rolling cordon window
+        for name in names[(r * flip) % len(names):][:flip]:
+            nodes[name].active = not nodes[name].active
+            delta.note(name)
+        sched.refresh_context(ctx, now=0.0)
+        # the same 64-request slice every round: identical type rows ->
+        # identical padded shapes -> one compiled program serves every
+        # churn round
+        small = [
+            BatchItem(("spmd", f"c{r}-{i}"), catalog[i % len(catalog)])
+            for i in range(64)
+        ]
+        sub, _ = sched.schedule(ctx.nodes, small, context=ctx)
+        churn_binds += sum(1 for x in sub if x.node)
+    return wall, placed, stats, results, churn_binds
+
+
+def run_probe(
+    n_pods: int, n_nodes: int, n_dev: int, churn_rounds: int = 4,
+    groups: Optional[List[str]] = None,
+) -> dict:
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from nhd_tpu.k8s.retry import API_COUNTERS
+    from nhd_tpu.obs.jitstats import JIT_STATS
+    from nhd_tpu.parallel.sharding import (
+        make_mesh, solve_bucket_ranked_sharded,
+    )
+    from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+    from nhd_tpu.solver import aot, kernel
+    from nhd_tpu.solver.batch import BatchScheduler
+    from nhd_tpu.solver.encode import encode_cluster, encode_pods
+
+    if len(jax.devices()) < n_dev:
+        raise RuntimeError(
+            f"spmd probe needs {n_dev} devices, host exposes "
+            f"{len(jax.devices())} (XLA_FLAGS not forwarded?)"
+        )
+    groups = groups or ["default", "edge"]
+    mesh = make_mesh(jax.devices()[:n_dev])
+    catalog = workload_mix(256, groups)
+    cache = tempfile.mkdtemp(prefix="nhd-spmd-bench-")
+    aot.reset()
+    aot.configure(directory=cache, save=True)
+    try:
+        # ---- 1. parity: mesh fused megaround == single-device ----
+        pnodes = cap_cluster(n_nodes, groups)
+        cluster = encode_cluster(pnodes, now=0.0)
+        R = kernel.rank_budget(1, cluster.n_nodes, accelerator=False)
+        for G, pods in sorted(
+            encode_pods(catalog[:64], cluster.interner).items()
+        ):
+            plain = np.asarray(kernel.solve_bucket_ranked(cluster, pods, R))
+            shard = solve_bucket_ranked_sharded(cluster, pods, R, mesh)
+            if not np.array_equal(plain, shard):
+                raise RuntimeError(
+                    f"SPMD parity violated: bucket G={G} mesh output "
+                    "diverges from the single-device fused program"
+                )
+
+        def fresh_sched():
+            return BatchScheduler(
+                respect_busy=False, register_pods=False,
+                device_state=True, mesh=mesh,
+            )
+
+        # ---- warm drive (untimed: compiles + AOT exports land) ----
+        _drive(fresh_sched(), cap_cluster(n_nodes, groups), catalog,
+               n_pods, churn_rounds)
+
+        # ---- 2. timed drive + churn upload economy ----
+        c0 = API_COUNTERS.snapshot()
+        wall, placed, stats, results, churn_binds = _drive(
+            fresh_sched(), cap_cluster(n_nodes, groups), catalog,
+            n_pods, churn_rounds,
+        )
+        c1 = API_COUNTERS.snapshot()
+        econ_rounds = stats.rounds  # the economy drive's round count
+        # the reported gang figure is the MIN over three identical
+        # drives: on CPU CI the mesh is N virtual devices time-slicing
+        # few cores, and a single sample's solve wall is dominated by OS
+        # scheduling (measured ±37% run-to-run at the cfg6 shape with
+        # identical code) — min-of-N is the standard low-noise estimator
+        # and keeps the bench_diff solve gate watching the program, not
+        # the scheduler. The churn economy above stays single-drive (its
+        # counters are deterministic).
+        for _ in range(2):
+            w2, p2, s2, r2, _cb = _drive(
+                fresh_sched(), cap_cluster(n_nodes, groups), catalog,
+                n_pods, 0,
+            )
+            if w2 < wall:
+                wall, placed, stats, results = w2, p2, s2, r2
+        rows_up = c1["device_state_rows_uploaded_total"] - (
+            c0["device_state_rows_uploaded_total"]
+        )
+        mesh_rows = c1["mesh_rows_uploaded_total"] - (
+            c0["mesh_rows_uploaded_total"]
+        )
+        deltas = c1["device_state_deltas_total"] - (
+            c0["device_state_deltas_total"]
+        )
+        rebuilds = c1["device_state_full_rebuilds_total"] - (
+            c0["device_state_full_rebuilds_total"]
+        )
+        wholesale = c1["mesh_wholesale_uploads_total"] - (
+            c0["mesh_wholesale_uploads_total"]
+        )
+        binds = placed + churn_binds
+        # O(changed rows): every uploaded row paid for by a row patch or
+        # a staged claim (2x slack for rows changing twice per round),
+        # plus any sanctioned rebuild's full rows — a wholesale re-shard
+        # per round (rounds x n_nodes regardless of changes) blows this
+        # by construction
+        rounds_total = econ_rounds + churn_rounds
+        budget = 2 * (deltas + binds) + rebuilds * n_nodes + (
+            rounds_total * 64
+        )
+        if rows_up > budget:
+            raise RuntimeError(
+                f"mesh upload is not O(changed rows): {rows_up:.0f} rows "
+                f"uploaded vs budget {budget:.0f} ({deltas:.0f} patches + "
+                f"{binds} binds + {rebuilds:.0f} rebuilds)"
+            )
+        if wholesale:
+            raise RuntimeError(
+                f"{wholesale:.0f} wholesale mesh re-uploads in a steady "
+                "run — the per-shard delta scatter is not engaging"
+            )
+
+        # ---- 3. restart-equivalent prewarm, compiles flat ----
+        aot.AOT.drain()
+        kernel.get_ranked_solver.cache_clear()
+        kernel.get_ranked_solver_mesh.cache_clear()
+        kernel.get_solver.cache_clear()
+        JIT_STATS.reset()
+        aot.reset()
+        aot.configure(directory=cache, save=False)
+        summary = aot.prewarm()
+        mesh_loaded = sum(1 for k in summary["keys"] if "_m" in k)
+        if summary["loaded"] == 0 or mesh_loaded == 0:
+            raise RuntimeError(
+                f"prewarm loaded {summary['loaded']} programs "
+                f"({mesh_loaded} sharded) — sharded AOT export/prewarm "
+                "is not engaging"
+            )
+        warm = JIT_STATS.snapshot()
+        warm_ranked = {
+            k for k in warm["shapes"] if k.startswith("solve_ranked:")
+        }
+        _drive(fresh_sched(), cap_cluster(n_nodes, groups), catalog,
+               n_pods, churn_rounds)
+        steady = JIT_STATS.snapshot()
+        escaped = sorted(
+            k for k in steady["shapes"]
+            if k.startswith("solve_ranked:") and k not in warm_ranked
+        )
+        if escaped:
+            raise RuntimeError(
+                f"sharded programs re-traced after prewarm: {escaped} "
+                f"(prewarmed: {sorted(warm_ranked)})"
+            )
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+    return {
+        "wall": wall,
+        "placed": placed,
+        "speedup": 0.0,
+        "rounds": stats.rounds,
+        "phases": {
+            "solve": stats.solve_seconds,
+            "select": stats.select_seconds,
+            "assign": stats.assign_seconds,
+            **stats.phases,
+        },
+        "p99_bind_ms": stats.bind_latency_percentile(results, 99) * 1e3,
+        "spmd": {
+            "devices": n_dev,
+            "n_pods": n_pods,
+            "n_nodes": n_nodes,
+            "parity_ok": True,
+            "prewarm_ok": True,
+            "prewarm_loaded": summary["loaded"],
+            "mesh_programs_loaded": mesh_loaded,
+            "rows_uploaded": rows_up,
+            "mesh_rows_uploaded": mesh_rows,
+            "upload_budget": budget,
+            "rows_per_round": round(rows_up / max(rounds_total, 1), 1),
+            "wholesale_uploads": wholesale,
+            "churn_binds": churn_binds,
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m nhd_tpu.parallel.spmd_bench", description=__doc__,
+    )
+    ap.add_argument("--pods", type=int, default=512)
+    ap.add_argument("--nodes", type=int, default=256)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--churn-rounds", type=int, default=4)
+    args = ap.parse_args(argv)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        from nhd_tpu.utils import force_cpu_backend
+
+        force_cpu_backend()
+    rec = run_probe(
+        args.pods, args.nodes, args.devices, args.churn_rounds,
+    )
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    # canonical-module main (same dual-module trap as solver/aot.py)
+    from nhd_tpu.parallel.spmd_bench import main as _canonical_main
+
+    sys.exit(_canonical_main())

@@ -245,6 +245,7 @@ class Scheduler(threading.Thread):
         clock: Callable[[], float] = time.time,
         recorder: Optional[FlightRecorder] = None,
         slo: Optional[obs_slo.SloTracker] = None,
+        mesh: Optional[str] = None,
     ):
         super().__init__(name="nhd-scheduler", daemon=True)
         self.logger = get_logger(__name__)
@@ -299,7 +300,26 @@ class Scheduler(threading.Thread):
         self.nodes: Dict[str, HostNode] = {}
         self.pod_state: Dict[Tuple[str, str], dict] = {}
         self.failed_schedule_count = 0
-        self.batch = BatchScheduler(respect_busy=respect_busy)
+        # multi-chip posture (docs/PERFORMANCE.md "SPMD megaround"):
+        # --mesh / NHD_MESH decides whether the solve shards over a
+        # device mesh — "auto" (every local device when >1), an explicit
+        # device count, or "off". Resolved ONCE here and handed to both
+        # the batch scheduler and the streaming tiler, so every solve
+        # path (and its persistent device-resident contexts) shares one
+        # posture.
+        from nhd_tpu.parallel.sharding import resolve_mesh_spec
+
+        self._mesh = resolve_mesh_spec(
+            mesh if mesh is not None else os.environ.get("NHD_MESH", "auto")
+        )
+        if self._mesh not in ("auto", None):
+            self.logger.warning(
+                f"solve mesh: {self._mesh.devices.size} device(s) "
+                f"(--mesh/NHD_MESH)"
+            )
+        self.batch = BatchScheduler(
+            respect_busy=respect_busy, mesh=self._mesh
+        )
         self._stream = None   # built lazily past STREAM_NODE_THRESH
         # incremental cluster state (NHD_DELTA_STATE): the ClusterDelta
         # over self.nodes plus its delta-built ScheduleContext, reused
@@ -745,6 +765,7 @@ class Scheduler(threading.Thread):
                     placement=STREAM_PLACEMENT,
                     respect_busy=self.batch.respect_busy,
                     persistent=DELTA_STATE,
+                    mesh=self._mesh,
                 )
             results, bstats = self._stream.schedule(nodes_view, batch_items)
         else:

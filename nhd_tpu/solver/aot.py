@@ -56,7 +56,11 @@ _VERSIONED_FIELDS = ("jax_version", "jaxlib_version", "fingerprint")
 class ShapeKey:
     """Identity of one compiled solver program: kind + every dim the
     program specializes on (the same dims kernel.ranked_shape_key puts
-    in the jit-stats key)."""
+    in the jit-stats key). ``mesh``: the kernel.mesh_desc of a sharded
+    program ("nodes8"), "" for single-device — a mesh program is a
+    DIFFERENT compilation with baked-in shardings, so it caches, exports
+    and prewarm-loads under its own key (and is only loadable on a host
+    exposing at least that many devices)."""
 
     kind: str  # "ranked" — the fused solve+rank production program
     G: int
@@ -65,11 +69,13 @@ class ShapeKey:
     R: int
     Tp: int
     Np: int
+    mesh: str = ""
 
     def name(self) -> str:
         return (
             f"{self.kind}_g{self.G}_u{self.U}_k{self.K}"
             f"_r{self.R}_t{self.Tp}_n{self.Np}"
+            + (f"_m{self.mesh}" if self.mesh else "")
         )
 
 
@@ -290,10 +296,17 @@ class AotCache:
 
     def prewarm(self) -> dict:
         """Deserialize, compile and install every valid artifact in the
-        cache directory; quarantine the rest. Returns a summary dict
-        (loaded / quarantined / seconds / keys)."""
+        cache directory; quarantine the rest. Mesh artifacts (sharded
+        programs) install under their mesh-qualified key when this host
+        exposes enough devices — too few devices SKIPS the artifact
+        (it is not stale, just inapplicable here: a single-chip restart
+        must not quarantine the slice's programs). Returns a summary
+        dict (loaded / quarantined / skipped / seconds / keys)."""
         t0 = time.perf_counter()
-        summary = {"loaded": 0, "quarantined": 0, "keys": [], "seconds": 0.0}
+        summary = {
+            "loaded": 0, "quarantined": 0, "skipped": 0,
+            "keys": [], "seconds": 0.0,
+        }
         directory = self.directory()
         if not (self.enabled() and os.path.isdir(directory)):
             summary["seconds"] = time.perf_counter() - t0
@@ -303,7 +316,13 @@ class AotCache:
         from jax import export as jexport
 
         from nhd_tpu.obs.jitstats import JIT_STATS
-        from nhd_tpu.solver.kernel import ranked_shape_key
+        from nhd_tpu.solver.kernel import (
+            _ARG_ORDER,
+            _POD_ARG_ORDER,
+            mesh_shardings,
+            parse_mesh_desc,
+            ranked_shape_key,
+        )
 
         for fname in sorted(os.listdir(directory)):
             if not fname.endswith(".json"):
@@ -321,18 +340,44 @@ class AotCache:
                 self._quarantine(meta_path, why)
                 summary["quarantined"] += 1
                 continue
+            desc = meta.get("mesh", "")
+            parsed = parse_mesh_desc(desc)
+            # LOCAL devices, like every other mesh consumer
+            # (resolve_mesh_spec, batch._resolve_mesh): on a
+            # multi-controller slice jax.devices() counts every host's
+            # chips, the gate would pass, and the numpy warm-up below
+            # would fail — quarantining artifacts the docstring promises
+            # to skip
+            if parsed is not None and parsed[1] > len(jax.local_devices()):
+                summary["skipped"] += 1
+                continue
             try:
                 key = ShapeKey(
                     meta["kind"], meta["G"], meta["U"], meta["K"],
-                    meta["R"], meta["Tp"], meta["Np"],
+                    meta["R"], meta["Tp"], meta["Np"], desc,
                 )
                 bin_path = meta_path[: -len(".json")] + ".stablehlo.bin"
                 with open(bin_path, "rb") as fh:
                     blob = fh.read()
                 exported = jexport.deserialize(bytearray(blob))
                 # one wrapper per DISTINCT artifact, installed once in
-                # the program table — not a per-call construction
-                prog = jax.jit(exported.call)  # nhdlint: ignore[NHD104]
+                # the program table — not a per-call construction. A
+                # sharded program re-binds to the live mesh via explicit
+                # in_shardings (the exported module bakes the LAYOUT but
+                # the call needs this host's device assignment).
+                if parsed is not None:
+                    from nhd_tpu.parallel.sharding import make_mesh
+
+                    axis, n_dev = parsed
+                    mesh = make_mesh(jax.local_devices()[:n_dev], axis=axis)
+                    node_spec, repl_spec = mesh_shardings(mesh)
+                    prog = jax.jit(  # nhdlint: ignore[NHD104]
+                        exported.call,
+                        in_shardings=(node_spec,) * len(_ARG_ORDER)
+                        + (repl_spec,) * len(_POD_ARG_ORDER),
+                    )
+                else:
+                    prog = jax.jit(exported.call)  # nhdlint: ignore[NHD104]
                 zeros = tuple(
                     np.zeros(a.shape, a.dtype) for a in exported.in_avals
                 )
@@ -349,7 +394,9 @@ class AotCache:
             # as a cache HIT: record the key now, inside the warmup
             JIT_STATS.record_use(
                 "solve_ranked",
-                ranked_shape_key(key.G, key.U, key.K, key.R, key.Tp, key.Np),
+                ranked_shape_key(
+                    key.G, key.U, key.K, key.R, key.Tp, key.Np, key.mesh
+                ),
             )
             summary["loaded"] += 1
             summary["keys"].append(key.name())

@@ -669,11 +669,16 @@ class BatchScheduler:
 
     def _schedule_serial(
         self, nodes, items, indices, results, stats, now, apply
-    ) -> None:
+    ) -> set:
         """Oracle-driven sequential scheduling for combo-oversized pods
-        (reference-exact semantics; claims hit the HostNode mirror)."""
+        (reference-exact semantics; claims hit the HostNode mirror).
+        Returns the TOUCHED node names — winners plus busy-stamped
+        failed attempts (set_busy lands before the assignment can
+        fail) — so delta-maintained callers patch every mutated row,
+        not just the claimed ones."""
         from nhd_tpu.sim.requests import request_to_topology
 
+        touched: set = set()
         for i in indices:
             item = items[i]
             m = oracle_find_node(
@@ -685,6 +690,7 @@ class BatchScheduler:
                 results[i] = BatchAssignment(item.key, m.node, m.mapping)
                 continue
             node = nodes[m.node]
+            touched.add(m.node)
             try:
                 top = item.topology or request_to_topology(item.request)
                 node.set_busy(now)
@@ -700,6 +706,7 @@ class BatchScheduler:
                 node.add_scheduled_pod(item.key[1], item.key[0], top)
             results[i] = BatchAssignment(item.key, m.node, m.mapping, nic_list)
             stats.scheduled += 1
+        return touched
 
     def make_context(
         self, nodes: Dict[str, HostNode], *, now: Optional[float] = None,
@@ -871,14 +878,20 @@ class BatchScheduler:
                 "context was built for a different nodes dict"
             )
         node_list = list(nodes.values())
-        cluster = (
-            context.cluster if context is not None
-            # contextless one-shot batch (bench/tests): the production
-            # round paths reuse a delta-built context instead
-            else encode_cluster(nodes, now=now)  # nhdlint: ignore[NHD108]
-        )
-        if context is None and not self.respect_busy:
-            cluster.busy[:] = False
+        # contextless one-shot batch (bench/tests): the encode routes
+        # through an EPHEMERAL ClusterDelta — its init rebuild is the
+        # sanctioned encode chokepoint (NHD108), and the serial
+        # oversized pre-pass below folds its claims back in as O(winner)
+        # row patches instead of a second full re-encode. The production
+        # round paths pass a persistent delta-built context instead.
+        ephemeral: Optional[ClusterDelta] = None
+        if context is not None:
+            cluster = context.cluster
+        else:
+            ephemeral = ClusterDelta(
+                nodes, now=now, respect_busy=self.respect_busy
+            )
+            cluster = ephemeral.arrays
         # per-shape phase attribution key: the (U, K, node-count) bucket
         # this batch's programs specialize on
         stats.shape_hint = f"U{cluster.U}_K{cluster.K}_N{len(node_list)}"
@@ -923,26 +936,27 @@ class BatchScheduler:
             # over lower-indexed tractable pods — a documented exception to
             # the lowest-index conflict rule (every claim is still feasible
             # when made; single-pod batches are unaffected)
-            self._schedule_serial(
+            touched = self._schedule_serial(
                 nodes, items, oversized, results, stats, now, apply
             )
             pending = pending[~np.isin(pending, oversized)]
             if apply and context is not None:
-                # the serial claims touched O(winners) rows: fold them in
-                # as delta patches + a device row scatter — the
-                # get-or-apply-deltas form of the full re-encode below
-                for i in oversized:
-                    r = results[i]
-                    if r is not None and r.node is not None:
-                        context.delta.note(r.node)
+                # the serial pass touched O(winners) rows (busy-stamped
+                # failures included): fold them in as delta patches + a
+                # device row scatter — the get-or-apply-deltas form of
+                # the contextless path below
+                context.delta.note_all(touched)
                 self.refresh_context(context, now=now)
-            elif apply:  # serial claims mutated the mirror: re-project
-                # (contextless one-shot batch, not a per-round hot path)
-                cluster = encode_cluster(  # nhdlint: ignore[NHD108]
-                    nodes, now=now, interner=cluster.interner
-                )
-                if not self.respect_busy:
-                    cluster.busy[:] = False
+            elif apply:
+                # contextless: the serial mutations fold into the
+                # ephemeral delta as O(touched) row patches
+                # (bit-identical to a re-encode by the delta parity
+                # contract; the arrays object keeps its identity).
+                # Device state is built below, from the already-patched
+                # arrays.
+                ephemeral.note_all(touched)
+                ephemeral.refresh(now)
+                ephemeral.drain_dirty()
 
         fast_future = None
         # deferred to round 0, right AFTER the first device dispatch: the
@@ -1207,7 +1221,7 @@ class BatchScheduler:
                 )
             for G, (pods, out) in ({} if spec_round else bucket_out).items():
                 # candidates arrive pre-ranked from the device (desc sel
-                # value = pref then low-node-index, kernel._get_ranker);
+                # value = pref then low-node-index, kernel._rank_body);
                 # valid prefix length per type:
                 n_cands = (out.val > 0).sum(axis=1)
 

@@ -9,11 +9,14 @@ rows), download is the compact per-(type, node) decision tensors
 (SURVEY §7 hard part 5: host↔device state coherence without re-upload).
 
 With a multi-device ``Mesh`` the resident arrays shard along the node axis
-(``NamedSharding(mesh, P("nodes"))``) and the solve runs SPMD via the
-pjit-compiled sharded solver (parallel/sharding.py) — this is the
-production multi-chip path (SURVEY §2 parallelism bullet 1): each device
-owns a node shard, per-round row scatters update only the owning shard,
-and the [T, N] decision tensors gather back over ICI.
+(``NamedSharding(mesh, P("nodes"))``) and the solve runs the SAME fused
+ranked megaround as the single-device path, SPMD over the mesh
+(kernel.get_ranked_solver_mesh via the one kernel.dispatch_ranked seam) —
+this is the production multi-chip path (SURVEY §2 parallelism bullet 1):
+each device owns a node shard, per-round row scatters update only the
+owning shard (shard-local index buckets through a shard_map — no
+cross-shard gathers), and only the packed [9, T, R] decision tensor
+gathers back over ICI.
 
 Scatter index vectors are padded to power-of-two lengths (repeating the
 last index — idempotent for row `set`) so round-to-round claim counts reuse
@@ -37,7 +40,6 @@ from nhd_tpu.solver.kernel import (
     _ARG_ORDER,
     _MUTABLE,
     _STATIC,
-    _get_ranker,
     _pad_pow2,
     _pad_rows_to as _pad_rows,
     dispatch_ranked,
@@ -61,6 +63,19 @@ def _pad_own(a: np.ndarray, size: int) -> np.ndarray:
     return _pad_rows(a, size)
 
 
+def _donate_default() -> bool:
+    """Whether row-scatter dispatches donate the resident arrays: on
+    accelerators the update is in place in HBM; the CPU backend ignores
+    donation (with a warning), so don't ask. One probe shared by the
+    single-device and mesh scatters — their donation behavior must not
+    drift."""
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False  # backend probe only decides donation, never
+        #               correctness
+
+
 def _delta_enabled() -> bool:
     """Row-scatter delta uploads (default on). NHD_DEVICE_DELTA=0 keeps
     the wholesale async re-upload instead — the right call on a relay
@@ -81,6 +96,36 @@ def _get_row_scatter(n_arrays: int, donate: bool):
     def fn(arrays, idx, rows):
         return tuple(a.at[idx].set(r) for a, r in zip(arrays, rows))
 
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(fn, **kwargs)
+
+
+@lru_cache(maxsize=None)
+def _get_mesh_row_scatter(n_arrays: int, mesh, donate: bool):
+    """The mesh counterpart of _get_row_scatter: each device scatters
+    ONLY its shard's rows, addressed by SHARD-LOCAL indices — a
+    shard_map over the ``nodes`` axis, so no cross-shard gather (or any
+    collective at all) is inserted. Inputs: resident arrays (node-
+    sharded [Np, ...]), idx [n_dev, Wp] int32 (row 0 of each device's
+    slice = its local index bucket), rows (one [n_dev, Wp, ...] per
+    array). Index buckets pad with an idempotent slot (see
+    DeviceClusterState._scatter_mesh), so ~log2(N) width variants cover
+    every delta size, same economy as the single-device scatter."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    (axis,) = mesh.axis_names
+
+    def body(arrays, idx, rows):
+        return tuple(
+            a.at[idx[0]].set(r[0]) for a, r in zip(arrays, rows)
+        )
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+    )
     kwargs = {"donate_argnums": (0,)} if donate else {}
     return jax.jit(fn, **kwargs)
 
@@ -119,11 +164,19 @@ class DeviceClusterState:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            self._node_sharding = NamedSharding(self.mesh, P("nodes"))
+            (axis,) = self.mesh.axis_names
+            self._node_sharding = NamedSharding(self.mesh, P(axis))
+            from nhd_tpu.k8s.retry import API_COUNTERS
+
+            # mesh observability (nhd_mesh_*): posture gauges set at
+            # build — scrapers see the sharding layout, not just totals
+            API_COUNTERS.set("mesh_devices", n_dev)
+            API_COUNTERS.set("mesh_shard_rows", self.Np // n_dev)
         self._dev: Dict[str, jax.Array] = {}
         # claim-dirty state: the touched row set (scattered before the
-        # next solve dispatch when the delta path is on) or, with
-        # NHD_DEVICE_DELTA=0 / a mesh, a plain flag driving the wholesale
+        # next solve dispatch when the delta path is on — single device
+        # AND mesh, which buckets rows per shard) or, with
+        # NHD_DEVICE_DELTA=0, a plain flag driving the wholesale
         # async re-upload — see stage_rows
         self._staged: bool = False
         self._staged_rows: set = set()
@@ -146,18 +199,19 @@ class DeviceClusterState:
         the next solve dispatch. Default (NHD_DEVICE_DELTA=1): ONE
         donated row-scatter over the pow-2-padded index bucket updates
         exactly the claimed rows of the mutable arrays — per-round
-        upload is O(claimed rows), not O(cluster). With the delta path
-        off (or on a mesh), the mutable arrays re-upload wholesale
-        (async device_put, batched into the next flush) — the right
-        trade on a relay that charges ~65 ms per FLUSH and nothing per
-        byte (docs/TPU_STATUS.md r4), where scatter-width program
-        variants cost more than the bytes they save."""
+        upload is O(claimed rows), not O(cluster) — and on a mesh the
+        scatter runs per shard with shard-local index buckets
+        (_scatter_mesh), so staged in-batch claims pay the same
+        O(claimed rows) there too. With the delta path off, the mutable
+        arrays re-upload wholesale (async device_put, batched into the
+        next flush) — the right trade on a relay that charges ~65 ms
+        per FLUSH and nothing per byte (docs/TPU_STATUS.md r4), where
+        scatter-width program variants cost more than the bytes they
+        save."""
         for i in indices:
             self._staged = True
             self._staged_rows.add(int(i))
-        if self._staged_rows and not (
-            _delta_enabled() and self.mesh is None
-        ):
+        if self._staged_rows and not _delta_enabled():
             self._staged_rows.clear()  # flag-only mode: wholesale flush
 
     def _flush_staged(self) -> None:
@@ -165,9 +219,7 @@ class DeviceClusterState:
             return
         self._staged = False
         rows, self._staged_rows = self._staged_rows, set()
-        if rows and _delta_enabled() and self.mesh is None and (
-            len(rows) < self.N
-        ):
+        if rows and _delta_enabled() and len(rows) < self.N:
             self._scatter(
                 _MUTABLE,
                 np.fromiter(sorted(rows), np.int64, len(rows)),
@@ -180,7 +232,11 @@ class DeviceClusterState:
         named resident arrays — ONE dispatch whatever the array count.
         The index vector pads to its power-of-two bucket by repeating
         the last row (idempotent), so ~log2(N) program variants cover
-        every delta size."""
+        every delta size. Mesh-sharded residents route to the per-shard
+        form (_scatter_mesh)."""
+        if self.mesh is not None:
+            self._scatter_mesh(names, rows)
+            return
         W = len(rows)
         Wp = _pad_pow2(W, floor=8)
         idx = np.empty(Wp, np.int32)
@@ -189,12 +245,7 @@ class DeviceClusterState:
         JIT_STATS.record_use(
             "row_scatter", f"A{len(names)}_W{Wp}_N{self.Np}"
         )
-        donate = False
-        try:
-            donate = jax.default_backend() != "cpu"
-        except Exception:  # nhdlint: ignore[NHD302]
-            pass  # backend probe only decides donation, never correctness
-        fn = _get_row_scatter(len(names), donate)
+        fn = _get_row_scatter(len(names), _donate_default())
         arrays = tuple(self._dev[name] for name in names)
         host_rows = tuple(
             jnp.asarray(np.ascontiguousarray(getattr(self.cluster, name)[idx]))
@@ -216,13 +267,83 @@ class DeviceClusterState:
 
         API_COUNTERS.inc("device_state_rows_uploaded_total", W)
 
+    def _scatter_mesh(self, names, rows: np.ndarray) -> None:
+        """Mesh-sharded row scatter (the PR 9 open item): dirty GLOBAL
+        row indices bucket by owning shard (shard = row // shard_rows),
+        each shard gets a SHARD-LOCAL index vector plus its rows' host-
+        mirror values, and ONE donated shard_map dispatch scatters every
+        shard's bucket in place — churn on a mesh pays O(changed rows),
+        never the wholesale re-shard it used to.
+
+        Buckets pad to one shared pow-2 width (jit-cache reuse, ~log2 N
+        variants): a shard's pad slots repeat its last dirty row
+        (idempotent row set, like the single-device scatter), and a
+        shard with NO dirty rows writes its own row 0 back — host-mirror
+        truth for live rows, zeros for padding rows past the cluster
+        (both exactly what the device already holds)."""
+        n_dev = self.mesh.devices.size
+        shard_rows = self.Np // n_dev
+        buckets: list = [[] for _ in range(n_dev)]
+        for g in rows.tolist():
+            buckets[g // shard_rows].append(g)
+        W = len(rows)
+        Wp = _pad_pow2(max(max(len(b) for b in buckets), 1), floor=8)
+        idx = np.empty((n_dev, Wp), np.int32)   # shard-local indices
+        gidx = np.empty((n_dev, Wp), np.int64)  # global rows (host gather)
+        for s, b in enumerate(buckets):
+            if b:
+                k = len(b)
+                idx[s, :k] = [g - s * shard_rows for g in b]
+                idx[s, k:] = b[-1] - s * shard_rows
+                gidx[s, :k] = b
+                gidx[s, k:] = b[-1]
+            else:
+                # idempotent no-op bucket: re-write the shard's row 0
+                idx[s, :] = 0
+                gidx[s, :] = s * shard_rows
+        JIT_STATS.record_use(
+            "mesh_row_scatter", f"A{len(names)}_W{Wp}_N{self.Np}_D{n_dev}"
+        )
+        fn = _get_mesh_row_scatter(len(names), self.mesh, _donate_default())
+        arrays = tuple(self._dev[name] for name in names)
+        live = gidx < self.N  # rows past the cluster hold device zeros
+        host_rows = []
+        for name in names:
+            src = getattr(self.cluster, name)
+            data = np.zeros((n_dev, Wp, *src.shape[1:]), src.dtype)
+            data[live] = src[gidx[live]]
+            host_rows.append(jax.device_put(data, self._node_sharding))
+        try:
+            out = fn(
+                arrays,
+                jax.device_put(idx, self._node_sharding),
+                tuple(host_rows),
+            )
+        except BaseException:
+            # the dispatch may have donated the resident arrays: restore
+            # them from the host mirror (source of truth)
+            for name in names:
+                self._dev[name] = self._put(
+                    _pad_own(getattr(self.cluster, name), self.Np)
+                )
+            raise
+        for name, arr in zip(names, out):
+            self._dev[name] = arr
+        from nhd_tpu.k8s.retry import API_COUNTERS
+
+        API_COUNTERS.inc("device_state_rows_uploaded_total", W)
+        API_COUNTERS.inc("mesh_rows_uploaded_total", W)
+
     def scatter_rows(self, rows: np.ndarray) -> None:
         """Delta-layer sync (encode.ClusterDelta.drain_dirty → here):
         scatter the changed rows of ALL resident arrays — watch events
         touch arrays the claim path never does (active, maintenance,
         group_mask) — and pick up any row growth inside the capacity
-        bucket. A mesh falls back to the wholesale sharded re-upload
-        (a host-indexed scatter would gather across shards)."""
+        bucket. Mesh-sharded residents take the same O(changed rows)
+        path through per-shard scatters (_scatter_mesh); only
+        storm-sized deltas or NHD_DEVICE_DELTA=0 fall back to the
+        wholesale re-upload (counted per posture, so the spmd bench can
+        assert zero mesh wholesale fallbacks in a steady round)."""
         self.N = self.cluster.n_nodes
         if self.N > self.Np:
             raise ValueError(
@@ -232,11 +353,7 @@ class DeviceClusterState:
         if rows.size == 0:
             return
         self._flush_staged()  # claim updates first, in their own mode
-        if (
-            self.mesh is not None
-            or not _delta_enabled()
-            or rows.size >= self.N // 2
-        ):
+        if not _delta_enabled() or rows.size >= self.N // 2:
             # storm-sized deltas: past ~half the rows, one contiguous
             # re-upload beats gathering scattered rows host-side (the
             # gather + index conversion costs more than the bytes saved)
@@ -247,6 +364,8 @@ class DeviceClusterState:
             from nhd_tpu.k8s.retry import API_COUNTERS
 
             API_COUNTERS.inc("device_state_rows_uploaded_total", self.N)
+            if self.mesh is not None:
+                API_COUNTERS.inc("mesh_wholesale_uploads_total")
             return
         self._scatter(_ARG_ORDER, rows.astype(np.int64))
 
@@ -273,23 +392,25 @@ class DeviceClusterState:
             return
 
     def _solve_raw(self, pods) -> SolveOut:
-        """The padded solver call against the resident arrays
-        ([Tp, Np] outputs, still on device)."""
+        """The padded PLAIN solver call against the resident arrays
+        ([Tp, Np] outputs, still on device) — the single-device
+        parity/debug surface. Mesh-resident state serves ONLY the fused
+        ranked megaround (solve_ranked): the legacy unfused sharded
+        solver is gone, so a plain mesh solve has no program to run."""
+        if self.mesh is not None:
+            raise RuntimeError(
+                "mesh-resident state runs the fused ranked megaround; "
+                "use solve_ranked (the unfused sharded solver was "
+                "removed — kernel.get_ranked_solver_mesh is the one "
+                "mesh program)"
+            )
         self._flush_staged()
         JIT_STATS.record_use(
             "solve",
             f"G{pods.G}_U{self.cluster.U}_K{self.cluster.K}"
-            f"_T{_pad_pow2(pods.n_types)}_N{self.Np}"
-            + ("_mesh" if self.mesh is not None else ""),
+            f"_T{_pad_pow2(pods.n_types)}_N{self.Np}",
         )
-        if self.mesh is not None:
-            from nhd_tpu.parallel.sharding import get_sharded_solver
-
-            solver = get_sharded_solver(
-                pods.G, self.cluster.U, self.cluster.K, self.mesh
-            )
-        else:
-            solver = get_solver(pods.G, self.cluster.U, self.cluster.K)
+        solver = get_solver(pods.G, self.cluster.U, self.cluster.K)
         return solver(
             *[self._dev[name] for name in _ARG_ORDER],
             *self._pod_args(pods),
@@ -307,37 +428,27 @@ class DeviceClusterState:
         the RESIDENT free arrays, which stage_rows/update_rows keep live
         between rounds).
 
-        Single device: any claim-dirty state re-uploads asynchronously,
-        then ONE fused solve+rank dispatch — its result pull is the
-        round's single relay flush (per-flush latency dominates the round
-        on the tunnel-attached TPU, so flush count is the metric that
-        matters). Mesh: the pjit SPMD solve + a replicated-output ranker —
-        top_k over the sharded node axis is the one collective this adds."""
+        Single device and mesh share ONE seam (kernel.dispatch_ranked):
+        any claim-dirty state flushes (delta scatters, or the async
+        wholesale re-upload with NHD_DEVICE_DELTA=0 — the right trade on
+        a relay that charges per FLUSH and nothing per byte), then ONE
+        fused solve+rank dispatch. On a mesh the same program runs SPMD
+        over the node-sharded resident arrays
+        (kernel.get_ranked_solver_mesh) — the rank's top_k over the
+        sharded node axis is the one collective, and the replicated
+        packed tensor is the round's single gather."""
         R = min(R, self.Np)
-        if self._node_sharding is not None:
-            out = self._solve_raw(pods)
-            from jax.sharding import NamedSharding, PartitionSpec as P
+        self._flush_staged()
+        if self.mesh is not None:
+            from nhd_tpu.k8s.retry import API_COUNTERS
 
-            ranker = _get_ranker(R, NamedSharding(self.mesh, P()))
-            return ranker(
-                out.cand, out.pref, out.best_c, out.best_m, out.best_a,
-                out.n_picks,
-                self._dev["gpu_free"], self._dev["cpu_free"],
-                self._dev["hp_free"],
-            )
-
-        self._flush_staged()  # async wholesale re-upload of dirty state
-        # same fused program (and AOT artifact) as the host path: claim
-        # updates reach the device as a wholesale async re-upload of the
-        # mutable arrays (see update_rows), NOT as a fused scatter — the
-        # relay charges per FLUSH, uploads batch into the next flush for
-        # free, and every distinct scatter-width variant used to lazily
-        # compile its own program mid-run (~1 s each through the tunnel)
+            API_COUNTERS.inc("mesh_solves_total")
         return dispatch_ranked(
             pods.G, self.cluster.U, self.cluster.K, R,
             _pad_pow2(pods.n_types), self.Np,
             [self._dev[name] for name in _ARG_ORDER]
             + self._pod_args(pods),
+            mesh=self.mesh,
         )
 
     def _rebuild_mutable(self) -> None:
@@ -355,6 +466,8 @@ class DeviceClusterState:
         from nhd_tpu.k8s.retry import API_COUNTERS
 
         API_COUNTERS.inc("device_state_rows_uploaded_total", self.N)
+        if self.mesh is not None:
+            API_COUNTERS.inc("mesh_wholesale_uploads_total")
 
     def megaround(self, bucket_pods: list, needs: list, respect_busy: bool):
         """Run the speculative on-device multi-round (solver/speculate.py)

@@ -309,25 +309,6 @@ def _rank_body(R, cand, pref, best_c, best_m, best_a, n_picks,
     ])
 
 
-@lru_cache(maxsize=None)
-def _get_ranker(R: int, out_sharding_key=None):
-    """Jitted top-R ranking over a solve's [T, N] outputs, returning the
-    packed [9, T, R] tensor. Cached per R (R is a pow-2 bucket, so a
-    handful of programs total); on a mesh the caller passes a replicated
-    out-sharding via ``out_sharding_key``."""
-
-    def rank(cand, pref, best_c, best_m, best_a, n_picks,
-             gpu_free, cpu_free, hp_free):
-        return _rank_body(
-            R, cand, pref, best_c, best_m, best_a, n_picks,
-            gpu_free, cpu_free, hp_free,
-        )
-
-    if out_sharding_key is not None:
-        return jax.jit(rank, out_shardings=out_sharding_key)
-    return jax.jit(rank)
-
-
 def rank_cap(accelerator: bool) -> int:
     """Ceiling for the top-R rank width.
 
@@ -404,33 +385,109 @@ def get_ranked_solver(G: int, U: int, K: int, R: int):
     return jax.jit(fn)
 
 
-def ranked_shape_key(G, U, K, R, Tp, Np) -> str:
+def mesh_desc(mesh) -> str:
+    """Canonical descriptor of a 1-D scheduler mesh ("nodes8" = a
+    ``nodes`` axis over 8 devices) — the string form every layer that
+    must name a sharded program shares: jit-stats shape keys, AOT cache
+    keys/artifact names (solver/aot.py reconstructs the mesh from it at
+    prewarm), and the NHD_MESH operator knob's log lines."""
+    if mesh is None:
+        return ""
+    (axis,) = mesh.axis_names
+    return f"{axis}{mesh.devices.size}"
+
+
+def parse_mesh_desc(desc: str):
+    """(axis, n_devices) from a mesh_desc string, or None for ""."""
+    if not desc:
+        return None
+    axis = desc.rstrip("0123456789")
+    return axis, int(desc[len(axis):])
+
+
+def mesh_shardings(mesh):
+    """(node_sharding, replicated) for *mesh* — the one place the
+    solver's GSPMD layout lives: every node array shards along axis 0
+    of the ``nodes`` mesh axis, everything else (pod-type arrays, the
+    packed rank output) replicates."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    (axis,) = mesh.axis_names
+    return NamedSharding(mesh, P(axis)), NamedSharding(mesh, P())
+
+
+@lru_cache(maxsize=None)
+def get_ranked_solver_mesh(G: int, U: int, K: int, R: int, mesh):
+    """The fused solve+rank megaround (get_ranked_solver) lowered onto a
+    device mesh: the 14 node arrays shard along the ``nodes`` axis, the
+    9 pod-type arrays replicate, and the packed [9, T, R] rank tensor
+    comes back replicated — the top-k over the sharded node axis is the
+    one collective GSPMD inserts. SAME program text as the single-device
+    megaround, so mesh results are bit-exact with it by construction
+    (pinned in tests/test_spmd.py); this replaced the legacy unfused
+    ``parallel.sharding.get_sharded_solver`` + separate ranker split,
+    whose intermediate [T, N] SolveOut tensors materialized (and
+    re-sharded) between two dispatches."""
+    node_spec, repl_spec = mesh_shardings(mesh)
+    in_shardings = (node_spec,) * len(_ARG_ORDER) + (
+        repl_spec,
+    ) * len(_POD_ARG_ORDER)
+    tables = get_tables(G, U, K)
+    i_hp = _ARG_ORDER.index("hp_free")
+    i_cpu = _ARG_ORDER.index("cpu_free")
+    i_gpu = _ARG_ORDER.index("gpu_free")
+
+    def fn(*args):
+        out = _solve(tables, *args)
+        return _rank_body(
+            R, out.cand, out.pref, out.best_c, out.best_m, out.best_a,
+            out.n_picks, args[i_gpu], args[i_cpu], args[i_hp],
+        )
+
+    return jax.jit(fn, in_shardings=in_shardings, out_shardings=repl_spec)
+
+
+def ranked_shape_key(G, U, K, R, Tp, Np, mesh: str = "") -> str:
     """The jit-stats shape key of one fused solve+rank program — every
-    dim the compiled program specializes on. Shared by the dispatch
-    sites and the AOT prewarm loader so a prewarmed program's first real
-    use counts as a cache hit, never a compile."""
-    return f"G{G}_U{U}_K{K}_R{R}_T{Tp}_N{Np}"
+    dim the compiled program specializes on (``mesh``: the mesh_desc of
+    a sharded variant — a mesh program is a DIFFERENT compilation).
+    Shared by the dispatch sites and the AOT prewarm loader so a
+    prewarmed program's first real use counts as a cache hit, never a
+    compile."""
+    key = f"G{G}_U{U}_K{K}_R{R}_T{Tp}_N{Np}"
+    return key + (f"_M{mesh}" if mesh else "")
 
 
-def dispatch_ranked(G, U, K, R, Tp, Np, args) -> jax.Array:
+def dispatch_ranked(G, U, K, R, Tp, Np, args, mesh=None) -> jax.Array:
     """Resolve + invoke the fused solve+rank program for one padded
     shape: the AOT prewarm cache first (zero-cold-start — the program
     was deserialized from StableHLO and compiled at daemon start), else
     the live jit, which is exported back to the AOT artifact cache when
     saving is on (solver/aot.py). ``args`` is the full 23-array
     positional list; host and device-resident callers share this single
-    entry so their programs (and AOT artifacts) are one and the same."""
+    entry so their programs (and AOT artifacts) are one and the same.
+    With ``mesh`` the SAME fused program runs SPMD over the node axis
+    (get_ranked_solver_mesh) — one seam serves single-chip and
+    multi-chip dispatch, and sharded programs export/prewarm through
+    the same AOT cache under a mesh-qualified key."""
     # recompile accounting (obs/jitstats.py): a first-seen key IS a
     # fresh trace+compile (or a prewarm load), the silent stall the
     # nhd_jit_* metrics make scrapeable
-    JIT_STATS.record_use("solve_ranked", ranked_shape_key(G, U, K, R, Tp, Np))
+    desc = mesh_desc(mesh)
+    JIT_STATS.record_use(
+        "solve_ranked", ranked_shape_key(G, U, K, R, Tp, Np, desc)
+    )
     from nhd_tpu.solver import aot
 
-    prog = aot.lookup(aot.ShapeKey("ranked", G, U, K, R, Tp, Np))
+    key = aot.ShapeKey("ranked", G, U, K, R, Tp, Np, desc)
+    prog = aot.lookup(key)
     if prog is not None:
         return prog(*args)
-    fn = get_ranked_solver(G, U, K, R)
-    aot.maybe_export(aot.ShapeKey("ranked", G, U, K, R, Tp, Np), fn, args)
+    fn = (
+        get_ranked_solver_mesh(G, U, K, R, mesh) if mesh is not None
+        else get_ranked_solver(G, U, K, R)
+    )
+    aot.maybe_export(key, fn, args)
     return fn(*args)
 
 

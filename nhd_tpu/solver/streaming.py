@@ -322,18 +322,16 @@ class StreamingScheduler:
             if not v:
                 oversized.append(i)
         if oversized:
-            self.batch._schedule_serial(
+            touched = self.batch._schedule_serial(
                 nodes, items, oversized, results, stats, now, True
             )
             ov = set(oversized)
             schedulable = [i for i in schedulable if i not in ov]
             # persistent tile contexts may already exist (prior calls):
-            # their claimed rows fold in as deltas at the context refresh
-            # below, exactly like any other inter-batch churn
-            self.note_nodes(
-                results[i].node for i in oversized
-                if results[i] is not None and results[i].node is not None
-            )
+            # their touched rows (winners + busy-stamped failures) fold
+            # in as deltas at the context refresh below, exactly like
+            # any other inter-batch churn
+            self.note_nodes(touched)
             stats.round_end_seconds.append(time.perf_counter() - t_stream)
             for i in oversized:
                 if results[i] is not None and results[i].node is not None:

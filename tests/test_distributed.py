@@ -195,21 +195,22 @@ _WORKER = textwrap.dedent("""
         os._exit(0)  # skip the distributed shutdown barrier: one rank is
         #              dead and a clean shutdown would wait for it
     elif scenario == "spmd":
-        from nhd_tpu.parallel.sharding import make_mesh, solve_bucket_sharded
+        from nhd_tpu.parallel.sharding import (
+            make_mesh, solve_bucket_ranked_sharded,
+        )
         from nhd_tpu.solver.encode import encode_cluster, encode_pods
-        from nhd_tpu.solver.kernel import solve_bucket
+        from nhd_tpu.solver.kernel import solve_bucket_ranked
 
         nodes = make_cluster(8)
         cluster = encode_cluster(nodes, now=0.0)
         pods = encode_pods([simple_request(gpus=1)], cluster.interner)[1]
         mesh = make_mesh(jax.devices())   # global: all devices, all processes
         assert mesh.devices.size == nproc * dev_per_proc
-        out = solve_bucket_sharded(cluster, pods, mesh)
-        ref = solve_bucket(cluster, pods)
-        np.testing.assert_array_equal(out.cand, np.asarray(ref.cand))
-        np.testing.assert_array_equal(out.pref, np.asarray(ref.pref))
-        np.testing.assert_array_equal(out.best_c, np.asarray(ref.best_c))
-        np.testing.assert_array_equal(out.best_a, np.asarray(ref.best_a))
+        # the PRODUCTION mesh program: the fused solve+rank megaround,
+        # sharded — bit-identical to the local single-device fused solve
+        out = solve_bucket_ranked_sharded(cluster, pods, 8, mesh)
+        ref = np.asarray(solve_bucket_ranked(cluster, pods, 8))
+        np.testing.assert_array_equal(out, ref)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     print(f"OK rank {rank} {scenario}")

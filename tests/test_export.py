@@ -81,24 +81,18 @@ def test_ranked_roundtrip_matches_live_ranked_solver(exported_dir):
     free-array argument indices and the RankOut serialization."""
     from jax import export as jexport
 
-    from nhd_tpu.solver.device_state import _ARG_ORDER
-    from nhd_tpu.solver.kernel import _get_ranker, get_solver
+    from nhd_tpu.solver.kernel import get_ranked_solver
 
     out, _, ranked = exported_dir
     by_bucket = {tuple(m["bucket"].values()): m for m in ranked}
-    i_hp = _ARG_ORDER.index("hp_free")
-    i_cpu = _ARG_ORDER.index("cpu_free")
-    i_gpu = _ARG_ORDER.index("gpu_free")
     for args, meta in build_headline_buckets():
         b = meta["bucket"]
         m = by_bucket[(b["G"], b["U"], b["K"])]
         blob = (out / m["artifact"]).read_bytes()
         exported = jexport.deserialize(bytearray(blob))
         got = exported.call(*args)
-        solver = get_solver(b["G"], b["U"], b["K"])
-        ranker = _get_ranker(m["rank_width"])
-        o = solver(*args)
-        want = ranker(o.cand, o.pref, o.best_c, o.best_m, o.best_a,
-                      o.n_picks, args[i_gpu], args[i_cpu], args[i_hp])
+        want = get_ranked_solver(b["G"], b["U"], b["K"], m["rank_width"])(
+            *args
+        )
         for g, w in zip(got, want):
             np.testing.assert_array_equal(np.array(g), np.array(w))

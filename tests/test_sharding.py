@@ -1,5 +1,8 @@
-"""Sharded solve must agree with the single-device solve on an 8-device
-virtual CPU mesh (conftest forces xla_force_host_platform_device_count=8)."""
+"""The sharded fused megaround must agree with the single-device fused
+program bit-for-bit on an 8-device virtual CPU mesh (conftest forces
+xla_force_host_platform_device_count=8). The mesh variant is the SAME
+program text (kernel.get_ranked_solver_mesh) re-partitioned by GSPMD, so
+parity is the contract, not a tolerance."""
 
 import random
 
@@ -8,8 +11,12 @@ import numpy as np
 import pytest
 
 from nhd_tpu.solver.encode import encode_cluster, encode_pods
-from nhd_tpu.solver.kernel import solve_bucket
-from nhd_tpu.parallel.sharding import make_mesh, solve_bucket_sharded
+from nhd_tpu.solver.kernel import solve_bucket_ranked
+from nhd_tpu.parallel.sharding import (
+    make_mesh,
+    resolve_mesh_spec,
+    solve_bucket_ranked_sharded,
+)
 from tests.test_jax_matcher import random_cluster, random_request
 
 
@@ -18,32 +25,49 @@ def test_mesh_has_8_devices():
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
-def test_sharded_matches_single_device(seed):
+def test_sharded_ranked_matches_single_device(seed):
     rng = random.Random(seed)
     nodes = random_cluster(rng, rng.randint(3, 12))
     reqs = [random_request(rng) for _ in range(8)]
     cluster = encode_cluster(nodes, now=1010.0)
     mesh = make_mesh()
     for G, pods in encode_pods(reqs, cluster.interner).items():
-        plain = solve_bucket(cluster, pods)
-        sharded = solve_bucket_sharded(cluster, pods, mesh)
-        np.testing.assert_array_equal(np.asarray(plain.cand), sharded.cand)
-        np.testing.assert_array_equal(np.asarray(plain.pref), sharded.pref)
-        np.testing.assert_array_equal(np.asarray(plain.best_c), sharded.best_c)
-        np.testing.assert_array_equal(np.asarray(plain.best_m), sharded.best_m)
-        np.testing.assert_array_equal(np.asarray(plain.best_a), sharded.best_a)
+        plain = np.asarray(solve_bucket_ranked(cluster, pods, 16))
+        sharded = solve_bucket_ranked_sharded(cluster, pods, 16, mesh)
+        np.testing.assert_array_equal(plain, sharded)
 
 
 def test_sharded_solve_with_node_count_not_divisible():
-    """N not divisible by the mesh size pads cleanly."""
+    """N not divisible by the mesh size pads cleanly (the mesh pads to a
+    multiple of the device count; padded rows are inactive)."""
     rng = random.Random(99)
     nodes = random_cluster(rng, 13)
     reqs = [random_request(rng) for _ in range(3)]
     cluster = encode_cluster(nodes, now=1010.0)
     for G, pods in encode_pods(reqs, cluster.interner).items():
-        plain = solve_bucket(cluster, pods)
-        sharded = solve_bucket_sharded(cluster, pods)
-        np.testing.assert_array_equal(np.asarray(plain.cand), sharded.cand)
+        plain = np.asarray(solve_bucket_ranked(cluster, pods, 8))
+        sharded = solve_bucket_ranked_sharded(cluster, pods, 8)
+        np.testing.assert_array_equal(plain, sharded)
+
+
+def test_resolve_mesh_spec():
+    """The NHD_MESH / --mesh operator knob: auto passes through, off
+    forces single-device, N builds an explicit mesh, and asking for more
+    devices than exist is a refused misconfiguration."""
+    assert resolve_mesh_spec("auto") == "auto"
+    assert resolve_mesh_spec(None) == "auto"
+    assert resolve_mesh_spec("off") is None
+    assert resolve_mesh_spec("0") is None
+    assert resolve_mesh_spec("none") is None
+    assert resolve_mesh_spec("1") is None  # one device = no mesh
+    mesh = resolve_mesh_spec("4")
+    assert mesh.devices.size == 4 and mesh.axis_names == ("nodes",)
+    # an existing Mesh passes through untouched
+    assert resolve_mesh_spec(mesh) is mesh
+    with pytest.raises(ValueError):
+        resolve_mesh_spec("9999")
+    with pytest.raises(ValueError):
+        resolve_mesh_spec("bogus")
 
 
 def _cluster_free_state(nodes):

@@ -1,0 +1,320 @@
+"""Tier-1 SPMD parity: the mesh-sharded production path vs single device.
+
+Promotes the MULTICHIP dryrun-harness assertions into the suite: the
+fused ranked megaround over an 8-host-device mesh (conftest forces the
+virtual devices) must be BIT-EXACT with the single-device program —
+through the device-resident state, the per-shard delta scatters, staged
+in-batch claims, and the sharded AOT export/prewarm cycle. A host that
+cannot run in-process sharded programs skips cleanly (same capability-
+probe pattern as tests/test_distributed.py)."""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import numpy as np
+import pytest
+
+from nhd_tpu.solver.encode import ClusterDelta, encode_cluster, encode_pods
+from nhd_tpu.solver.kernel import solve_bucket_ranked
+
+
+@functools.lru_cache(maxsize=1)
+def _mesh_unsupported_reason() -> Optional[str]:
+    """None when this host can run an in-process 8-way sharded jit;
+    otherwise the reason to skip (environmental, not a regression)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        return f"needs 8 devices, host exposes {len(jax.devices())}"
+    try:
+        import jax.numpy as jnp
+
+        from nhd_tpu.parallel.sharding import make_mesh
+        from nhd_tpu.solver.kernel import mesh_shardings
+
+        mesh = make_mesh(jax.devices()[:8])
+        node, repl = mesh_shardings(mesh)
+        out = jax.jit(
+            lambda a: jnp.sum(a), in_shardings=(node,), out_shardings=repl
+        )(np.ones(16, np.float32))
+        assert float(out) == 16.0
+    except Exception as exc:  # environmental: no sharded CPU execution
+        return f"sharded jit unavailable: {exc}"
+    return None
+
+
+def _require_mesh() -> None:
+    reason = _mesh_unsupported_reason()
+    if reason is not None:
+        pytest.skip(f"in-process SPMD unavailable: {reason}")
+
+
+def _mesh():
+    import jax
+
+    from nhd_tpu.parallel.sharding import make_mesh
+
+    return make_mesh(jax.devices()[:8])
+
+
+def _cluster(n_nodes: int, seed: int = 0):
+    from tests.test_jax_matcher import random_cluster
+    import random
+
+    return random_cluster(random.Random(seed), n_nodes)
+
+
+def _requests(n: int, seed: int = 0):
+    from tests.test_jax_matcher import random_request
+    import random
+
+    rng = random.Random(seed)
+    return [random_request(rng) for _ in range(n)]
+
+
+@pytest.mark.parametrize("seed,n_nodes", [(0, 8), (1, 13), (2, 21)])
+def test_device_state_mesh_solve_ranked_bit_exact(seed, n_nodes):
+    """The production mesh dispatch (DeviceClusterState.solve_ranked →
+    kernel.get_ranked_solver_mesh) vs the host fused program, even and
+    uneven node splits — the dryrun harness's exact-parity assertion."""
+    _require_mesh()
+    from nhd_tpu.solver.device_state import DeviceClusterState
+
+    nodes = _cluster(n_nodes, seed)
+    cluster = encode_cluster(nodes, now=1010.0)
+    dev = DeviceClusterState(cluster, _mesh())
+    for G, pods in sorted(
+        encode_pods(_requests(8, seed), cluster.interner).items()
+    ):
+        got = np.asarray(dev.solve_ranked(pods, 16))
+        want = np.asarray(solve_bucket_ranked(cluster, pods, 16))
+        # single-device pads N to its own pow-2 bucket; the mesh pads to
+        # a multiple of the device count — compare at the common width
+        R = min(got.shape[2], want.shape[2])
+        np.testing.assert_array_equal(got[:, :, :R], want[:, :, :R])
+
+
+def test_mesh_respect_busy_split_parity():
+    """Busy-marked rows (the respect-busy dryrun split) survive the
+    shard boundary bit-exactly."""
+    _require_mesh()
+    from nhd_tpu.solver.device_state import DeviceClusterState
+
+    nodes = _cluster(12, 3)
+    cluster = encode_cluster(nodes, now=1010.0)
+    cluster.busy[::3] = True
+    dev = DeviceClusterState(cluster, _mesh())
+    for G, pods in sorted(
+        encode_pods(_requests(6, 3), cluster.interner).items()
+    ):
+        got = np.asarray(dev.solve_ranked(pods, 8))
+        want = np.asarray(solve_bucket_ranked(cluster, pods, 8))
+        R = min(got.shape[2], want.shape[2])
+        np.testing.assert_array_equal(got[:, :, :R], want[:, :, :R])
+
+
+def test_mesh_scatter_rows_o_changed_rows_and_bit_exact():
+    """The PR 9 open item closed: churn rows reach mesh-sharded resident
+    arrays as per-shard delta scatters — counters tick O(changed rows),
+    zero wholesale fallbacks, and every resident array equals the padded
+    host mirror bit-for-bit afterwards."""
+    _require_mesh()
+    from nhd_tpu.k8s.retry import API_COUNTERS
+    from nhd_tpu.solver.device_state import (
+        DeviceClusterState, _ARG_ORDER, _pad_own,
+    )
+
+    nodes = _cluster(11, 5)
+    cluster = encode_cluster(nodes, now=1010.0)
+    dev = DeviceClusterState(cluster, _mesh())
+    # churn-shaped host mutations across several shards
+    cluster.active[1] = False
+    cluster.maintenance[4] = True
+    cluster.cpu_free[7] = 0
+    cluster.hp_free[9] = 0
+    c0 = API_COUNTERS.snapshot()
+    dev.scatter_rows(np.asarray([1, 4, 7, 9], np.int64))
+    c1 = API_COUNTERS.snapshot()
+    assert c1["device_state_rows_uploaded_total"] - (
+        c0["device_state_rows_uploaded_total"]
+    ) == 4
+    assert c1["mesh_rows_uploaded_total"] - (
+        c0["mesh_rows_uploaded_total"]
+    ) == 4
+    assert c1["mesh_wholesale_uploads_total"] == (
+        c0["mesh_wholesale_uploads_total"]
+    )
+    for name in _ARG_ORDER:
+        np.testing.assert_array_equal(
+            np.asarray(dev._dev[name]),
+            _pad_own(getattr(cluster, name), dev.Np),
+            err_msg=name,
+        )
+
+
+def test_mesh_staged_claims_scatter_matches_wholesale():
+    """Staged in-batch claims (stage_rows) take the per-shard scatter on
+    a mesh and the next solve sees exactly the host-mirror truth — the
+    same answer a wholesale re-upload (NHD_DEVICE_DELTA=0) produces."""
+    _require_mesh()
+    from nhd_tpu.solver.device_state import DeviceClusterState
+
+    nodes = _cluster(10, 7)
+    cluster = encode_cluster(nodes, now=1010.0)
+    buckets = encode_pods(_requests(5, 7), cluster.interner)
+
+    outs = {}
+    for mode in ("delta", "wholesale"):
+        os.environ["NHD_DEVICE_DELTA"] = "1" if mode == "delta" else "0"
+        try:
+            dev = DeviceClusterState(cluster, _mesh())
+            cluster.busy[2] = True
+            cluster.gpu_free[6] = 0
+            dev.stage_rows([2, 6])
+            outs[mode] = {
+                G: np.asarray(dev.solve_ranked(pods, 8))
+                for G, pods in sorted(buckets.items())
+            }
+        finally:
+            os.environ.pop("NHD_DEVICE_DELTA", None)
+            cluster.busy[2] = False
+    for G in outs["delta"]:
+        np.testing.assert_array_equal(
+            outs["delta"][G], outs["wholesale"][G]
+        )
+
+
+def test_delta_context_churn_on_mesh_pays_changed_rows():
+    """refresh_context over a delta-built MESH context: noted churn
+    reaches the sharded resident arrays as row scatters (not the
+    wholesale re-upload the mesh used to force), and the delta parity
+    invariant holds throughout."""
+    _require_mesh()
+    from nhd_tpu.k8s.retry import API_COUNTERS
+    from nhd_tpu.solver.batch import BatchItem, BatchScheduler
+    from nhd_tpu.solver.device_state import _ARG_ORDER, _pad_own
+
+    nodes = _cluster(12, 9)
+    sched = BatchScheduler(
+        respect_busy=False, register_pods=False,
+        device_state=True, mesh=_mesh(),
+    )
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    ctx = sched.make_context(nodes, now=0.0, delta=delta)
+    assert ctx.dev is not None and ctx.dev.mesh is not None
+    items = [
+        BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(_requests(6, 9))
+    ]
+    sched.schedule(ctx.nodes, items, context=ctx)
+
+    # inter-batch churn: two nodes flip, noted like watch events
+    names = list(nodes)
+    nodes[names[0]].active = not nodes[names[0]].active
+    nodes[names[5]].maintenance = True
+    delta.note(names[0])
+    delta.note(names[5])
+    c0 = API_COUNTERS.snapshot()
+    sched.refresh_context(ctx, now=0.0)
+    c1 = API_COUNTERS.snapshot()
+    up = c1["device_state_rows_uploaded_total"] - (
+        c0["device_state_rows_uploaded_total"]
+    )
+    assert 0 < up <= 4, up  # the two noted rows (+ staged claim rows)
+    assert c1["mesh_wholesale_uploads_total"] == (
+        c0["mesh_wholesale_uploads_total"]
+    )
+    assert delta.parity_errors() == []
+    for name in _ARG_ORDER:
+        np.testing.assert_array_equal(
+            np.asarray(ctx.dev._dev[name]),
+            _pad_own(getattr(ctx.cluster, name), ctx.dev.Np),
+            err_msg=name,
+        )
+
+
+def test_mesh_aot_export_prewarm_compiles_flat(tmp_path):
+    """Sharded programs export to the AOT cache under mesh-qualified
+    keys, prewarm back, and the next mesh dispatch is a cache HIT
+    serving bit-identical results (the zero-recompile invariant for the
+    multi-chip posture)."""
+    _require_mesh()
+    from nhd_tpu.obs.jitstats import JIT_STATS
+    from nhd_tpu.solver import aot, kernel
+    from nhd_tpu.solver.device_state import DeviceClusterState
+
+    aot.reset()
+    aot.configure(directory=str(tmp_path), save=True)
+    try:
+        nodes = _cluster(9, 11)
+        cluster = encode_cluster(nodes, now=1010.0)
+        buckets = encode_pods(_requests(6, 11), cluster.interner)
+        dev = DeviceClusterState(cluster, _mesh())
+        outs = {
+            G: np.asarray(dev.solve_ranked(pods, 8))
+            for G, pods in sorted(buckets.items())
+        }
+        aot.AOT.drain()
+        mesh_artifacts = [
+            f for f in os.listdir(tmp_path)
+            if f.endswith(".json") and "_mnodes8" in f
+        ]
+        assert mesh_artifacts, sorted(os.listdir(tmp_path))
+
+        # restart-equivalent: live programs dropped, disk is the source
+        kernel.get_ranked_solver_mesh.cache_clear()
+        kernel.get_ranked_solver.cache_clear()
+        JIT_STATS.reset()
+        aot.reset()
+        aot.configure(directory=str(tmp_path), save=False)
+        summary = aot.prewarm()
+        assert summary["quarantined"] == 0
+        assert any("_mnodes8" in k for k in summary["keys"]), summary
+        warm = JIT_STATS.snapshot()
+
+        dev2 = DeviceClusterState(cluster, _mesh())
+        for G, pods in sorted(buckets.items()):
+            got = np.asarray(dev2.solve_ranked(pods, 8))
+            np.testing.assert_array_equal(got, outs[G])
+        steady = JIT_STATS.snapshot()
+        escaped = sorted(
+            k for k in steady["shapes"]
+            if k.startswith("solve_ranked:")
+            and k not in warm["shapes"]
+        )
+        assert escaped == [], escaped
+    finally:
+        aot.reset()
+
+
+def test_mesh_prewarm_skips_oversized_mesh_artifacts(tmp_path):
+    """An artifact exported on a BIGGER slice (more devices than this
+    host) is skipped — neither loaded nor quarantined: it is not stale,
+    just inapplicable here."""
+    import json
+
+    from nhd_tpu.solver import aot
+
+    aot.reset()
+    aot.configure(directory=str(tmp_path), save=False)
+    try:
+        meta = {
+            "aot_schema": aot.AOT_SCHEMA_VERSION,
+            "kind": "ranked", "G": 1, "U": 2, "K": 2, "R": 8,
+            "Tp": 8, "Np": 64, "mesh": "nodes64",
+            **aot._versions(),
+            "platforms": ["cpu", "tpu"],
+        }
+        base = tmp_path / "ranked_g1_u2_k2_r8_t8_n64_mnodes64"
+        (tmp_path / f"{base.name}.json").write_text(json.dumps(meta))
+        (tmp_path / f"{base.name}.stablehlo.bin").write_bytes(b"\x00")
+        summary = aot.prewarm()
+        assert summary["loaded"] == 0
+        assert summary["quarantined"] == 0
+        assert summary["skipped"] == 1
+        # left in place for the host that CAN run it
+        assert (tmp_path / f"{base.name}.stablehlo.bin").exists()
+    finally:
+        aot.reset()

@@ -14,7 +14,10 @@ artifact writer.
     make bench-diff                 # two newest artifacts/bench/*.json
 
 Watched per shared config: the solve-phase seconds (the figure the
-ROADMAP's perf arc optimizes) and total wall. Watched globally: the
+ROADMAP's perf arc optimizes) and total wall; sustained-churn configs
+gate their rates + p99 latency class, and SPMD configs (an ``spmd``
+section) additionally gate the parity/prewarm flags, zero wholesale
+mesh uploads and the per-round upload rows. Watched globally: the
 headline pods/s. Phases below ``--floor`` seconds (default 5 ms) are
 skipped — at that scale the diff measures host jitter, not the solver.
 Configs present in only one artifact are reported but never fatal (the
@@ -95,6 +98,54 @@ def _churn_gates(
             )
 
 
+def _spmd_gates(
+    name: str, o: dict, n: dict, threshold: float, lines, regressions
+) -> None:
+    """SPMD configs (an ``spmd`` section in the NEW record): the parity
+    flag, the sharded-prewarm flag and zero wholesale uploads are hard
+    gates (boolean promises, not figures); the per-round upload rows
+    gate relatively when both sides carry the section — a growing figure
+    means churn is paying for rows it didn't change. The solve phase
+    rides the standard WATCHED_PHASES gate."""
+    nc = n.get("spmd")
+    if not isinstance(nc, dict):
+        return
+    for flag, what in (
+        ("parity_ok", "mesh/single-device parity"),
+        ("prewarm_ok", "sharded AOT prewarm"),
+    ):
+        if not nc.get(flag):
+            lines.append(f"{name:>24} {flag}: FAILED <-- REGRESSION")
+            regressions.append(f"{name} {what} flag is false")
+    wholesale = float(nc.get("wholesale_uploads", 0) or 0)
+    if wholesale > 0:
+        lines.append(
+            f"{name:>24} wholesale: {wholesale:.0f} <-- REGRESSION"
+        )
+        regressions.append(
+            f"{name} paid {wholesale:.0f} wholesale mesh re-uploads "
+            "(per-shard delta scatter not engaging)"
+        )
+    oc = o.get("spmd")
+    if isinstance(oc, dict):
+        ov = float(oc.get("rows_per_round", 0.0) or 0.0)
+        nv = float(nc.get("rows_per_round", 0.0) or 0.0)
+        if ov > 0:
+            d = _pct(ov, nv)
+            fatal = d > threshold and (nv - ov) >= 64
+            mark = " <-- REGRESSION" if fatal else ""
+            lines.append(
+                f"{name:>24} rows/rnd: {ov:8.1f} -> {nv:8.1f} "
+                f"({d:+.1%}){mark}"
+            )
+            if fatal:
+                regressions.append(
+                    f"{name} mesh upload rows/round regressed {d:+.1%} "
+                    f"({ov:.1f} -> {nv:.1f}, threshold {threshold:.0%} "
+                    "and +64 rows)"
+                )
+
+
 #: a wall regression is fatal only when BOTH the relative threshold and
 #: this absolute growth (seconds) are exceeded: at small scales the
 #: figure is scheduler fixed overhead + host jitter (a 3 ms blip on a
@@ -142,6 +193,7 @@ def diff_artifacts(
             # the wall gate would double-count (events are fixed, so
             # wall IS the inverse of the sustained rate)
             _churn_gates(name, o, n, threshold, lines, regressions)
+        _spmd_gates(name, o, n, threshold, lines, regressions)
         cfg_threshold = (
             threshold * 2 if name in LATENCY_CONFIGS else threshold
         )
