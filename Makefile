@@ -14,8 +14,10 @@ FED_SEEDS ?= 6
 FED_STEPS ?= 50
 FED_SHARDS ?= 3
 FED_REPLICAS ?= 3
+DEV_SEEDS ?= 3
+DEV_STEPS ?= 40
 
-.PHONY: test lint sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos trace-demo fleet-demo docker docker-smoke release
+.PHONY: test lint sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos trace-demo fleet-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -55,7 +57,7 @@ lint:
 sanitize:
 	NHD_SAN=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
 		tests/test_streaming.py tests/test_faults.py tests/test_ha.py \
-		tests/test_fleet.py -q
+		tests/test_fleet.py tests/test_guard.py -q
 
 # full release gate: lint + suite + the seconds-scale bench-smoke leg
 # (writes a perf artifact and diffs it against the newest prior one, so
@@ -65,6 +67,7 @@ sanitize:
 check: lint test
 	$(MAKE) bench-smoke
 	$(MAKE) fleet-demo
+	$(MAKE) device-chaos
 
 # Regenerate protobuf message bindings. Service stubs are hand-written in
 # nhd_tpu/rpc/server.py (no grpc_python_plugin needed).
@@ -150,6 +153,18 @@ fed-chaos:
 		--seeds $(FED_SEEDS) --steps $(FED_STEPS) --nodes 6 \
 		--json-out artifacts/chaos/fed_chaos.json \
 		--fleet-out artifacts/fleet
+
+# solver data-plane matrix: seeds x the device-faults profile (injected
+# dispatch/upload exceptions, slow dispatches, bit-flipped resident
+# rows) against the resident-state path, with a fault-free CONTROL run
+# per cell — every cell must end with a bound set bit-identical to its
+# control, a bit-exact device audit, and zero process restarts
+# (docs/RESILIENCE.md "Layer 8"; CI runs the fast cell in
+# tests/test_guard.py). Artifact per cell via --json-out.
+device-chaos:
+	python tools/chaos_storm.py --profiles device-faults --device-plane \
+		--bind-parity --seeds $(DEV_SEEDS) --steps $(DEV_STEPS) \
+		--json-out artifacts/chaos/device_chaos.json
 
 # flight-recorder demo: run the sim with tracing on, dump the Chrome
 # trace, validate its schema + per-pod span pipeline (docs/OBSERVABILITY.md)

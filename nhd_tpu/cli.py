@@ -296,20 +296,13 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     if args.prewarm:
-        # zero-cold-start serving: compile every cached solver program
-        # NOW, before any thread starts, so the first watch event finds
-        # a warm program table; newly traced shapes export back to the
-        # cache for the next restart (crash-only restarts get faster
-        # over the daemon's life, not slower)
+        # save-on-trace turns on now; the prewarm itself runs AFTER the
+        # thread set is built (below) so each installed artifact can
+        # advance the scheduler's heartbeat — a long multi-artifact
+        # compile must never read as a wedged loop to the stall watchdog
         from nhd_tpu.solver import aot
 
         aot.configure(save=True)
-        summary = aot.prewarm()
-        msg = (f"prewarm: {summary['loaded']} solver program(s) compiled "
-               f"in {summary['seconds']:.2f}s from {aot.AOT.directory()}")
-        if summary["quarantined"]:
-            msg += f" ({summary['quarantined']} stale artifact(s) quarantined)"
-        logger.warning(msg)
 
     trace_capacity = int(os.environ.get("NHD_TRACE_CAPACITY", "16384"))
     if args.trace_out:
@@ -397,6 +390,26 @@ def main(argv=None) -> int:
         shards=args.shards, shard_peers=shard_peers, on_demote=on_demote,
         mesh=args.mesh,
     )
+    if args.prewarm:
+        # zero-cold-start serving: compile every cached solver program
+        # NOW, before any thread starts, so the first watch event finds
+        # a warm program table; newly traced shapes export back to the
+        # cache for the next restart (crash-only restarts get faster
+        # over the daemon's life, not slower). The watchdog is armed
+        # only when the threads start below, AND every artifact
+        # installed advances Scheduler.last_heartbeat (the prewarm
+        # progress hook) — belt and braces, so neither this ordering
+        # nor an embedding that starts its watchdog earlier can read a
+        # long AOT compile as a stalled loop.
+        from nhd_tpu.solver import aot
+
+        sched = next(t for t in threads if isinstance(t, Scheduler))
+        summary = aot.prewarm(progress=sched._beat)
+        msg = (f"prewarm: {summary['loaded']} solver program(s) compiled "
+               f"in {summary['seconds']:.2f}s from {aot.AOT.directory()}")
+        if summary["quarantined"]:
+            msg += f" ({summary['quarantined']} stale artifact(s) quarantined)"
+        logger.warning(msg)
     for t in threads:
         t.start()
 

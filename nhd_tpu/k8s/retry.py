@@ -136,6 +136,45 @@ class ApiCounters:
             ("counter", "Mesh resident-state uploads that fell back to "
                         "a wholesale re-shard (storm-sized delta or "
                         "NHD_DEVICE_DELTA=0)"),
+        # solver data-plane guard (solver/guard.py, docs/RESILIENCE.md
+        # "Layer 8"): the detect -> degrade -> repair ladder's ledger.
+        # guard_rung is the current degradation floor (0 = full
+        # fidelity/mesh, 1 = single-device, 2 = host solve path).
+        "guard_rung":
+            ("gauge", "Solver guard degradation floor (0 mesh/full, "
+                      "1 single-device, 2 host)"),
+        "guard_faults_total":
+            ("counter", "Device-plane faults the solver guard observed"),
+        "guard_retries_total":
+            ("counter", "Solver rounds re-dispatched after a transient "
+                        "device-plane fault"),
+        "guard_giveups_total":
+            ("counter", "Device-plane faults surfaced past the guard "
+                        "(terminal, or the rung ladder exhausted)"),
+        "guard_degradations_total":
+            ("counter", "Rung drops down the mesh -> single-device -> "
+                        "host ladder"),
+        "guard_promotions_total":
+            ("counter", "Rung re-promotions after clean probe rounds"),
+        "guard_audits_total":
+            ("counter", "Resident-state audit passes run"),
+        "guard_audit_rows_total":
+            ("counter", "Device rows bit-exact spot-checked against the "
+                        "host mirror"),
+        "guard_corruptions_total":
+            ("counter", "Resident-state corruptions detected (audit "
+                        "mismatches + rank-tensor screen failures)"),
+        "guard_repairs_total":
+            ("counter", "Resident states rebuilt from host truth by the "
+                        "guard"),
+        "guard_quarantined_shapes":
+            ("gauge", "Shape keys quarantined for repeated program "
+                      "faults (AOT artifact retired, live re-trace)"),
+        # AOT export worker (solver/aot.py): background-thread failures
+        # were invisible before this counter
+        "aot_export_failures_total":
+            ("counter", "AOT StableHLO background exports that failed "
+                        "(serving unaffected; cache not written)"),
         # HA plane (k8s/lease.py, docs/RESILIENCE.md "HA & fencing").
         # Under the sharded federation the single-leader gauges
         # generalize: ha_is_leader means "holds at least one shard" and

@@ -298,6 +298,13 @@ def build_fleet_payload(
             "mesh_solves_total",
             "mesh_rows_uploaded_total",
             "mesh_wholesale_uploads_total",
+            "guard_faults_total",
+            "guard_retries_total",
+            "guard_degradations_total",
+            "guard_promotions_total",
+            "guard_audits_total",
+            "guard_corruptions_total",
+            "guard_repairs_total",
         ):
             total, seen = 0.0, False
             for v in views:
@@ -349,6 +356,25 @@ def build_fleet_payload(
             "wholesale_uploads_total": counters.get(
                 "mesh_wholesale_uploads_total", 0
             ),
+        },
+        # solver data-plane guard (ISSUE 12, solver/guard.py): rung is
+        # the in-process degradation floor (the scrape path cannot sum
+        # a gauge across replicas, so it stays 0 there — the per-replica
+        # nhd_guard_rung series carries it); the _total families sum
+        # like every other fleet counter
+        "guard": {
+            "rung": int(counters.get("guard_rung", 0)),
+            "faults_total": counters.get("guard_faults_total", 0),
+            "retries_total": counters.get("guard_retries_total", 0),
+            "degradations_total": counters.get(
+                "guard_degradations_total", 0
+            ),
+            "promotions_total": counters.get("guard_promotions_total", 0),
+            "audits_total": counters.get("guard_audits_total", 0),
+            "corruptions_total": counters.get(
+                "guard_corruptions_total", 0
+            ),
+            "repairs_total": counters.get("guard_repairs_total", 0),
         },
     }
 

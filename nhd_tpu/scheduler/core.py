@@ -320,6 +320,16 @@ class Scheduler(threading.Thread):
         self.batch = BatchScheduler(
             respect_busy=respect_busy, mesh=self._mesh
         )
+        # solver data-plane guard (solver/guard.py): recovery retries
+        # and resident-state audits are legitimate intra-turn work — let
+        # them advance the loop heartbeat so the stall watchdog measures
+        # "no progress", never "one long repair". Process-global like
+        # the device plane itself; the last replica constructed in a
+        # multi-replica test process owns the hook, which is harmless
+        # (any live replica's progress is loop progress).
+        from nhd_tpu.solver.guard import GUARD
+
+        GUARD.heartbeat = self._beat
         self._stream = None   # built lazily past STREAM_NODE_THRESH
         # incremental cluster state (NHD_DELTA_STATE): the ClusterDelta
         # over self.nodes plus its delta-built ScheduleContext, reused

@@ -105,6 +105,10 @@ class ChaosStats:
     # incremental-state rebuilds observed across the run (the
     # delta/rebuild invariant: faults may COST rebuilds, never parity)
     delta_rebuilds: int = 0
+    # solver data-plane storm (device-faults profile): resident device
+    # rows corrupted in place by the sim — the guard's audit must find
+    # and repair every one before it can influence a bind
+    bit_flips: int = 0
     # HA mode: lease epoch high-water mark (== total acquisitions) and
     # the longest stretch of steps with no replica believing it leads
     lease_epoch: int = 0
@@ -332,6 +336,48 @@ class ChaosSim:
         base.clock = self.sim_clock
         self.base = base
         self.fed_profile = api_faults if self.federation else None
+        # solver data-plane storm (device-faults profile): the guard and
+        # the device plane are process-global, so device profiles run
+        # SOLO mode only. The injector rides its own seeded RNG stream
+        # (like the flap rng) and the profile's API-fault fields are all
+        # zero, so the cell's churn/action sequence stays bit-identical
+        # to a fault-free run of the same seed — that equality is the
+        # bind-parity invariant the device-chaos matrix checks.
+        self.device_profile = None
+        self.device_injector = None
+        if api_faults is not None and api_faults.has_device_faults():
+            if ha or federation:
+                raise ValueError(
+                    "device-fault profiles run solo mode only (the "
+                    "solver guard and device plane are process-global)"
+                )
+            from nhd_tpu.solver.batch import _accelerator_backend
+
+            if (
+                os.environ.get("NHD_TPU_DEVICE_STATE") != "1"
+                and not _accelerator_backend()
+            ):
+                # on the CPU backend the resident-state path is off by
+                # default — a device storm against no device state would
+                # pass vacuously. Fail loud instead. (The real backend
+                # is consulted, not JAX_PLATFORMS: on an accelerator box
+                # the env is typically unset and the resident path is
+                # auto-on.)
+                raise ValueError(
+                    "device-fault profiles need the resident-state path "
+                    "active: set NHD_TPU_DEVICE_STATE=1 (chaos_storm "
+                    "--device-plane does)"
+                )
+            from nhd_tpu.sim.faults import DeviceFaultInjector
+            from nhd_tpu.solver import guard
+
+            self.device_profile = api_faults
+            self._dev_rng = random.Random(seed + 424243)
+            self.device_injector = DeviceFaultInjector(
+                api_faults, self._dev_rng
+            )
+            guard.GUARD.reset()
+            guard.set_fault_injector(self.device_injector)
         if api_faults is not None and not self.federation:
             # the fault RNG is its own seeded stream: fault timing stays
             # reproducible without perturbing the churn sequence
@@ -421,9 +467,17 @@ class ChaosSim:
                 for k, n in r.faulty.fault_stats.items():
                     tot[k] = tot.get(k, 0) + n
             return tot
-        if isinstance(self.backend, FaultyBackend):
-            return dict(self.backend.fault_stats)
-        return {}
+        tot = (
+            dict(self.backend.fault_stats)
+            if isinstance(self.backend, FaultyBackend) else {}
+        )
+        if self.device_injector is not None:
+            tot.update({
+                f"device_{k}": n
+                for k, n in self.device_injector.stats.items()
+            })
+            tot["device_bit_flips"] = self.stats.bit_flips
+        return tot
 
     # ------------------------------------------------------------------
     # fleet observability producers (federation mode with tracing on):
@@ -782,6 +836,57 @@ class ChaosSim:
             )
         self.stats.node_flaps += 1
 
+    def _resident_dev(self):
+        """The solo scheduler's live device-resident state, or None
+        (no batch has built the delta context yet)."""
+        ctx = getattr(self.sched, "_delta_ctx", None)
+        return ctx.dev if ctx is not None else None
+
+    def _act_bit_flip(self) -> None:
+        """Corrupt one resident device row in place (its OWN seeded
+        stream, like the flap rng, so fault timing never perturbs the
+        churn sequence): the guard's batch-start audit must detect and
+        repair it from host truth before any solve reads the row. With
+        the guard disabled (NHD_GUARD=0 — the negative control),
+        the corruption persists and device_audit_errors() proves the
+        parity invariant fires."""
+        if self._dev_rng.random() >= self.device_profile.device_bit_flip:
+            return
+        dev = self._resident_dev()
+        if dev is None or dev.N <= 0:
+            return
+        import numpy as np
+
+        from nhd_tpu.solver.encode import DELTA_FIELDS
+
+        name = self._dev_rng.choice(DELTA_FIELDS)
+        row = self._dev_rng.randrange(dev.N)
+        cur = np.asarray(dev._dev[name][row])
+        bad = ~cur if cur.dtype == np.bool_ else cur + np.ones_like(cur)
+        dev._dev[name] = dev._dev[name].at[row].set(bad)
+        self.stats.bit_flips += 1
+
+    def device_audit_errors(self) -> List[str]:
+        """Full-coverage audit of the live resident state against the
+        host mirror ([] = bit-exact) — the device-faults acceptance
+        check, and the negative control's tripwire: a bit-flipped run
+        with the guard DISABLED must end with defects here."""
+        dev = self._resident_dev()
+        if dev is None:
+            return []
+        from nhd_tpu.solver.guard import audit_device_rows
+
+        return audit_device_rows(dev, range(dev.N))
+
+    def bound_set(self) -> List[Tuple[str, str, str]]:
+        """Sorted (ns, pod, node) of every bound pod — the bind-parity
+        invariant compares a faulted run's end state against a
+        fault-free run of the same seed with this."""
+        return sorted(
+            (p.namespace, p.name, p.node)
+            for p in self.base.pods.values() if p.node
+        )
+
     def _act_kill_wave(self) -> None:
         """Federation-only: take 1..N-1 replicas down simultaneously for
         a couple of steps — their shards must expire, rebalance onto the
@@ -834,6 +939,13 @@ class ChaosSim:
             # the split-brain overlap fencing exists for
             for r in self.rng.sample(self.replicas, len(self.replicas)):
                 r.elector.tick()
+        if self.device_injector is not None:
+            # refill the step's injection budget, then maybe corrupt a
+            # resident row — BEFORE the control plane drives, so the
+            # guard's batch-start audit is what stands between the
+            # corruption and the step's solves
+            self.device_injector.begin_step()
+            self._act_bit_flip()
         actions = [
             self._act_create, self._act_delete, self._act_cordon,
             self._act_maintenance, self._act_bind_failure, self._act_restart,
@@ -1215,6 +1327,8 @@ class ChaosSim:
                     r.faulty.enabled = False
         elif isinstance(self.backend, FaultyBackend):
             self.backend.enabled = False
+        if self.device_injector is not None:
+            self.device_injector.enabled = False
         for _ in range(rounds):
             self._now += STEP_SEC
             if self.federation:
@@ -1242,6 +1356,11 @@ class ChaosSim:
                         f"{limit:.1f}"
                     )
         self._maybe_capture_violation()
+        if self.device_injector is not None:
+            # leave the process-global seam clean for the next cell
+            from nhd_tpu.solver import guard
+
+            guard.set_fault_injector(None)
         return self.unplaced_pods()
 
     def worst_burn_rates(self) -> Dict[str, float]:

@@ -61,6 +61,19 @@ class FaultProfile:
     # other replica keeps working — for 1..partition_steps steps
     partition: float = 0.0
     partition_steps: int = 3
+    # solver data-plane (solver/guard.py; injected through
+    # guard.set_fault_injector at the dispatch/upload/megaround sites,
+    # plus direct resident-row bit flips applied by ChaosSim — the
+    # failure surface PRs 8-10 created and the guard ladder absorbs)
+    device_dispatch_error: float = 0.0  # fused solve dispatch raises
+    device_upload_error: float = 0.0    # resident-row scatter/upload raises
+    device_bit_flip: float = 0.0        # per-step resident device row flip
+    device_slow_dispatch: float = 0.0   # dispatch stalls slow_seconds
+    #: injected device EXCEPTIONS per chaos step are capped here, like
+    #: the once-per-pod transient writes above: the guard's bounded
+    #: per-rung retries then provably absorb every step's faults, so a
+    #: faulted storm's end state stays comparable to the fault-free run
+    device_faults_per_step: int = 1
     # HTTP-level (FaultyHttpClient)
     http_error: float = 0.0            # injected HTTP error status
     http_statuses: Tuple[int, ...] = (500, 503, 429)
@@ -76,6 +89,16 @@ class FaultProfile:
     # profile makes no SLO promise (the heavy storms legitimately torch
     # the budget; calibrated profiles and the fleet demo set a bound)
     slo_burn_limit: Optional[float] = None
+
+    def has_device_faults(self) -> bool:
+        """Whether this profile storms the solver data plane (ChaosSim
+        then installs a DeviceFaultInjector and the bit-flip action)."""
+        return any(
+            p > 0 for p in (
+                self.device_dispatch_error, self.device_upload_error,
+                self.device_bit_flip, self.device_slow_dispatch,
+            )
+        )
 
 
 #: the fault-storm matrix swept by `make chaos` (tools/chaos_storm.py)
@@ -113,6 +136,17 @@ PROFILES: Dict[str, FaultProfile] = {
     "churn": FaultProfile(
         name="churn", drop_watch_event=0.25, poison_watch_event=0.20,
         transient_bind=0.15, transient_annotate=0.10,
+    ),
+    # solver data-plane storm (`make device-chaos`, solo mode only —
+    # the guard is process-global): injected dispatch/upload faults,
+    # slow dispatches and resident-row bit flips, with every API-fault
+    # field ZERO so the cell's churn sequence is bit-identical to a
+    # fault-free run of the same seed — the bind-parity invariant
+    # (tools/chaos_storm.py --bind-parity) compares exactly that
+    "device-faults": FaultProfile(
+        name="device-faults", device_dispatch_error=0.12,
+        device_upload_error=0.06, device_bit_flip=0.20,
+        device_slow_dispatch=0.05, slow_seconds=0.002,
     ),
     # federation storms (ChaosSim federation=S, `make fed-chaos`): the
     # ha-* fault surface PLUS asymmetric partitions; kill/restart waves
@@ -249,6 +283,65 @@ def install_http_faults(
     backend.v1._api._http = lead
     backend.crd._api._http = lead.for_inner(backend.crd._api._http)
     return lead
+
+
+# ---------------------------------------------------------------------------
+# solver data-plane seam (solver/guard.py)
+# ---------------------------------------------------------------------------
+
+
+class DeviceFaultInjector:
+    """The ``guard.set_fault_injector`` target: called at every
+    device-plane dispatch site (``dispatch`` / ``upload`` /
+    ``megaround``, see solver/guard.maybe_inject) with a seeded RNG of
+    its own, it raises :class:`guard.InjectedDeviceFault` (classified
+    transient, like the XLA runtime faults it stands in for) or sleeps
+    (slow dispatch — the guard must NOT misread slowness as a fault).
+
+    Exceptions are budgeted per chaos step (``device_faults_per_step``),
+    mirroring the once-per-pod transient writes of FaultyBackend: the
+    guard's bounded per-rung retries then provably absorb every step's
+    injections, which is what makes the bind-parity invariant (faulted
+    end state bit-identical to the fault-free run) checkable rather
+    than probabilistic. ``begin_step`` refills the budget."""
+
+    def __init__(self, profile: FaultProfile,
+                 rng: Optional[random.Random] = None, sleep=time.sleep):
+        self.profile = profile
+        self.rng = rng or random.Random(0)
+        self._sleep = sleep
+        self.enabled = True
+        self._left = int(profile.device_faults_per_step)
+        self.stats: Dict[str, int] = {
+            "dispatch_errors": 0, "upload_errors": 0, "slow_dispatches": 0,
+        }
+
+    def begin_step(self) -> None:
+        self._left = int(self.profile.device_faults_per_step)
+
+    def _roll(self, p: float) -> bool:
+        return self.enabled and p > 0 and self.rng.random() < p
+
+    def __call__(self, site: str, detail: str = "") -> None:
+        from nhd_tpu.solver.guard import InjectedDeviceFault
+
+        if self._roll(self.profile.device_slow_dispatch):
+            self.stats["slow_dispatches"] += 1
+            self._sleep(self.profile.slow_seconds)
+        if self._left <= 0:
+            return
+        if site == "dispatch":
+            p, stat = self.profile.device_dispatch_error, "dispatch_errors"
+        elif site in ("upload", "megaround"):
+            p, stat = self.profile.device_upload_error, "upload_errors"
+        else:
+            return
+        if self._roll(p):
+            self._left -= 1
+            self.stats[stat] += 1
+            raise InjectedDeviceFault(
+                f"injected device fault at {site} ({detail})"
+            )
 
 
 # ---------------------------------------------------------------------------

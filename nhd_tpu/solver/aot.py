@@ -234,7 +234,14 @@ class AotCache:
             self.logger.info(f"aot: exported {key.name()} ({len(blob)} bytes)")
         except Exception as exc:
             # export is an optimization for the NEXT restart — it must
-            # never break the run that volunteered it
+            # never break the run that volunteered it. But the worker
+            # thread is otherwise invisible: count every failure
+            # (nhd_aot_export_failures_total) and log the first with its
+            # shape key, or an export plane dead for the daemon's whole
+            # life would read as "cache warm" forever
+            from nhd_tpu.k8s.retry import API_COUNTERS
+
+            API_COUNTERS.inc("aot_export_failures_total")
             with self._lock:
                 warned, self._warned_export = self._warned_export, True
             if not warned:
@@ -242,6 +249,21 @@ class AotCache:
                     f"aot: export of {key.name()} failed (cache skipped, "
                     f"serving unaffected): {exc}"
                 )
+
+    def forget(self, key: ShapeKey) -> None:
+        """Drop *key*'s installed program and quarantine its on-disk
+        artifact — the solver guard's poisoned-program hook
+        (solver/guard.py shape quarantine): a shape whose dispatches
+        keep faulting must not be served from the cache again, this run
+        or the next. Idempotent; a key with no artifact just loses its
+        table entry."""
+        with self._lock:
+            self._programs.pop(key, None)
+        bin_path, meta_path = self._paths(key)
+        if os.path.exists(meta_path) or os.path.exists(bin_path):
+            self._quarantine(
+                meta_path, "solver guard: program faulted repeatedly"
+            )
 
     # -- prewarm -------------------------------------------------------
 
@@ -294,14 +316,20 @@ class AotCache:
             return f"platform {platform!r} not in {meta.get('platforms')!r}"
         return None
 
-    def prewarm(self) -> dict:
+    def prewarm(self, progress: Optional[callable] = None) -> dict:
         """Deserialize, compile and install every valid artifact in the
         cache directory; quarantine the rest. Mesh artifacts (sharded
         programs) install under their mesh-qualified key when this host
         exposes enough devices — too few devices SKIPS the artifact
         (it is not stale, just inapplicable here: a single-chip restart
         must not quarantine the slice's programs). Returns a summary
-        dict (loaded / quarantined / skipped / seconds / keys)."""
+        dict (loaded / quarantined / skipped / seconds / keys).
+
+        ``progress`` is invoked (no args, exceptions swallowed) after
+        EVERY artifact processed — loaded, quarantined or skipped. The
+        CLI wires ``Scheduler._beat`` here so a long multi-artifact
+        compile at startup advances the loop heartbeat per artifact and
+        the stall watchdog never reads prewarm as a wedged loop."""
         t0 = time.perf_counter()
         summary = {
             "loaded": 0, "quarantined": 0, "skipped": 0,
@@ -324,6 +352,16 @@ class AotCache:
             ranked_shape_key,
         )
 
+        def _tick() -> None:
+            # per-artifact liveness: a broken callback must not break
+            # the prewarm that volunteered to report progress
+            if progress is None:
+                return
+            try:
+                progress()
+            except Exception:  # nhdlint: ignore[NHD302]
+                pass
+
         for fname in sorted(os.listdir(directory)):
             if not fname.endswith(".json"):
                 continue
@@ -334,11 +372,13 @@ class AotCache:
             except (OSError, ValueError) as exc:
                 self._quarantine(meta_path, f"unreadable meta: {exc}")
                 summary["quarantined"] += 1
+                _tick()
                 continue
             why = self._validate_meta(meta)
             if why is not None:
                 self._quarantine(meta_path, why)
                 summary["quarantined"] += 1
+                _tick()
                 continue
             desc = meta.get("mesh", "")
             parsed = parse_mesh_desc(desc)
@@ -350,6 +390,7 @@ class AotCache:
             # to skip
             if parsed is not None and parsed[1] > len(jax.local_devices()):
                 summary["skipped"] += 1
+                _tick()
                 continue
             try:
                 key = ShapeKey(
@@ -387,6 +428,7 @@ class AotCache:
             except Exception as exc:
                 self._quarantine(meta_path, f"deserialize/compile: {exc}")
                 summary["quarantined"] += 1
+                _tick()
                 continue
             with self._lock:
                 self._programs[key] = prog
@@ -400,6 +442,7 @@ class AotCache:
             )
             summary["loaded"] += 1
             summary["keys"].append(key.name())
+            _tick()
         summary["seconds"] = time.perf_counter() - t0
         return summary
 
@@ -416,12 +459,16 @@ def maybe_export(key: ShapeKey, fn, args) -> None:
     AOT.maybe_export(key, fn, args)
 
 
+def forget(key: ShapeKey) -> None:
+    AOT.forget(key)
+
+
 def configure(directory: Optional[str] = None, save: Optional[bool] = None):
     AOT.configure(directory, save)
 
 
-def prewarm() -> dict:
-    return AOT.prewarm()
+def prewarm(progress: Optional[callable] = None) -> dict:
+    return AOT.prewarm(progress)
 
 
 def reset() -> None:

@@ -50,6 +50,13 @@ from nhd_tpu.solver.encode import (
     encode_pods,
     refresh_node_row,
 )
+from nhd_tpu.solver.guard import (
+    GUARD,
+    RUNG_HOST,
+    RUNG_MESH,
+    RUNG_SINGLE,
+    DeviceCorruptionError,
+)
 from nhd_tpu.solver.kernel import bucket_tractable
 from nhd_tpu.solver.oracle import find_node as oracle_find_node
 from nhd_tpu.solver.fast_assign import (
@@ -60,7 +67,13 @@ from nhd_tpu.solver.fast_assign import (
 )
 from nhd_tpu.obs.recorder import get_recorder
 from nhd_tpu.solver.jax_matcher import decode_mapping
-from nhd_tpu.solver.kernel import rank_budget, solve_bucket_ranked
+from nhd_tpu.solver.kernel import (
+    _pad_pow2,
+    mesh_desc,
+    rank_budget,
+    ranked_shape_key,
+    solve_bucket_ranked,
+)
 from nhd_tpu.utils import get_logger
 
 
@@ -245,6 +258,15 @@ def _accelerator_backend() -> bool:
         return jax.default_backend() != "cpu"
     except Exception:
         return False
+
+
+def _rung_of(dev) -> int:
+    """The ladder rung a solve attempt runs at, read off its device
+    state (solver/guard.py): mesh-sharded resident arrays, single-device
+    resident arrays, or the pure host path."""
+    if dev is None:
+        return RUNG_HOST
+    return RUNG_MESH if dev.mesh is not None else RUNG_SINGLE
 
 
 def _cpu_small_max() -> int:
@@ -752,7 +774,25 @@ class BatchScheduler:
             if self.use_fast
             else None
         )
+        mesh, use_dev = self._guard_posture()
+        dev = (
+            self._build_dev(
+                cluster, mesh,
+                delta.capacity if delta is not None else None,
+            )
+            if use_dev else None
+        )
+        return ScheduleContext(nodes, cluster, fast, dev, now, delta)
+
+    def _guard_posture(self):
+        """(mesh, use_dev) for a fresh device-state build, with the
+        solver guard's degradation floor applied: a condemned mesh
+        strips to single-device, a condemned device plane strips to the
+        host path (solver/guard.py ladder). With the guard at full
+        fidelity this is exactly the pre-guard auto logic."""
         mesh = self._resolve_mesh()
+        if GUARD.active() and not GUARD.allow_mesh():
+            mesh = None
         use_dev = (
             self.device_state is True
             or (
@@ -760,14 +800,107 @@ class BatchScheduler:
                 and (_accelerator_backend() or mesh is not None)
             )
         )
-        dev = (
-            DeviceClusterState(
-                cluster, mesh,
-                capacity=delta.capacity if delta is not None else None,
+        if GUARD.active() and not GUARD.allow_device():
+            use_dev = False
+        return mesh, use_dev
+
+    def _build_dev(self, cluster, mesh, capacity):
+        """Construct device-resident state under the guard's fault
+        boundary: the BUILD itself dispatches device_puts, and on a
+        hard-down device (dead tunnel) it faults exactly like a solve
+        would — walking the ladder rung by rung would re-fault at every
+        device rung, so a transient construction failure condemns the
+        device plane straight to the host rung and returns None. With
+        the guard off (or a terminal fault) it raises as before."""
+        from nhd_tpu.solver.guard import classify_device_fault
+
+        if not GUARD.active():
+            return DeviceClusterState(cluster, mesh, capacity=capacity)
+        try:
+            return DeviceClusterState(cluster, mesh, capacity=capacity)
+        except Exception as exc:
+            if not classify_device_fault(exc):
+                raise
+            self.logger.error(
+                "solver guard: device-state build failed (device plane "
+                f"unreachable); condemning to the host rung: {exc!r}"
             )
+            GUARD.condemn_device(exc)
+            return None
+
+    def _reposture_dev(self, ctx: ScheduleContext) -> None:
+        """Rebuild a persistent context's device state when the guard's
+        floor moved between batches — degradation condemns the resident
+        plane (or just its mesh), re-promotion after clean probe rounds
+        re-derives it from host truth at the faster rung. A no-op when
+        the posture already matches (the steady-state branch)."""
+        mesh, use_dev = self._guard_posture()
+        cur = ctx.dev
+        if use_dev == (cur is not None) and (
+            cur is None or (cur.mesh is not None) == (mesh is not None)
+        ):
+            return
+        capacity = ctx.delta.capacity if ctx.delta is not None else None
+        ctx.dev = (
+            self._build_dev(ctx.cluster, mesh, capacity)
             if use_dev else None
         )
-        return ScheduleContext(nodes, cluster, fast, dev, now, delta)
+        if ctx.dev is not None:
+            GUARD.note_repair()
+
+    def _guard_recover(self, dev, cluster, context):
+        """Condemn + rebuild the device plane after a transient fault,
+        at the guard's (possibly degraded) allowed rung: resident arrays
+        re-derive wholesale from the host ClusterArrays — the SURVEY
+        §5.4 re-derivability contract spent at failure time. Returns the
+        replacement device state (None = host rung) and re-points a
+        persistent context at it so later batches inherit the posture."""
+        new = None
+        if dev is not None and GUARD.allow_device():
+            mesh = dev.mesh if GUARD.allow_mesh() else None
+            capacity = (
+                context.delta.capacity
+                if context is not None and context.delta is not None
+                else None
+            )
+            new = self._build_dev(cluster, mesh, capacity)
+            if new is not None:
+                GUARD.note_repair()
+        elif dev is not None:
+            self.logger.error(
+                "solver guard: device state condemned; this batch "
+                "continues on the host solve path"
+            )
+        if context is not None:
+            context.dev = new
+        return new
+
+    def _guard_audit(self, dev, cluster, context, stats):
+        """Batch-start resident-state audit (solver/guard.py): flush any
+        staged claim rows (the device may legitimately lag them), then
+        bit-exact spot-check the budgeted row sample against the host
+        mirror. Corruption repairs IN PLACE (rebuild_resident — host
+        truth wins) before any solve reads the poisoned rows. A device
+        fault inside the audit itself takes the same recover path as a
+        round fault. Returns the (possibly replaced) device state."""
+        t0 = time.perf_counter()
+        try:
+            dev._flush_staged()
+            errs = GUARD.run_audit(dev)
+            if errs:
+                for e in errs[:4]:
+                    self.logger.error(f"resident-state audit: {e}")
+                dev.rebuild_resident()
+                GUARD.note_repair()
+            return dev
+        except Exception as exc:
+            if GUARD.on_fault(
+                exc, rung=_rung_of(dev), attempt=1
+            ) != "retry":
+                raise
+            return self._guard_recover(dev, cluster, context)
+        finally:
+            stats.phase_add("guard_audit", time.perf_counter() - t0)
 
     def refresh_context(
         self, ctx: ScheduleContext, *, now: Optional[float] = None,
@@ -786,6 +919,11 @@ class BatchScheduler:
             raise ValueError("refresh_context needs a delta-built context")
         if now is None:
             now = time.monotonic()
+        if GUARD.active():
+            # guard posture drift: a degradation (or re-promotion after
+            # clean probe rounds) between batches rebuilds the resident
+            # plane at the allowed rung before this batch's rows scatter
+            self._reposture_dev(ctx)
         delta.refresh(now)
         ctx.now = now
         if delta.consume_full():
@@ -798,8 +936,8 @@ class BatchScheduler:
                 if self.use_fast else None
             )
             if ctx.dev is not None:
-                ctx.dev = DeviceClusterState(
-                    ctx.cluster, ctx.dev.mesh, capacity=delta.capacity
+                ctx.dev = self._build_dev(
+                    ctx.cluster, ctx.dev.mesh, delta.capacity
                 )
             return ctx
         rows = delta.drain_dirty()
@@ -975,16 +1113,20 @@ class BatchScheduler:
             # keep node arrays resident on device across rounds; per-round
             # uploads shrink to the claimed rows (solver/device_state.py).
             # A multi-device mesh implies resident state: sharded arrays must
-            # live on their devices for the SPMD solve.
-            mesh = self._resolve_mesh()
-            use_dev = (
-                self.device_state is True
-                or (
-                    self.device_state == "auto"
-                    and (_accelerator_backend() or mesh is not None)
-                )
-            )
-            dev = DeviceClusterState(cluster, mesh) if use_dev else None
+            # live on their devices for the SPMD solve. The guard's
+            # degradation floor applies here too (_guard_posture), and a
+            # build that faults on a dead device condemns to the host
+            # rung instead of crashing the batch (_build_dev).
+            mesh, use_dev = self._guard_posture()
+            dev = self._build_dev(cluster, mesh, None) if use_dev else None
+        guard_on = GUARD.active()
+        if guard_on and dev is not None and GUARD.audit_due():
+            # periodic + on-suspicion resident-state audit BEFORE any
+            # solve of this batch reads the resident rows: a corrupted
+            # row repairs from host truth here, so a clean batch's binds
+            # are bit-identical to a fault-free run (the device-faults
+            # chaos invariant)
+            dev = self._guard_audit(dev, cluster, context, stats)
         records: Dict[int, AssignRecord] = {}
         busy_nodes: set = set()
         all_buckets = None
@@ -1055,14 +1197,6 @@ class BatchScheduler:
             is_pending[:] = False
             is_pending[pending] = True
 
-            # (pod index, node index, bucket G, type, rank position)
-            claims: List[Tuple[int, int, int, int, int]] = []
-            bucket_out = {}
-            # pins the jax RankOuts whose buffers RankHost's zero-copy
-            # views alias, for the round's lifetime — correctness must not
-            # hinge on any particular backend's buffer-export semantics
-            keepalive: List[object] = []
-
             # dispatch every bucket's solve+rank before pulling any result:
             # jax dispatch is async, so the buckets' XLA programs overlap
             # instead of serializing on the first np.asarray block.
@@ -1085,6 +1219,30 @@ class BatchScheduler:
                     pod_index=full.pod_index[mask],
                 )
 
+            def _shape_key(G, pods, host: bool) -> str:
+                """The ranked_shape_key this bucket's dispatch runs
+                under — matches kernel.dispatch_ranked's key exactly, so
+                the guard's quarantine attribution joins on it."""
+                if host or dev is None:
+                    Np_k = _pad_pow2(cluster.n_nodes, floor=8)
+                    desc = ""
+                else:
+                    Np_k = dev.Np
+                    desc = mesh_desc(dev.mesh)
+                return ranked_shape_key(
+                    G, cluster.U, cluster.K, min(R, Np_k),
+                    _pad_pow2(pods.n_types), Np_k, desc,
+                )
+
+            def _stamp(exc: BaseException, G, pods, host: bool) -> None:
+                """Attribute a dispatch/pull fault to its bucket's shape
+                key (best effort — some exception types refuse new
+                attributes) for the guard's quarantine ledger."""
+                try:
+                    exc._nhd_shape_key = _shape_key(G, pods, host)
+                except Exception:  # nhdlint: ignore[NHD302]
+                    pass
+
             def _dispatch_solves(use_cpu: bool = False):
                 launched = []
                 if use_cpu:
@@ -1096,19 +1254,26 @@ class BatchScheduler:
                             if not mask.any():
                                 continue
                             pods = _membership(full, mask)
-                            launched.append(
-                                (G, pods, solve_bucket_ranked(cluster, pods, R))
-                            )
+                            try:
+                                out = solve_bucket_ranked(cluster, pods, R)
+                            except Exception as exc:
+                                _stamp(exc, G, pods, host=True)
+                                raise
+                            launched.append((G, pods, out))
                     return launched
                 for G, full in all_buckets.items():
                     mask = is_pending[full.pod_index]
                     if not mask.any():
                         continue
                     pods = _membership(full, mask)
-                    out = (
-                        dev.solve_ranked(pods, R) if dev
-                        else solve_bucket_ranked(cluster, pods, R)
-                    )
+                    try:
+                        out = (
+                            dev.solve_ranked(pods, R) if dev
+                            else solve_bucket_ranked(cluster, pods, R)
+                        )
+                    except Exception as exc:
+                        _stamp(exc, G, pods, host=dev is None)
+                        raise
                     launched.append((G, pods, out))
                 return launched
 
@@ -1120,87 +1285,165 @@ class BatchScheduler:
                     and cluster.n_nodes <= _cpu_small_nodes()
                 )
 
-            use_cpu_round = _route_cpu(len(pending))
-            if use_cpu_round:
-                stats.count_add("cpu_routed_rounds", 1)
-            spec_round = spec_ok and round_no == 0 and not use_cpu_round
-            spec = None
-            if prelaunched is not None:
-                # round r-1 dispatched this round's solves right after its
-                # native assign; its result materialization ran under the
-                # XLA compute (the round-pipelining that keeps host work
-                # off the critical path)
-                launched = prelaunched
-                prelaunched = None
-            else:
-                if spec_round:
-                    t_sp = time.perf_counter()
-                    spec = self._speculate_dispatch(
-                        dev, all_buckets, is_pending
-                    )
-                    stats.phase_add(
-                        "spec_dispatch", time.perf_counter() - t_sp
-                    )
-                    launched = []
-                if spec is None:
-                    # nothing to speculate, or a small CPU-routed
-                    # batch: classic round
-                    spec_round = False
-                    launched = _dispatch_solves(use_cpu_round)
-            if submit_fast:
-                # first dispatch is in flight: the build's CPU time now
-                # hides under the relay flush (see submit_fast above)
-                submit_fast = False
-                fast_future = _fc_executor().submit(
-                    FastCluster, nodes, cluster.U, cluster.K,
-                    arrays=cluster, static_cache=self._fc_static,
-                )
-            if fast_future is not None:
-                # join here, while the just-dispatched solves (or the
-                # in-flight megaround) compute in the XLA pool: the build
-                # hides under the relay turnaround, and the worker never
-                # outlives schedule()
-                t_j = time.perf_counter()
-                fast = fast_future.result()
-                fast_future = None
-                stats.phase_add("fast_join", time.perf_counter() - t_j)
-            claims_np = counts_np = None
-            if spec_round:
-                # ONE relay flush pulls the claim tensor AND its counts
-                # plane; the flush was started by the copy_to_host_async
-                # at dispatch (_speculate_dispatch), so the FastCluster
-                # join above ran under it and this asarray pays only the
-                # remaining flush time (sequential asarray pulls without
-                # the async batch each pay a full ~65 ms turnaround —
-                # measured 130 ms vs 65 ms, docs/TPU_STATUS.md r4)
-                t_pull = time.perf_counter()
-                # the speculative round's ONE sanctioned flush (NHD107):
-                # all four tensors were copy_to_host_async'd at dispatch
-                claims_np = np.asarray(spec.claims)  # nhdlint: ignore[NHD107]
-                counts_np = np.asarray(spec.counts)  # nhdlint: ignore[NHD107]
-                spec_need_left = int(np.asarray(spec.need_left).sum())  # nhdlint: ignore[NHD107]
-                spec_it = int(np.asarray(spec.iters_used))  # nhdlint: ignore[NHD107]
-                stats.phase_add("spec_pull", time.perf_counter() - t_pull)
-            for G, pods, out in launched:
+            # ---- solve phase, under the guard's fault boundary ------
+            # Any exception out of a device dispatch, an async pull or
+            # the rank-tensor screen is classified (solver/guard.py);
+            # a transient fault condemns the device state, rebuilds it
+            # from host truth at a (possibly degraded) rung, and
+            # RE-DISPATCHES the whole round — none of this round's
+            # claims has been applied yet, so a retried round can never
+            # produce a wrong or partial bind. Terminal faults and an
+            # exhausted ladder surface to the scheduler's _guarded
+            # isolation exactly as before the guard existed.
+            guard_attempts = 0
+            while True:
+                # (pod index, node index, bucket G, type, rank position)
+                claims: List[Tuple[int, int, int, int, int]] = []
+                bucket_out = {}
+                # pins the jax RankOuts whose buffers RankHost's
+                # zero-copy views alias, for the round's lifetime —
+                # correctness must not hinge on any particular backend's
+                # buffer-export semantics
+                keepalive: List[object] = []
+                spec = None
+                claims_np = counts_np = None
                 try:
-                    out.copy_to_host_async()  # batch all bucket pulls
-                except Exception:  # nhdlint: ignore[NHD302]
-                    pass  # prefetch hint only; sync pull below still works
-            for G, pods, out in launched:
-                # pull results to host in ONE transfer — the rank output
-                # is a single packed [9, Tp, R] tensor because each
-                # device→host transfer costs ~84 ms of relay latency on
-                # the tunnel-attached TPU regardless of size (nine
-                # separate field pulls were the round bottleneck,
-                # docs/TPU_STATUS.md). RankHost's fields are zero-copy
-                # row views on CPU; `keepalive` pins the owning array
-                # for the round's lifetime
-                keepalive.append(out)
-                T = pods.n_types
-                # the classic round's ONE sanctioned flush (NHD107): the
-                # copy_to_host_async loop above batched every bucket pull
-                arr = np.asarray(out)  # nhdlint: ignore[NHD107]
-                bucket_out[G] = (pods, RankHost(*arr[:, :T]))
+                    use_cpu_round = _route_cpu(len(pending))
+                    if use_cpu_round and guard_attempts == 0:
+                        stats.count_add("cpu_routed_rounds", 1)
+                    spec_round = (
+                        spec_ok and round_no == 0 and not use_cpu_round
+                    )
+                    if prelaunched is not None:
+                        # round r-1 dispatched this round's solves right
+                        # after its native assign; its result
+                        # materialization ran under the XLA compute (the
+                        # round-pipelining that keeps host work off the
+                        # critical path)
+                        launched = prelaunched
+                        prelaunched = None
+                    else:
+                        if spec_round:
+                            t_sp = time.perf_counter()
+                            spec = self._speculate_dispatch(
+                                dev, all_buckets, is_pending
+                            )
+                            stats.phase_add(
+                                "spec_dispatch", time.perf_counter() - t_sp
+                            )
+                            launched = []
+                        if spec is None:
+                            # nothing to speculate, or a small CPU-routed
+                            # batch: classic round
+                            spec_round = False
+                            launched = _dispatch_solves(use_cpu_round)
+                    if submit_fast:
+                        # first dispatch is in flight: the build's CPU
+                        # time now hides under the relay flush (see
+                        # submit_fast above)
+                        submit_fast = False
+                        fast_future = _fc_executor().submit(
+                            FastCluster, nodes, cluster.U, cluster.K,
+                            arrays=cluster, static_cache=self._fc_static,
+                        )
+                    if fast_future is not None:
+                        # join here, while the just-dispatched solves (or
+                        # the in-flight megaround) compute in the XLA
+                        # pool: the build hides under the relay
+                        # turnaround, and the worker never outlives
+                        # schedule()
+                        t_j = time.perf_counter()
+                        fast = fast_future.result()
+                        fast_future = None
+                        stats.phase_add(
+                            "fast_join", time.perf_counter() - t_j
+                        )
+                    if spec_round:
+                        # ONE relay flush pulls the claim tensor AND its
+                        # counts plane; the flush was started by the
+                        # copy_to_host_async at dispatch
+                        # (_speculate_dispatch), so the FastCluster join
+                        # above ran under it and this asarray pays only
+                        # the remaining flush time (sequential asarray
+                        # pulls without the async batch each pay a full
+                        # ~65 ms turnaround — measured 130 ms vs 65 ms,
+                        # docs/TPU_STATUS.md r4)
+                        t_pull = time.perf_counter()
+                        # the speculative round's ONE sanctioned flush
+                        # (NHD107): all four tensors were
+                        # copy_to_host_async'd at dispatch
+                        claims_np = np.asarray(spec.claims)  # nhdlint: ignore[NHD107]
+                        counts_np = np.asarray(spec.counts)  # nhdlint: ignore[NHD107]
+                        spec_need_left = int(np.asarray(spec.need_left).sum())  # nhdlint: ignore[NHD107]
+                        spec_it = int(np.asarray(spec.iters_used))  # nhdlint: ignore[NHD107]
+                        stats.phase_add(
+                            "spec_pull", time.perf_counter() - t_pull
+                        )
+                    for G, pods, out in launched:
+                        try:
+                            out.copy_to_host_async()  # batch bucket pulls
+                        except Exception:  # nhdlint: ignore[NHD302]
+                            pass  # prefetch hint only; sync pull works
+                    for G, pods, out in launched:
+                        # pull results to host in ONE transfer — the rank
+                        # output is a single packed [9, Tp, R] tensor
+                        # because each device→host transfer costs ~84 ms
+                        # of relay latency on the tunnel-attached TPU
+                        # regardless of size (nine separate field pulls
+                        # were the round bottleneck, docs/TPU_STATUS.md).
+                        # RankHost's fields are zero-copy row views on
+                        # CPU; `keepalive` pins the owning array for the
+                        # round's lifetime
+                        keepalive.append(out)
+                        T = pods.n_types
+                        try:
+                            # the classic round's ONE sanctioned flush
+                            # (NHD107): the copy_to_host_async loop above
+                            # batched every bucket pull
+                            arr = np.asarray(out)  # nhdlint: ignore[NHD107]
+                            if guard_on:
+                                # value-domain screen BEFORE any winner
+                                # materializes (the int analog of a
+                                # NaN/inf screen, solver/guard.py).
+                                # CPU-routed rounds solved at the HOST
+                                # pad even when resident state exists —
+                                # screening by dev.Np there would admit
+                                # corrupt indices in [host_Np, dev.Np)
+                                npad = (
+                                    dev.Np
+                                    if dev is not None and not use_cpu_round
+                                    else _pad_pow2(
+                                        cluster.n_nodes, floor=8
+                                    )
+                                )
+                                defect = GUARD.screen_rank(arr, npad)
+                                if defect:
+                                    raise DeviceCorruptionError(
+                                        f"rank-tensor screen: {defect}"
+                                    )
+                        except Exception as exc:
+                            _stamp(exc, G, pods, host=use_cpu_round
+                                   or dev is None)
+                            raise
+                        bucket_out[G] = (pods, RankHost(*arr[:, :T]))
+                    break
+                except Exception as exc:
+                    if not guard_on:
+                        raise
+                    guard_attempts += 1
+                    if GUARD.on_fault(
+                        exc, rung=_rung_of(dev), attempt=guard_attempts,
+                        shape_key=getattr(exc, "_nhd_shape_key", ""),
+                    ) != "retry":
+                        raise
+                    # a faulted batch never speculates again: the classic
+                    # round's host re-verification is the conservative
+                    # posture while the device plane is suspect
+                    spec_ok = False
+                    prelaunched = None
+                    dev = self._guard_recover(dev, cluster, context)
+            if guard_on:
+                GUARD.note_round_clean()
             stats.solve_seconds += time.perf_counter() - t0
 
             t0 = time.perf_counter()
@@ -1445,7 +1688,22 @@ class BatchScheduler:
                 if len(pending) and round_no + 1 < self.max_rounds:
                     is_pending[:] = False
                     is_pending[pending] = True
-                    prelaunched = _dispatch_solves(_route_cpu(len(pending)))
+                    try:
+                        prelaunched = _dispatch_solves(
+                            _route_cpu(len(pending))
+                        )
+                    except Exception as exc:
+                        # a prelaunch fault costs only the pipelining:
+                        # recover the device plane now and let the next
+                        # round dispatch fresh under its own boundary
+                        if not guard_on or GUARD.on_fault(
+                            exc, rung=_rung_of(dev), attempt=1,
+                            shape_key=getattr(exc, "_nhd_shape_key", ""),
+                        ) != "retry":
+                            raise
+                        prelaunched = None
+                        spec_ok = False
+                        dev = self._guard_recover(dev, cluster, context)
 
                 t_mat = time.perf_counter()
                 for bi, (G, pods, w_pod, w_node, w_type, buffers, w_c, w_m) in (

@@ -245,6 +245,9 @@ class DeviceClusterState:
         JIT_STATS.record_use(
             "row_scatter", f"A{len(names)}_W{Wp}_N{self.Np}"
         )
+        from nhd_tpu.solver import guard
+
+        guard.maybe_inject("upload", f"scatter_W{Wp}_N{self.Np}")
         fn = _get_row_scatter(len(names), _donate_default())
         arrays = tuple(self._dev[name] for name in names)
         host_rows = tuple(
@@ -303,6 +306,11 @@ class DeviceClusterState:
                 gidx[s, :] = s * shard_rows
         JIT_STATS.record_use(
             "mesh_row_scatter", f"A{len(names)}_W{Wp}_N{self.Np}_D{n_dev}"
+        )
+        from nhd_tpu.solver import guard
+
+        guard.maybe_inject(
+            "upload", f"mesh_scatter_W{Wp}_N{self.Np}_D{n_dev}"
         )
         fn = _get_mesh_row_scatter(len(names), self.mesh, _donate_default())
         arrays = tuple(self._dev[name] for name in names)
@@ -451,6 +459,32 @@ class DeviceClusterState:
             mesh=self.mesh,
         )
 
+    def rebuild_resident(self) -> None:
+        """Re-derive EVERY resident array from the host mirror (source
+        of truth) — the guard's repair chokepoint (solver/guard.py):
+        after a detected corruption or a failed dispatch, the whole
+        device plane rebuilds from the live ClusterArrays in place (same
+        capacity bucket, same sharding), and any staged-but-unflushed
+        claim rows are dropped — their values are host truth already, so
+        the wholesale re-upload subsumes them."""
+        self.N = self.cluster.n_nodes
+        if self.N > self.Np:
+            raise ValueError(
+                f"cluster grew past the resident capacity bucket "
+                f"({self.N} > {self.Np}); rebuild DeviceClusterState"
+            )
+        self._staged = False
+        self._staged_rows.clear()
+        for name in _ARG_ORDER:
+            self._dev[name] = self._put(
+                _pad_own(getattr(self.cluster, name), self.Np)
+            )
+        from nhd_tpu.k8s.retry import API_COUNTERS
+
+        API_COUNTERS.inc("device_state_rows_uploaded_total", self.N)
+        if self.mesh is not None:
+            API_COUNTERS.inc("mesh_wholesale_uploads_total")
+
     def _rebuild_mutable(self) -> None:
         """Re-upload the claim-mutated resident arrays wholesale from the
         host mirror (source of truth) — the staged-claim fallback mode
@@ -518,6 +552,9 @@ class DeviceClusterState:
         ))
         mutable = {name: self._dev[name] for name in _MUTABLE}
         static = {name: self._dev[name] for name in _STATIC}
+        from nhd_tpu.solver import guard
+
+        guard.maybe_inject("megaround", f"B{len(bucket_pods)}_N{self.Np}")
         try:
             new_mutable, claims, counts, need_left, it = fn(
                 mutable, static, need, *pod_args

@@ -1,0 +1,559 @@
+"""Solver data-plane fault tolerance: a detect → degrade → repair ladder.
+
+PRs 8-10 made the solver fast by making it stateful and device-resident
+(fused AOT megaround, delta-maintained resident arrays, SPMD mesh) — and
+every one of those layers assumed the accelerator plane never fails. An
+XLA runtime error, a poisoned resident row or a lost mesh device
+mid-round surfaced as an unhandled exception in the scheduling loop,
+with no analog of the API-layer classification/retry/requeue machinery
+(k8s/retry.py) the control plane has had since PR 2. This module is that
+missing layer, built on the one property the repo already verifies
+continuously: **HostNodes are the source of truth and device state is a
+cache** (SURVEY §5.4 re-derivability, the ClusterDelta parity
+invariant). The guard *spends* that property at failure time:
+
+* **Detect** — :func:`classify_device_fault` splits raised device-plane
+  errors into *transient* (XLA runtime faults, transport errors across a
+  TPU tunnel, injected chaos faults, detected corruption — substrate
+  health, mirroring the 429/5xx stance of ``k8s/retry.classify``) and
+  *terminal* (``INVALID_ARGUMENT``/``UNIMPLEMENTED``, TypeError/
+  ValueError — facts about the program that repetition will not fix).
+  A budgeted **resident-state audit** (:func:`audit_device_rows`) runs
+  periodic + on-suspicion bit-exact spot checks of device rows against
+  the host mirror, and :meth:`SolverGuard.screen_rank` screens every
+  pulled rank tensor before winners are materialized (the packed tensor
+  is int32, so the screen is the integer analog of a NaN/inf screen:
+  non-negative ranking values, node indices inside the padded axis; a
+  float dtype is itself a defect and IS NaN/inf-screened).
+
+* **Degrade** — an explicit rung ladder with bounded retries per rung:
+  mesh megaround → single-device megaround → host
+  (``solve_bucket_ranked``). A transient fault condemns the
+  ``DeviceClusterState`` and re-dispatches the round — never a wrong or
+  partial bind (claims only apply after a clean solve; anything already
+  staged at commit time rides the PR 2 unwind+requeue path). The rung
+  floor is process-wide: the next batch (and every streaming tile
+  context) is rebuilt at the allowed rung through
+  ``BatchScheduler.make_context``/``refresh_context``.
+
+* **Repair** — resident arrays rebuild from host truth through the
+  sanctioned chokepoints (``DeviceClusterState.rebuild_resident`` /
+  a fresh ``DeviceClusterState`` over the live ``ClusterArrays``), the
+  guard re-promotes one rung per ``NHD_GUARD_PROBE_ROUNDS`` clean
+  rounds, and a shape key whose program keeps faulting is QUARANTINED
+  (AOT-quarantine style: its artifact moves to ``quarantine/``, its
+  installed program is dropped, and dispatches re-trace live) so one
+  poisoned bucket can't wedge the fleet.
+
+Environment knobs (``NHD_GUARD_*``, read per call so chaos cells and
+tests can flip them): ``NHD_GUARD`` (1; 0 disables the layer — the
+chaos negative control), ``NHD_GUARD_RETRIES`` (attempts per rung per
+round), ``NHD_GUARD_PROBE_ROUNDS`` (clean rounds per re-promotion),
+``NHD_GUARD_AUDIT_INTERVAL`` (batches between periodic audits),
+``NHD_GUARD_AUDIT_ROWS`` (rows per audit; 0 = every row),
+``NHD_GUARD_SHAPE_FAULTS`` (faults before a shape key is quarantined).
+docs/RESILIENCE.md "Layer 8" has the failure model; docs/OPERATIONS.md
+has the knob table and the degraded-mode runbook.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+from nhd_tpu.utils import get_logger
+
+# ---------------------------------------------------------------------------
+# rungs
+# ---------------------------------------------------------------------------
+
+RUNG_MESH = 0     # full fidelity: SPMD megaround over the device mesh
+RUNG_SINGLE = 1   # single-device megaround (mesh condemned)
+RUNG_HOST = 2     # host solve path (device plane condemned entirely)
+
+RUNG_NAMES = ("mesh", "single-device", "host")
+
+
+class DeviceCorruptionError(RuntimeError):
+    """Resident device state diverged from the host mirror (audit), or a
+    pulled rank tensor failed the value-domain screen. Transient by
+    definition: the host mirror is the source of truth, so a rebuild
+    repairs it."""
+
+
+class InjectedDeviceFault(RuntimeError):
+    """A chaos-injected device-plane fault (sim/faults.py
+    DeviceFaultInjector). Classified transient, like the real XLA
+    runtime faults it stands in for."""
+
+
+def _xla_error_types() -> tuple:
+    types: list = []
+    try:
+        from jax.errors import JaxRuntimeError  # noqa: WPS433
+
+        types.append(JaxRuntimeError)
+    except Exception:  # nhdlint: ignore[NHD302]
+        pass  # older jax: fall through to the jaxlib name
+    try:
+        from jax._src.lib import xla_client
+
+        types.append(xla_client.XlaRuntimeError)
+    except Exception:  # nhdlint: ignore[NHD302]
+        pass  # classification degrades to the stdlib set
+    return tuple(types)
+
+
+_XLA_ERRORS = _xla_error_types()
+
+#: substrings of an XLA runtime error that mean "a fact about the
+#: program", not about device health — retrying or degrading cannot fix
+#: a malformed program, and burning the retry budget on one would open
+#: the ladder against a healthy device (same stance as retry.classify's
+#: terminal-4xx rule)
+_TERMINAL_MARKERS = ("INVALID_ARGUMENT", "UNIMPLEMENTED")
+
+
+def classify_device_fault(exc: BaseException) -> bool:
+    """True when *exc* is a transient device-plane fault (retry/degrade
+    may help), False when it is terminal (a fact about the program or
+    the call — surface it). Mirrors ``k8s/retry.classify``: transient =
+    substrate health (5xx/status-0 there; XLA runtime faults, transport
+    errors, detected corruption here), terminal = deterministic facts
+    (4xx there; INVALID_ARGUMENT / TypeError / ValueError here)."""
+    if isinstance(exc, (DeviceCorruptionError, InjectedDeviceFault)):
+        return True
+    if _XLA_ERRORS and isinstance(exc, _XLA_ERRORS):
+        msg = str(exc)
+        return not any(m in msg for m in _TERMINAL_MARKERS)
+    if isinstance(exc, (OSError, MemoryError)):
+        # transport failure across the TPU tunnel / host memory pressure:
+        # a lower rung (smaller footprint, no relay) can genuinely help
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# fault injection seam (sim/faults.py DeviceFaultInjector)
+# ---------------------------------------------------------------------------
+
+_INJECTOR: Optional[Callable[[str, str], None]] = None
+
+
+def set_fault_injector(fn: Optional[Callable[[str, str], None]]) -> None:
+    """Install (or clear, with None) the chaos fault injector. The
+    injector is called at every device-plane dispatch site with
+    ``(site, detail)`` and may raise :class:`InjectedDeviceFault` (or
+    sleep, for slow-dispatch faults). Process-global, like the device
+    plane it faults — ChaosSim restricts device profiles to solo mode."""
+    global _INJECTOR
+    _INJECTOR = fn
+
+
+def maybe_inject(site: str, detail: str = "") -> None:
+    """The dispatch-site hook: no-op unless a chaos injector is
+    installed (one attribute read on the hot path)."""
+    if _INJECTOR is not None:
+        _INJECTOR(site, detail)
+
+
+# ---------------------------------------------------------------------------
+# the resident-state audit
+# ---------------------------------------------------------------------------
+
+
+def audit_device_rows(dev, rows: Iterable[int]) -> List[str]:
+    """Bit-exact spot check of resident device rows against the host
+    mirror (the ClusterDelta parity contract extended one hop further:
+    not only must the packed arrays re-derive from HostNodes, the
+    device copies must equal the packed arrays). Returns defect strings
+    ([] = every sampled row bit-exact). O(|rows|) device pull per
+    array; never on the hot path — the guard budgets and schedules it.
+
+    Rows the caller staged but not yet flushed are the device being
+    legitimately behind, so callers flush first (the audit entrypoints
+    in solver/batch.py do)."""
+    from nhd_tpu.solver.kernel import _ARG_ORDER, _MUTABLE
+
+    n = min(dev.N, dev.cluster.n_nodes)
+    wanted = {int(r) for r in rows if 0 <= int(r) < n}
+    idx_all = np.asarray(sorted(wanted), np.int64)
+    # staged-but-unflushed claim rows: the MUTABLE arrays legitimately
+    # lag the host there until the next flush (stage_rows defers the
+    # scatter into the next dispatch) — and with the flag-only wholesale
+    # mode (NHD_DEVICE_DELTA=0) every mutable array lags. Static arrays
+    # are never claim-mutated, so they are judged at EVERY sampled row.
+    staged = set(getattr(dev, "_staged_rows", None) or ())
+    if getattr(dev, "_staged", False) and not staged:
+        idx_mut = np.zeros(0, np.int64)
+    else:
+        idx_mut = np.asarray(sorted(wanted - staged), np.int64)
+    if idx_all.size == 0:
+        return []
+    errs: List[str] = []
+    names = getattr(dev.cluster, "names", [])
+    # dispatch every gather, THEN start one batched device→host flush
+    # before the first blocking pull: on the tunnel-attached TPU each
+    # separate transfer pays ~65-84 ms of relay latency regardless of
+    # size (docs/TPU_STATUS.md), so 14 sequential pulls would turn one
+    # audit into ~1 s of scheduler stall
+    gathers = {}
+    for name in _ARG_ORDER:
+        idx = idx_mut if name in _MUTABLE else idx_all
+        if idx.size == 0:
+            continue
+        gathers[name] = (idx, dev._dev[name][idx])
+    for _idx, g in gathers.values():
+        try:
+            g.copy_to_host_async()
+        except Exception:  # nhdlint: ignore[NHD302]
+            pass  # prefetch hint only; the sync pull below still works
+    for name, (idx, g) in gathers.items():
+        want = np.asarray(getattr(dev.cluster, name)[idx])
+        # the audit IS a sanctioned host pull of device-resident values
+        got = np.asarray(g)
+        if want.shape != got.shape:
+            errs.append(
+                f"{name}: device rows shape {got.shape} != host {want.shape}"
+            )
+            continue
+        if not np.array_equal(want, got):
+            bad = [
+                int(idx[i]) for i in range(len(idx))
+                if not np.array_equal(want[i], got[i])
+            ][:4]
+            errs.append(
+                f"{name}: device rows {bad} != host mirror "
+                f"(nodes {[names[r] for r in bad if r < len(names)]})"
+            )
+    return errs
+
+
+def _counters():
+    from nhd_tpu.k8s.retry import API_COUNTERS
+
+    return API_COUNTERS
+
+
+# ---------------------------------------------------------------------------
+# the guard
+# ---------------------------------------------------------------------------
+
+
+class SolverGuard:
+    """Process-wide fault-boundary state: the degradation floor, the
+    audit schedule, and the shape-key quarantine. One instance per
+    process (``GUARD``), like the jit cache and the AOT program table it
+    protects — streaming tile workers share it, so every state
+    transition happens under the lock (counters are ApiCounters, already
+    thread-safe). Retry ATTEMPT counting is caller-local (an argument to
+    :meth:`on_fault`), so concurrent tiles can never launder each
+    other's budgets into an unbounded retry loop."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._floor = RUNG_MESH
+        self._clean_rounds = 0
+        self._batches = 0
+        self._last_audit = 0
+        self._audits = 0
+        self._suspicion = False
+        self._shape_faults: dict = {}
+        self._quarantined: set = set()
+        self.logger = get_logger(__name__)
+        #: loop-liveness hook (Scheduler wires its ``_beat``): audits and
+        #: recovery retries can legitimately outlast one watchdog budget,
+        #: and the stall watchdog must read them as progress, not a wedge
+        self.heartbeat: Optional[Callable[[], None]] = None
+
+    # -- configuration (env, read per call so chaos cells flip them) ---
+
+    def active(self) -> bool:
+        """The whole layer on/off (NHD_GUARD=0 is the chaos negative
+        control: faults surface raw and corruption persists)."""
+        return os.environ.get("NHD_GUARD", "1") != "0"
+
+    def retries_per_rung(self) -> int:
+        return max(1, int(os.environ.get("NHD_GUARD_RETRIES", "2")))
+
+    def probe_rounds(self) -> int:
+        return max(1, int(os.environ.get("NHD_GUARD_PROBE_ROUNDS", "8")))
+
+    def audit_interval(self) -> int:
+        return int(os.environ.get("NHD_GUARD_AUDIT_INTERVAL", "64"))
+
+    def audit_rows(self) -> int:
+        return int(os.environ.get("NHD_GUARD_AUDIT_ROWS", "16"))
+
+    def shape_fault_limit(self) -> int:
+        return max(1, int(os.environ.get("NHD_GUARD_SHAPE_FAULTS", "3")))
+
+    def reset(self) -> None:
+        """Back to full fidelity and a clean ledger (test/chaos-cell
+        isolation; counters live in API_COUNTERS and reset there)."""
+        with self._lock:
+            self._floor = RUNG_MESH
+            self._clean_rounds = 0
+            self._batches = 0
+            self._last_audit = 0
+            self._audits = 0
+            self._suspicion = False
+            self._shape_faults.clear()
+            self._quarantined.clear()
+        _counters().set("guard_rung", RUNG_MESH)
+        _counters().set("guard_quarantined_shapes", 0)
+
+    # -- posture -------------------------------------------------------
+
+    @property
+    def floor(self) -> int:
+        return self._floor
+
+    def allow_mesh(self) -> bool:
+        return self._floor <= RUNG_MESH
+
+    def allow_device(self) -> bool:
+        return self._floor < RUNG_HOST
+
+    def _beat(self) -> None:
+        hb = self.heartbeat
+        if hb is None:
+            return
+        try:
+            hb()
+        except Exception:  # nhdlint: ignore[NHD302]
+            pass  # a broken liveness hook must never break recovery
+
+    # -- detect / degrade ----------------------------------------------
+
+    def on_fault(
+        self, exc: BaseException, *, rung: int, attempt: int,
+        shape_key: str = "",
+    ) -> str:
+        """Classify one device-plane fault and decide the caller's next
+        move: ``"retry"`` (re-dispatch the round — possibly at a lower
+        rung; the caller rebuilds its device state from host truth
+        first) or ``"raise"`` (terminal, or the ladder is exhausted).
+
+        ``rung``: the rung the failed attempt ran at. ``attempt``:
+        1-based fault count for THIS round, tracked by the caller —
+        every ``retries_per_rung()`` faults drop one rung, and a fault
+        past the whole ladder's budget propagates."""
+        self._beat()
+        c = _counters()
+        transient = classify_device_fault(exc)
+        c.inc("guard_faults_total")
+        if isinstance(exc, DeviceCorruptionError):
+            c.inc("guard_corruptions_total")
+        if not transient:
+            c.inc("guard_giveups_total")
+            self.logger.error(
+                f"solver guard: terminal device-plane fault at rung "
+                f"{RUNG_NAMES[rung]} (surfacing): {exc!r}"
+            )
+            return "raise"
+        if shape_key:
+            self._note_shape_fault(shape_key)
+        with self._lock:
+            self._suspicion = True
+            self._clean_rounds = 0
+        per = self.retries_per_rung()
+        if attempt > per * (RUNG_HOST + 1):
+            # absolute backstop: whatever the rung accounting saw, a
+            # round never retries past the whole ladder's budget
+            c.inc("guard_giveups_total")
+            return "raise"
+        if attempt % per == 0:
+            # this rung's budget is spent: degrade (or give up past host)
+            if rung >= RUNG_HOST:
+                c.inc("guard_giveups_total")
+                self.logger.error(
+                    "solver guard: host rung exhausted its retry budget; "
+                    f"surfacing: {exc!r}"
+                )
+                return "raise"
+            self._degrade(rung + 1, exc)
+        c.inc("guard_retries_total")
+        self.logger.warning(
+            f"solver guard: transient device-plane fault (attempt "
+            f"{attempt} at rung {RUNG_NAMES[rung]}); re-dispatching the "
+            f"round from host truth: {exc!r}"
+        )
+        return "retry"
+
+    def _degrade(self, floor: int, exc: BaseException) -> None:
+        with self._lock:
+            if floor <= self._floor:
+                return
+            old, self._floor = self._floor, min(floor, RUNG_HOST)
+            self._clean_rounds = 0
+        c = _counters()
+        c.inc("guard_degradations_total")
+        c.set("guard_rung", self._floor)
+        self.logger.error(
+            f"solver guard: degrading {RUNG_NAMES[old]} -> "
+            f"{RUNG_NAMES[self._floor]} (bounded retries exhausted): "
+            f"{exc!r}"
+        )
+
+    # -- repair / re-promotion -----------------------------------------
+
+    def condemn_device(self, exc: BaseException) -> None:
+        """Force the floor straight to the host rung: the device plane
+        is unreachable (even REBUILDING resident state faults — e.g. a
+        dead tunnel fails the device_put itself), so walking the ladder
+        one rung at a time would just re-fault at every device rung.
+        Clean probe rounds at the host rung re-promote as usual once
+        the substrate returns."""
+        with self._lock:
+            self._suspicion = True
+            self._clean_rounds = 0
+        _counters().inc("guard_faults_total")
+        self._degrade(RUNG_HOST, exc)
+
+    def note_repair(self) -> None:
+        """A resident state was rebuilt from host truth (the repair
+        chokepoint fired)."""
+        _counters().inc("guard_repairs_total")
+
+    def note_round_clean(self) -> None:
+        """One solver round completed without a device-plane fault.
+        After ``probe_rounds()`` consecutive clean rounds at a degraded
+        floor, re-promote ONE rung (gradual: a flappy device earns its
+        way back one probe window at a time)."""
+        if self._floor == RUNG_MESH:
+            return
+        with self._lock:
+            if self._floor == RUNG_MESH:
+                return
+            self._clean_rounds += 1
+            if self._clean_rounds < self.probe_rounds():
+                return
+            self._clean_rounds = 0
+            self._floor -= 1
+            floor = self._floor
+        c = _counters()
+        c.inc("guard_promotions_total")
+        c.set("guard_rung", floor)
+        self.logger.warning(
+            f"solver guard: re-promoting to rung {RUNG_NAMES[floor]} "
+            f"after {self.probe_rounds()} clean probe rounds"
+        )
+
+    # -- the audit schedule --------------------------------------------
+
+    def audit_due(self) -> bool:
+        """Called once per batch: True when this batch should open with
+        a resident-state audit — on the periodic cadence
+        (NHD_GUARD_AUDIT_INTERVAL batches) or on suspicion (any fault
+        since the last audit)."""
+        if not self.active():
+            return False
+        with self._lock:
+            self._batches += 1
+            due = self._suspicion
+            interval = self.audit_interval()
+            if interval > 0 and self._batches - self._last_audit >= interval:
+                due = True
+            if due:
+                self._last_audit = self._batches
+                self._suspicion = False
+            return due
+
+    def run_audit(self, dev) -> List[str]:
+        """One budgeted audit pass over *dev*: NHD_GUARD_AUDIT_ROWS
+        rows (0 = every row), sampled as a rotating window so bounded
+        budgets still reach every row eventually — deterministically (no
+        RNG), so a chaos seed replays bit-exactly. Returns the defects;
+        the caller repairs (rebuild_resident) when any are found."""
+        self._beat()
+        budget = self.audit_rows()
+        n = min(dev.N, dev.cluster.n_nodes)
+        if n <= 0:
+            return []
+        with self._lock:
+            start = (self._audits * max(budget, 1)) % n
+            self._audits += 1
+        if budget <= 0 or budget >= n:
+            rows: Iterable[int] = range(n)
+            sampled = n
+        else:
+            rows = [(start + i) % n for i in range(budget)]
+            sampled = budget
+        errs = audit_device_rows(dev, rows)
+        c = _counters()
+        c.inc("guard_audits_total")
+        c.inc("guard_audit_rows_total", sampled)
+        if errs:
+            c.inc("guard_corruptions_total")
+        self._beat()
+        return errs
+
+    # -- the rank-tensor screen ----------------------------------------
+
+    def screen_rank(self, arr: np.ndarray, n_padded: int) -> Optional[str]:
+        """Value-domain screen of one pulled [9, T, R] rank tensor
+        before any winner is materialized — the integer analog of a
+        NaN/inf screen (the packed tensor is int32 by contract; a float
+        dtype is itself a defect and gets the literal screen). Cheap:
+        O(T*R) host compares on an array the round pulled anyway.
+        Returns the defect string, or None when clean."""
+        if arr.ndim != 3 or arr.shape[0] != 9:
+            return f"rank tensor shape {arr.shape} != (9, T, R)"
+        if np.issubdtype(arr.dtype, np.floating):
+            if not np.isfinite(arr).all():
+                return "non-finite values in rank tensor"
+            return f"rank tensor dtype {arr.dtype} (int32 contract)"
+        val, idx = arr[0], arr[1]
+        if (val < 0).any():
+            return "negative ranking values (sel encoding is >= 0)"
+        if ((idx < 0) | (idx >= n_padded)).any():
+            return f"ranked node index outside [0, {n_padded})"
+        return None
+
+    # -- shape-key quarantine ------------------------------------------
+
+    def shape_quarantined(self, key_str: str) -> bool:
+        return key_str in self._quarantined
+
+    def _note_shape_fault(self, key_str: str) -> None:
+        with self._lock:
+            n = self._shape_faults.get(key_str, 0) + 1
+            self._shape_faults[key_str] = n
+            if n < self.shape_fault_limit() or key_str in self._quarantined:
+                return
+            self._quarantined.add(key_str)
+            count = len(self._quarantined)
+        _counters().set("guard_quarantined_shapes", count)
+        self.logger.error(
+            f"solver guard: quarantining shape {key_str} after {n} "
+            "faults — its AOT artifact is retired and dispatches "
+            "re-trace live (one poisoned bucket must not wedge the rest)"
+        )
+        self._forget_aot(key_str)
+
+    def _forget_aot(self, key_str: str) -> None:
+        """Retire the quarantined shape's AOT program + on-disk artifact
+        (a corrupt or miscompiled cached program may be the fault source;
+        the next dispatch — and the next restart — must re-trace)."""
+        try:
+            from nhd_tpu.solver import aot
+            from nhd_tpu.solver.kernel import parse_ranked_shape_key
+
+            parsed = parse_ranked_shape_key(key_str)
+            if parsed is not None:
+                aot.forget(aot.ShapeKey("ranked", *parsed))
+        except Exception as exc:
+            # quarantine bookkeeping must never turn into a second fault
+            self.logger.warning(
+                f"solver guard: could not retire AOT artifact for "
+                f"{key_str}: {exc}"
+            )
+
+
+#: process-wide guard (one device plane per process, one jit cache, one
+#: AOT program table — and one degradation floor over all of them)
+GUARD = SolverGuard()

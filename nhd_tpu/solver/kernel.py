@@ -458,6 +458,22 @@ def ranked_shape_key(G, U, K, R, Tp, Np, mesh: str = "") -> str:
     return key + (f"_M{mesh}" if mesh else "")
 
 
+def parse_ranked_shape_key(key: str):
+    """(G, U, K, R, Tp, Np, mesh_desc) back out of a ranked_shape_key
+    string, or None when it doesn't parse — the guard's quarantine
+    bookkeeping (solver/guard.py) maps a faulting shape key back to its
+    AOT ShapeKey to retire the cached artifact."""
+    import re
+
+    m = re.fullmatch(
+        r"G(\d+)_U(\d+)_K(\d+)_R(\d+)_T(\d+)_N(\d+)(?:_M(.+))?", key
+    )
+    if m is None:
+        return None
+    dims = tuple(int(x) for x in m.groups()[:6])
+    return dims + (m.group(7) or "",)
+
+
 def dispatch_ranked(G, U, K, R, Tp, Np, args, mesh=None) -> jax.Array:
     """Resolve + invoke the fused solve+rank program for one padded
     shape: the AOT prewarm cache first (zero-cold-start — the program
@@ -474,20 +490,26 @@ def dispatch_ranked(G, U, K, R, Tp, Np, args, mesh=None) -> jax.Array:
     # fresh trace+compile (or a prewarm load), the silent stall the
     # nhd_jit_* metrics make scrapeable
     desc = mesh_desc(mesh)
-    JIT_STATS.record_use(
-        "solve_ranked", ranked_shape_key(G, U, K, R, Tp, Np, desc)
-    )
-    from nhd_tpu.solver import aot
+    key_str = ranked_shape_key(G, U, K, R, Tp, Np, desc)
+    JIT_STATS.record_use("solve_ranked", key_str)
+    from nhd_tpu.solver import aot, guard
 
+    # chaos fault-injection seam (solver/guard.py): no-op in production
+    guard.maybe_inject("dispatch", key_str)
     key = aot.ShapeKey("ranked", G, U, K, R, Tp, Np, desc)
-    prog = aot.lookup(key)
-    if prog is not None:
-        return prog(*args)
+    quarantined = guard.GUARD.shape_quarantined(key_str)
+    if not quarantined:
+        prog = aot.lookup(key)
+        if prog is not None:
+            return prog(*args)
     fn = (
         get_ranked_solver_mesh(G, U, K, R, mesh) if mesh is not None
         else get_ranked_solver(G, U, K, R)
     )
-    aot.maybe_export(key, fn, args)
+    if not quarantined:
+        # a quarantined shape must not re-seed the cache it was just
+        # evicted from — its dispatches stay live-traced
+        aot.maybe_export(key, fn, args)
     return fn(*args)
 
 

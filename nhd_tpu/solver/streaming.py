@@ -114,6 +114,14 @@ class StreamingScheduler:
         # changed rows)). Membership or interner-budget changes drop the
         # whole state (counted as delta rebuilds). Single-caller
         # contract: note_nodes/schedule run on the scheduler thread.
+        # Solver-guard posture (solver/guard.py): each persistent tile
+        # context reposturues at its first offer of a call — a
+        # degradation condemns its resident plane down the mesh →
+        # single-device → host ladder, a re-promotion rebuilds it from
+        # host truth at the faster rung — via the same
+        # make_context/refresh_context chokepoints the solo path uses;
+        # a tile whose solve trips the guard terminally fails only its
+        # own call (the errored call never banks its state).
         self.persistent = persistent
         self._pstate: Optional[dict] = None
         self._pstale: set = set()

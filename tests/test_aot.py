@@ -270,3 +270,99 @@ def test_bench_diff_wall_gate_absolute_and_relative():
         artifact(0.045), artifact(5.0), threshold=0.10, floor=0.005,
     )
     assert any("wall regressed" in r for r in regressions)
+
+
+def test_prewarm_progress_called_per_artifact(aot_cache):
+    """The stall-watchdog grace hook (ISSUE 12 satellite): prewarm
+    invokes ``progress`` once per artifact processed — loaded AND
+    quarantined — so a long multi-artifact compile advances the loop
+    heartbeat artifact by artifact."""
+    _seed_cache(aot_cache)
+    # one stale artifact rides along: progress must tick for it too
+    stale = sorted(
+        f for f in os.listdir(aot_cache) if f.endswith(".json")
+    )[0]
+    meta = json.load(open(os.path.join(aot_cache, stale)))
+    meta["fingerprint"] = "0" * 16
+    path = os.path.join(aot_cache, "zz_stale.json")
+    with open(path, "w") as fh:
+        json.dump(meta, fh)
+    aot.reset()
+    aot.configure(directory=aot_cache, save=False)
+    beats = []
+    summary = aot.prewarm(progress=lambda: beats.append(1))
+    assert summary["loaded"] >= 1 and summary["quarantined"] >= 1
+    assert len(beats) == (
+        summary["loaded"] + summary["quarantined"] + summary["skipped"]
+    )
+
+
+def test_prewarm_progress_keeps_watchdog_quiet_on_slow_compiles():
+    """Regression (injected slow compile): with per-artifact heartbeats
+    a prewarm whose every compile eats most of the stall budget never
+    trips the watchdog; without them the same timeline fires it."""
+    from nhd_tpu.k8s.lease import StallWatchdog
+
+    for with_progress, expect_fired in ((True, False), (False, True)):
+        clock = {"t": 0.0}
+        stamp = {"t": 0.0}
+        fired = []
+        dog = StallWatchdog(
+            lambda: stamp["t"], stall_after=10.0,
+            exit_fn=lambda code: fired.append(code),
+            clock=lambda: clock["t"],
+        )
+        for _ in range(4):  # four artifacts, 8s of compile each
+            clock["t"] += 8.0
+            if with_progress:
+                stamp["t"] = clock["t"]  # aot.prewarm(progress=_beat)
+            dog.check()
+        assert bool(fired) == expect_fired, (with_progress, fired)
+
+
+def test_export_failure_counted_and_logged_once(aot_cache, monkeypatch):
+    """The background export worker's failures were invisible (ISSUE 12
+    satellite): a failing serialize now ticks
+    nhd_aot_export_failures_total per failure and logs once per run
+    with the shape key."""
+    import jax.export as jexport
+
+    from nhd_tpu.k8s.retry import API_COUNTERS
+
+    def _boom(*a, **k):
+        raise RuntimeError("injected serialize failure")
+
+    monkeypatch.setattr(jexport, "export", _boom)
+    base = API_COUNTERS.get("aot_export_failures_total")
+    key1 = aot.ShapeKey("ranked", 1, 2, 2, 8, 8, 16)
+    key2 = aot.ShapeKey("ranked", 2, 2, 2, 8, 8, 16)
+    fn = get_ranked_solver(1, 2, 2, 8)
+    args = [np.zeros(4, np.int32)]
+    aot.maybe_export(key1, fn, args)
+    aot.maybe_export(key2, fn, args)
+    aot.AOT.drain()
+    assert API_COUNTERS.get("aot_export_failures_total") == base + 2
+    # no artifact landed for either key
+    assert not [
+        f for f in os.listdir(aot_cache) if f.endswith(".stablehlo.bin")
+    ]
+
+
+def test_forget_retires_program_and_quarantines_artifact(aot_cache):
+    """aot.forget (the solver guard's poisoned-program hook): the
+    installed program is dropped and the on-disk pair moves to
+    quarantine/ — never deleted."""
+    _seed_cache(aot_cache)
+    aot.reset()
+    aot.configure(directory=aot_cache, save=False)
+    summary = aot.prewarm()
+    assert summary["loaded"] >= 1
+    name = summary["keys"][0]
+    key = next(k for k in aot.AOT._programs if k.name() == name)
+    aot.forget(key)
+    assert aot.lookup(key) is None
+    qdir = os.path.join(aot_cache, "quarantine")
+    assert os.path.exists(os.path.join(qdir, f"{name}.stablehlo.bin"))
+    assert not os.path.exists(
+        os.path.join(aot_cache, f"{name}.stablehlo.bin")
+    )

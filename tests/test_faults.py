@@ -278,3 +278,65 @@ def test_unisolated_controller_raises():
     backend.create_pod("p1", cfg_text=make_triad_config())
     with pytest.raises(TypeError):
         ctrl.run_once(now=0.0)
+
+
+# ---------------------------------------------------------------------------
+# solver data-plane injector (sim/faults.py DeviceFaultInjector, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def test_device_injector_sites_and_step_budget():
+    """Exceptions route by site under the per-step budget; slow
+    dispatches sleep without consuming it."""
+    import random
+
+    from nhd_tpu.sim.faults import DeviceFaultInjector
+    from nhd_tpu.solver.guard import InjectedDeviceFault
+
+    sleeps = []
+    inj = DeviceFaultInjector(
+        FaultProfile(
+            name="d", device_dispatch_error=1.0, device_upload_error=1.0,
+            device_slow_dispatch=1.0, slow_seconds=0.01,
+            device_faults_per_step=2,
+        ),
+        random.Random(0), sleep=sleeps.append,
+    )
+    with pytest.raises(InjectedDeviceFault, match="dispatch"):
+        inj("dispatch", "G1")
+    with pytest.raises(InjectedDeviceFault, match="upload"):
+        inj("upload", "scatter")
+    # budget spent: further calls are quiet (the guard's bounded
+    # retries then provably absorb the step)
+    inj("dispatch", "G1")
+    inj("megaround", "B1")
+    assert inj.stats["dispatch_errors"] == 1
+    assert inj.stats["upload_errors"] == 1
+    # slow dispatches fired on every call, budget-independent
+    assert len(sleeps) == 4 and all(s == 0.01 for s in sleeps)
+    inj.begin_step()
+    with pytest.raises(InjectedDeviceFault):
+        inj("megaround", "B1")
+    # unknown sites and disabled injectors never raise
+    inj.begin_step()
+    inj("unknown-site", "x")
+    inj.enabled = False
+    inj("dispatch", "G1")
+    assert inj.stats["dispatch_errors"] == 1
+
+
+def test_device_profile_classification_and_registry():
+    """The device-faults preset storms ONLY the data plane (API-fault
+    fields zero — bind parity with a fault-free run depends on it) and
+    its injected exception classifies transient."""
+    from nhd_tpu.sim.faults import PROFILES
+    from nhd_tpu.solver.guard import (
+        InjectedDeviceFault, classify_device_fault,
+    )
+
+    p = PROFILES["device-faults"]
+    assert p.has_device_faults()
+    assert p.drop_watch_event == p.poison_watch_event == 0.0
+    assert p.transient_bind == p.transient_annotate == 0.0
+    assert not FaultProfile(name="api", transient_bind=0.5).has_device_faults()
+    assert classify_device_fault(InjectedDeviceFault("x"))

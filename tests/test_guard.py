@@ -1,0 +1,589 @@
+"""Solver data-plane fault tolerance (solver/guard.py, ISSUE 12).
+
+The detect → degrade → repair ladder under injected faults: transient
+XLA-style dispatch errors are absorbed by bounded round re-dispatches
+(binds bit-identical to a fault-free run), the rung ladder walks
+mesh → single-device → host and re-promotes after clean probe rounds,
+the resident-state audit finds and repairs bit-flipped device rows from
+host truth, a repeatedly-faulting shape key is quarantined
+(AOT-artifact retirement included), and — the negative control — with
+the guard DISABLED the same corruption demonstrably persists. The fast
+device-faults chaos cell pins the `make device-chaos` acceptance
+invariants in tier-1.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from nhd_tpu.k8s.retry import API_COUNTERS
+from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+from nhd_tpu.solver import guard
+from nhd_tpu.solver.batch import BatchItem, BatchScheduler
+from nhd_tpu.solver.encode import ClusterDelta
+from nhd_tpu.solver.guard import (
+    GUARD,
+    RUNG_HOST,
+    RUNG_MESH,
+    RUNG_SINGLE,
+    DeviceCorruptionError,
+    InjectedDeviceFault,
+    classify_device_fault,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard(monkeypatch):
+    """Every test starts at full fidelity with no injector installed
+    and the resident-state path forced on (the CPU backend leaves it
+    off by default)."""
+    monkeypatch.setenv("NHD_TPU_DEVICE_STATE", "1")
+    GUARD.reset()
+    guard.set_fault_injector(None)
+    yield
+    guard.set_fault_injector(None)
+    GUARD.reset()
+
+
+def _items(n=8, seed_groups=("default",)):
+    return [
+        BatchItem(("ns", f"p{i}"), r)
+        for i, r in enumerate(workload_mix(n, list(seed_groups)))
+    ]
+
+
+def _sched(**kw):
+    kw.setdefault("respect_busy", False)
+    kw.setdefault("register_pods", False)
+    kw.setdefault("device_state", True)
+    return BatchScheduler(**kw)
+
+
+def _placements(results):
+    return [r.node for r in results]
+
+
+class _NShotInjector:
+    """Raise at the first *n* calls matching *site*, then go quiet."""
+
+    def __init__(self, n, site="dispatch"):
+        self.left = n
+        self.site = site
+        self.calls = 0
+
+    def __call__(self, site, detail=""):
+        self.calls += 1
+        if site == self.site and self.left > 0:
+            self.left -= 1
+            raise InjectedDeviceFault(f"injected at {site} ({detail})")
+
+
+# ---------------------------------------------------------------------------
+# detect: classification + screens
+# ---------------------------------------------------------------------------
+
+
+def test_classification_mirrors_retry_semantics():
+    # substrate health → transient (the 5xx analog)
+    assert classify_device_fault(InjectedDeviceFault("x"))
+    assert classify_device_fault(DeviceCorruptionError("x"))
+    assert classify_device_fault(OSError("tunnel reset"))
+    assert classify_device_fault(MemoryError())
+    # facts about the program/call → terminal (the 4xx analog)
+    assert not classify_device_fault(ValueError("bad arg"))
+    assert not classify_device_fault(TypeError("bad call"))
+    assert not classify_device_fault(KeyError("k"))
+    # XLA runtime errors: transient unless they carry a terminal marker
+    try:
+        from jax._src.lib import xla_client
+
+        assert classify_device_fault(
+            xla_client.XlaRuntimeError("RESOURCE_EXHAUSTED: oom")
+        )
+        assert not classify_device_fault(
+            xla_client.XlaRuntimeError("INVALID_ARGUMENT: shape")
+        )
+    except ImportError:
+        pass  # classification degrades to the stdlib set there
+
+
+def test_screen_rank_value_domain():
+    ok = np.zeros((9, 2, 4), np.int32)
+    assert GUARD.screen_rank(ok, 8) is None
+    bad_val = ok.copy()
+    bad_val[0, 0, 0] = -3
+    assert "negative" in GUARD.screen_rank(bad_val, 8)
+    bad_idx = ok.copy()
+    bad_idx[1, 1, 1] = 8  # == n_padded: out of the padded axis
+    assert "outside" in GUARD.screen_rank(bad_idx, 8)
+    assert "shape" in GUARD.screen_rank(np.zeros((3, 2), np.int32), 8)
+    nan = np.zeros((9, 2, 4), np.float32)
+    nan[2, 0, 0] = np.nan
+    assert "finite" in GUARD.screen_rank(nan, 8)
+
+
+# ---------------------------------------------------------------------------
+# degrade + repair: the ladder
+# ---------------------------------------------------------------------------
+
+
+def test_transient_dispatch_fault_retries_with_identical_binds():
+    """A one-shot injected dispatch fault costs one re-dispatch, not a
+    bind: placements are bit-identical to the fault-free run and the
+    floor never moves (retry budget not exhausted)."""
+    items = _items(9)
+    clean, _ = _sched().schedule(cap_cluster(6, ["default"]), items)
+
+    GUARD.reset()
+    inj = _NShotInjector(1)
+    guard.set_fault_injector(inj)
+    base = API_COUNTERS.snapshot()
+    faulted, _ = _sched().schedule(cap_cluster(6, ["default"]), items)
+    now = API_COUNTERS.snapshot()
+    assert inj.left == 0  # the fault actually fired
+    assert _placements(faulted) == _placements(clean)
+    assert now["guard_faults_total"] - base["guard_faults_total"] == 1
+    assert now["guard_retries_total"] - base["guard_retries_total"] == 1
+    assert now["guard_repairs_total"] - base["guard_repairs_total"] >= 1
+    assert GUARD.floor == RUNG_MESH
+
+
+def test_ladder_degrades_to_host_and_repromotes(monkeypatch):
+    """NHD_GUARD_RETRIES=1: one fault exhausts the single-device rung's
+    budget → floor drops to host, the round completes there, and clean
+    probe rounds walk the floor back to full fidelity (one rung per
+    probe window)."""
+    monkeypatch.setenv("NHD_GUARD_RETRIES", "1")
+    monkeypatch.setenv("NHD_GUARD_PROBE_ROUNDS", "2")
+    items = _items(9)
+    # mesh=None: start the ladder at the single-device rung (conftest's
+    # 8 virtual devices would otherwise auto-resolve a mesh)
+    clean, _ = _sched(mesh=None).schedule(
+        cap_cluster(6, ["default"]), items
+    )
+
+    GUARD.reset()
+    guard.set_fault_injector(_NShotInjector(1))
+    base = API_COUNTERS.snapshot()
+    faulted, _ = _sched(mesh=None).schedule(
+        cap_cluster(6, ["default"]), items
+    )
+    now = API_COUNTERS.snapshot()
+    assert _placements(faulted) == _placements(clean)
+    assert GUARD.floor == RUNG_HOST
+    assert (
+        now["guard_degradations_total"] - base["guard_degradations_total"]
+        == 1
+    )
+    assert API_COUNTERS.get("guard_rung") == RUNG_HOST
+
+    # clean batches at the degraded floor: the host rung still binds,
+    # and every clean round counts toward re-promotion
+    guard.set_fault_injector(None)
+    promoted = []
+    for _ in range(8):
+        nodes = cap_cluster(6, ["default"])
+        res, _ = _sched().schedule(nodes, _items(6))
+        promoted.append(GUARD.floor)
+        if GUARD.floor == RUNG_MESH:
+            break
+    assert GUARD.floor == RUNG_MESH, promoted
+    assert API_COUNTERS.get("guard_promotions_total") >= 2
+    assert API_COUNTERS.get("guard_rung") == RUNG_MESH
+
+
+def test_terminal_fault_surfaces_unchanged():
+    """A terminal fault (program fact) must propagate — the guard never
+    retries what repetition cannot fix."""
+
+    def _terminal(site, detail=""):
+        if site == "dispatch":
+            raise ValueError("deterministic program bug")
+
+    guard.set_fault_injector(_terminal)
+    base = API_COUNTERS.get("guard_giveups_total")
+    with pytest.raises(ValueError):
+        _sched().schedule(cap_cluster(4, ["default"]), _items(4))
+    assert API_COUNTERS.get("guard_giveups_total") == base + 1
+
+
+def test_ladder_exhaustion_raises(monkeypatch):
+    """A fault storm that outlives every rung's budget surfaces the
+    last exception instead of retrying forever."""
+    monkeypatch.setenv("NHD_GUARD_RETRIES", "1")
+    guard.set_fault_injector(_NShotInjector(50))
+    base = API_COUNTERS.get("guard_giveups_total")
+    with pytest.raises(InjectedDeviceFault):
+        _sched().schedule(cap_cluster(4, ["default"]), _items(4))
+    assert API_COUNTERS.get("guard_giveups_total") == base + 1
+    assert GUARD.floor == RUNG_HOST
+
+
+def test_mesh_rung_degrades_to_single_device(monkeypatch):
+    """The top of the ladder: a faulting mesh megaround condemns the
+    mesh and the round re-dispatches on ONE device, bit-identically."""
+    from tests.test_spmd import _mesh, _require_mesh
+
+    _require_mesh()
+    monkeypatch.setenv("NHD_GUARD_RETRIES", "1")
+    items = _items(9)
+    clean, _ = _sched(mesh=_mesh()).schedule(
+        cap_cluster(8, ["default"]), items
+    )
+
+    GUARD.reset()
+    guard.set_fault_injector(_NShotInjector(1))
+    faulted, _ = _sched(mesh=_mesh()).schedule(
+        cap_cluster(8, ["default"]), items
+    )
+    assert _placements(faulted) == _placements(clean)
+    assert GUARD.floor == RUNG_SINGLE
+    assert not GUARD.allow_mesh() and GUARD.allow_device()
+
+    # a persistent context built now comes up at the degraded rung
+    nodes = cap_cluster(8, ["default"])
+    ctx = _sched(mesh=_mesh()).make_context(nodes, now=0.0)
+    assert ctx.dev is not None and ctx.dev.mesh is None
+
+
+# ---------------------------------------------------------------------------
+# the resident-state audit + negative control
+# ---------------------------------------------------------------------------
+
+
+def _delta_ctx(n_nodes=6):
+    nodes = cap_cluster(n_nodes, ["default"])
+    sched = _sched()
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    ctx = sched.make_context(nodes, now=0.0, delta=delta)
+    assert ctx.dev is not None
+    return nodes, sched, delta, ctx
+
+
+def _flip_row(dev, name="smt", row=1):
+    cur = np.asarray(dev._dev[name][row])
+    bad = ~cur if cur.dtype == np.bool_ else cur + np.ones_like(cur)
+    dev._dev[name] = dev._dev[name].at[row].set(bad)
+
+
+def test_audit_detects_and_repairs_bit_flip(monkeypatch):
+    """A corrupted resident row is found by the batch-start audit and
+    repaired from host truth BEFORE any solve reads it — binds stay
+    bit-identical to a clean run."""
+    monkeypatch.setenv("NHD_GUARD_AUDIT_INTERVAL", "1")
+    monkeypatch.setenv("NHD_GUARD_AUDIT_ROWS", "0")
+    items = _items(6)
+    n0, s0, d0, c0 = _delta_ctx()
+    clean, _ = s0.schedule(c0.nodes, items, context=c0)
+
+    GUARD.reset()
+    nodes, sched, delta, ctx = _delta_ctx()
+    _flip_row(ctx.dev, "smt", 1)   # a static array no claim touches
+    _flip_row(ctx.dev, "cpu_free", 3)
+    assert guard.audit_device_rows(ctx.dev, range(ctx.dev.N)) != []
+    base = API_COUNTERS.snapshot()
+    faulted, stats = sched.schedule(ctx.nodes, items, context=ctx)
+    now = API_COUNTERS.snapshot()
+    assert _placements(faulted) == _placements(clean)
+    assert now["guard_audits_total"] > base["guard_audits_total"]
+    assert (
+        now["guard_corruptions_total"] > base["guard_corruptions_total"]
+    )
+    assert now["guard_repairs_total"] > base["guard_repairs_total"]
+    assert guard.audit_device_rows(ctx.dev, range(ctx.dev.N)) == []
+    assert "guard_audit" in stats.phases
+
+
+def test_negative_control_guard_disabled_corruption_persists(monkeypatch):
+    """NHD_GUARD=0 (the chaos negative control): the same corruption is
+    NOT audited or repaired — it persists across a whole batch, and the
+    parity tripwire (audit_device_rows) demonstrably fires."""
+    monkeypatch.setenv("NHD_GUARD", "0")
+    monkeypatch.setenv("NHD_GUARD_AUDIT_INTERVAL", "1")
+    monkeypatch.setenv("NHD_GUARD_AUDIT_ROWS", "0")
+    nodes, sched, delta, ctx = _delta_ctx()
+    _flip_row(ctx.dev, "smt", 1)
+    base = API_COUNTERS.snapshot()
+    sched.schedule(ctx.nodes, _items(6), context=ctx)
+    now = API_COUNTERS.snapshot()
+    assert now["guard_audits_total"] == base["guard_audits_total"]
+    errs = guard.audit_device_rows(ctx.dev, range(ctx.dev.N))
+    assert errs and "smt" in errs[0]
+
+
+def test_audit_budget_rotates_to_full_coverage(monkeypatch):
+    """A bounded audit budget still reaches every row over successive
+    audits (rotating window, no RNG)."""
+    monkeypatch.setenv("NHD_GUARD_AUDIT_ROWS", "2")
+    nodes, sched, delta, ctx = _delta_ctx(6)
+    _flip_row(ctx.dev, "hp_free", 5)  # the last row
+    found = 0
+    for _ in range(4):  # ceil(6/2) windows cover every row
+        if GUARD.run_audit(ctx.dev):
+            found += 1
+            ctx.dev.rebuild_resident()
+    assert found == 1
+    assert guard.audit_device_rows(ctx.dev, range(ctx.dev.N)) == []
+
+
+# ---------------------------------------------------------------------------
+# shape quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_poisoned_aot_program_quarantined_end_to_end(
+    tmp_path, monkeypatch
+):
+    """A prewarmed program that faults on every call is quarantined
+    after NHD_GUARD_SHAPE_FAULTS faults: its artifact moves to
+    quarantine/, dispatches re-trace live, and the batch still binds."""
+    from nhd_tpu.solver import aot
+    from nhd_tpu.solver.kernel import ranked_shape_key
+
+    monkeypatch.setenv("NHD_GUARD_SHAPE_FAULTS", "2")
+    monkeypatch.setenv("NHD_GUARD_RETRIES", "2")
+    items = _items(6)
+    clean, _ = _sched().schedule(cap_cluster(6, ["default"]), items)
+
+    # seed the disk cache with REAL artifacts for these shapes
+    aot.reset()
+    aot.configure(directory=str(tmp_path), save=True)
+    try:
+        GUARD.reset()
+        _sched().schedule(cap_cluster(6, ["default"]), items)
+        aot.AOT.drain()
+        aot.reset()
+        aot.configure(directory=str(tmp_path), save=False)
+        summary = aot.prewarm()
+        assert summary["loaded"] >= 1
+
+        # poison ONE installed program: it raises like a miscompiled
+        # kernel would
+        key = sorted(aot.AOT._programs, key=lambda k: k.name())[0]
+        key_str = ranked_shape_key(
+            key.G, key.U, key.K, key.R, key.Tp, key.Np, key.mesh
+        )
+
+        def _poisoned(*a, **k):
+            raise InjectedDeviceFault(f"poisoned program {key.name()}")
+
+        aot.AOT._programs[key] = _poisoned
+        GUARD.reset()
+        faulted, _ = _sched().schedule(cap_cluster(6, ["default"]), items)
+        assert _placements(faulted) == _placements(clean)
+        assert GUARD.shape_quarantined(key_str)
+        assert API_COUNTERS.get("guard_quarantined_shapes") == 1
+        assert aot.lookup(key) is None
+        qdir = os.path.join(str(tmp_path), "quarantine")
+        assert os.path.exists(
+            os.path.join(qdir, f"{key.name()}.stablehlo.bin")
+        )
+        # later batches dispatch the shape live, no further faults
+        again, _ = _sched().schedule(cap_cluster(6, ["default"]), items)
+        assert _placements(again) == _placements(clean)
+    finally:
+        aot.reset()
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_guard_counters_on_metrics_and_fleet_payload():
+    from nhd_tpu.obs.fleet import build_fleet_payload
+    from nhd_tpu.rpc.metrics import render_metrics
+
+    out = render_metrics([], 0)
+    for name in (
+        "nhd_guard_rung", "nhd_guard_faults_total",
+        "nhd_guard_audits_total", "nhd_guard_repairs_total",
+        "nhd_guard_quarantined_shapes", "nhd_aot_export_failures_total",
+    ):
+        assert name in out
+    payload = build_fleet_payload(
+        [], counters={"guard_rung": 1, "guard_faults_total": 3,
+                      "guard_repairs_total": 2},
+    )
+    g = payload["device_state"]["guard"]
+    assert g["rung"] == 1
+    assert g["faults_total"] == 3
+    assert g["repairs_total"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the device-faults chaos cell (the `make device-chaos` acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+def _device_chaos_env(monkeypatch):
+    monkeypatch.setenv("NHD_TPU_DEVICE_STATE", "1")
+    monkeypatch.setenv("NHD_GUARD_AUDIT_INTERVAL", "1")
+    monkeypatch.setenv("NHD_GUARD_AUDIT_ROWS", "0")
+
+
+def test_device_chaos_binds_bit_identical_to_fault_free(monkeypatch):
+    """Injected mid-round dispatch failures AND bit-flipped resident
+    rows both end in a bound set bit-identical to a fault-free run of
+    the same seed — zero process restarts, every corruption repaired
+    in-process (end-state audit bit-exact), zero guard giveups."""
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import PROFILES
+
+    _device_chaos_env(monkeypatch)
+    total_faults = 0
+    for seed in (0, 1):
+        GUARD.reset()
+        control = ChaosSim(seed=seed, api_faults=None)
+        control.run(steps=25)
+        control.quiesce()
+
+        GUARD.reset()
+        base_giveups = API_COUNTERS.get("guard_giveups_total")
+        sim = ChaosSim(seed=seed, api_faults=PROFILES["device-faults"])
+        sim.run(steps=25)
+        sim.quiesce()
+        assert sim.stats.violations == []
+        assert sim.stuck_pods() == []
+        assert sim.bound_set() == control.bound_set(), seed
+        assert sim.device_audit_errors() == []
+        assert API_COUNTERS.get("guard_giveups_total") == base_giveups
+        faults = sim.fault_totals()
+        total_faults += (
+            faults["device_dispatch_errors"]
+            + faults["device_upload_errors"] + faults["device_bit_flips"]
+        )
+    assert total_faults > 0  # the storm was real, not vacuous
+
+
+def test_device_chaos_negative_control_violates_parity(monkeypatch):
+    """The corruption storm with the guard DISABLED: bit-flipped
+    resident rows reach the end state — the device audit reports
+    divergent rows (or the bound set itself diverges), proving the
+    guard was the repairing agent in the positive cell. Flips-only
+    profile: an unabsorbed dispatch exception would crash the sim's
+    drive loop itself, which is the OTHER thing the guard prevents."""
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import FaultProfile
+
+    _device_chaos_env(monkeypatch)
+    monkeypatch.setenv("NHD_GUARD", "0")
+    flips = FaultProfile(name="flips-only", device_bit_flip=0.5)
+    GUARD.reset()
+    control = ChaosSim(seed=0, api_faults=None)
+    control.run(steps=25)
+    control.quiesce()
+
+    GUARD.reset()
+    base_repairs = API_COUNTERS.get("guard_repairs_total")
+    sim = ChaosSim(seed=0, api_faults=flips)
+    audit_fired = 0
+    for _ in range(25):
+        flips_before = sim.stats.bit_flips
+        sim.step()
+        if sim.stats.bit_flips > flips_before and (
+            sim.device_audit_errors()
+        ):
+            # the corruption SURVIVED the whole step's control-plane
+            # drive: nothing repaired it (with the guard on, the
+            # batch-start audit would have, before any solve)
+            audit_fired += 1
+    sim.quiesce()
+    assert sim.stats.bit_flips > 0
+    assert API_COUNTERS.get("guard_repairs_total") == base_repairs
+    assert audit_fired > 0, (
+        "guard-disabled corruption never survived a step — the "
+        "negative control is vacuous"
+    )
+
+
+def test_device_profile_refuses_vacuous_posture(monkeypatch):
+    """A device storm against no resident state would pass vacuously —
+    the sim fails loud instead."""
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import PROFILES
+
+    monkeypatch.delenv("NHD_TPU_DEVICE_STATE", raising=False)
+    with pytest.raises(ValueError, match="resident-state"):
+        ChaosSim(seed=0, api_faults=PROFILES["device-faults"])
+    with pytest.raises(ValueError, match="solo"):
+        ChaosSim(
+            seed=0, api_faults=PROFILES["device-faults"], ha=True
+        )
+
+
+# ---------------------------------------------------------------------------
+# chaos_storm per-cell timeout (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_storm_cell_timeout_reports_and_fails(tmp_path, monkeypatch):
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "chaos_storm_under_test", os.path.join(root, "tools", "chaos_storm.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def _hang(args, profile, seed):
+        time.sleep(5.0)
+        return {"ok": True}
+
+    monkeypatch.setattr(mod, "_run_cell", _hang)
+    out = tmp_path / "matrix.json"
+    rc = mod.main([
+        "--seeds", "1", "--steps", "1", "--profiles", "light",
+        "--cell-timeout", "0.3", "--json-out", str(out),
+    ])
+    assert rc == 1
+    summary = json.loads(out.read_text())
+    assert summary["cells_failed"] == 1
+    cell = summary["cells"][0]
+    assert cell["timeout"] is True
+    assert cell["profile"] == "light" and cell["seed"] == 0
+    assert "timed out" in cell["violations"][0]
+
+
+def test_hard_down_device_condemns_build_to_host_rung(monkeypatch):
+    """Review finding: on a fully dead device even REBUILDING resident
+    state faults (the device_put itself raises). The guard must condemn
+    the device plane straight to the host rung and keep binding — not
+    crash the batch from inside its own recovery path."""
+    from nhd_tpu.solver.device_state import DeviceClusterState
+
+    items = _items(6)
+    clean, _ = _sched(mesh=None).schedule(
+        cap_cluster(6, ["default"]), items
+    )
+
+    GUARD.reset()
+    orig = DeviceClusterState._put
+
+    def _dead(self, padded):
+        raise InjectedDeviceFault("device_put: tunnel down")
+
+    monkeypatch.setattr(DeviceClusterState, "_put", _dead)
+    base = API_COUNTERS.snapshot()
+    faulted, _ = _sched(mesh=None).schedule(
+        cap_cluster(6, ["default"]), items
+    )
+    now = API_COUNTERS.snapshot()
+    assert _placements(faulted) == _placements(clean)
+    assert GUARD.floor == RUNG_HOST
+    assert now["guard_degradations_total"] > base["guard_degradations_total"]
+
+    # the device heals: clean probe rounds re-promote as usual
+    monkeypatch.setattr(DeviceClusterState, "_put", orig)
+    monkeypatch.setenv("NHD_GUARD_PROBE_ROUNDS", "1")
+    for _ in range(6):
+        _sched(mesh=None).schedule(cap_cluster(6, ["default"]), _items(4))
+        if GUARD.floor == RUNG_MESH:
+            break
+    assert GUARD.floor == RUNG_MESH

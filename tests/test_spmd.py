@@ -318,3 +318,104 @@ def test_mesh_prewarm_skips_oversized_mesh_artifacts(tmp_path):
         assert (tmp_path / f"{base.name}.stablehlo.bin").exists()
     finally:
         aot.reset()
+
+
+def _mesh_delta_context(n_nodes: int, seed: int):
+    """A delta-built MESH ScheduleContext over a live node dict — the
+    structural-fallback tests' shared scaffold (ISSUE 12 satellite:
+    tombstone-readd and compaction were only exercised end-to-end on the
+    single-device path before)."""
+    from nhd_tpu.solver.batch import BatchScheduler
+
+    nodes = _cluster(n_nodes, seed)
+    sched = BatchScheduler(
+        respect_busy=False, register_pods=False,
+        device_state=True, mesh=_mesh(),
+    )
+    delta = ClusterDelta(nodes, now=0.0, respect_busy=False)
+    ctx = sched.make_context(nodes, now=0.0, delta=delta)
+    assert ctx.dev is not None and ctx.dev.mesh is not None
+    return nodes, sched, delta, ctx
+
+
+def _assert_mesh_ctx_rederived(ctx):
+    """After a structural fallback: parity holds, the resident arrays
+    equal the padded host mirror bit-for-bit, and a mesh solve matches
+    the host fused program on a from-scratch encode of the live dict."""
+    from nhd_tpu.solver.device_state import _ARG_ORDER, _pad_own
+
+    assert ctx.delta.parity_errors() == []
+    for name in _ARG_ORDER:
+        np.testing.assert_array_equal(
+            np.asarray(ctx.dev._dev[name]),
+            _pad_own(getattr(ctx.cluster, name), ctx.dev.Np),
+            err_msg=name,
+        )
+    live = {n: ctx.delta.nodes[n] for n in ctx.delta.nodes}
+    fresh = encode_cluster(live, now=0.0)
+    fresh.busy[:] = False
+    for G, pods in sorted(
+        encode_pods(_requests(4, 11), fresh.interner).items()
+    ):
+        got = np.asarray(
+            ctx.dev.solve_ranked(
+                # encode against the CONTEXT's interner so group-mask
+                # bit positions match the resident arrays
+                encode_pods(pods.requests, ctx.cluster.interner)[G], 8
+            )
+        )
+        want = np.asarray(solve_bucket_ranked(fresh, pods, 8))
+        R = min(got.shape[2], want.shape[2])
+        # tombstoned rows live only in the context's padded axis; the
+        # ranked node INDICES can differ between the two row spaces, so
+        # compare the selection values per type instead of raw indices
+        np.testing.assert_array_equal(
+            (got[0, :, :R] > 0).sum(axis=1),
+            (want[0, :, :R] > 0).sum(axis=1),
+        )
+
+
+def test_mesh_delta_tombstone_readd_rebuilds_and_stays_bit_exact():
+    """Removing a node then re-adding the SAME name while its tombstone
+    still occupies a mid-array slot forces the sanctioned
+    tombstone-readd rebuild — and with the MESH-resident path active the
+    rebuilt context must re-derive bit-exactly (sharded resident arrays
+    included)."""
+    _require_mesh()
+    from nhd_tpu.solver.encode import rebuild_reasons_snapshot
+
+    nodes, sched, delta, ctx = _mesh_delta_context(12, 11)
+    victim = list(nodes)[3]
+    node_obj = nodes.pop(victim)
+    delta.note(victim)
+    sched.refresh_context(ctx, now=0.0)  # tombstones in place
+    assert victim in delta._tombstones
+    assert ctx.dev is not None and ctx.dev.mesh is not None
+
+    r0 = rebuild_reasons_snapshot().get("tombstone-readd", 0)
+    node_obj.active = True
+    nodes[victim] = node_obj  # re-insert: live dict appends at the END
+    delta.note(victim)
+    sched.refresh_context(ctx, now=0.0)
+    assert rebuild_reasons_snapshot().get("tombstone-readd", 0) == r0 + 1
+    assert ctx.dev is not None and ctx.dev.mesh is not None
+    _assert_mesh_ctx_rederived(ctx)
+
+
+def test_mesh_delta_compaction_rebuilds_and_stays_bit_exact():
+    """Tombstoning past the occupancy threshold triggers the compaction
+    rebuild; the mesh-resident context re-derives wholesale (fresh
+    capacity bucket, fresh shard layout) and stays bit-exact."""
+    _require_mesh()
+    from nhd_tpu.solver.encode import rebuild_reasons_snapshot
+
+    nodes, sched, delta, ctx = _mesh_delta_context(16, 13)
+    r0 = rebuild_reasons_snapshot().get("compaction", 0)
+    for victim in list(nodes)[2:8]:  # > max(4, 16//8) tombstones
+        nodes.pop(victim)
+        delta.note(victim)
+    sched.refresh_context(ctx, now=0.0)
+    assert rebuild_reasons_snapshot().get("compaction", 0) == r0 + 1
+    assert delta._tombstones == set()
+    assert ctx.dev is not None and ctx.dev.mesh is not None
+    _assert_mesh_ctx_rederived(ctx)

@@ -51,6 +51,28 @@ def _run_cell(args, profile: str, seed: int) -> dict:
     from nhd_tpu.sim.faults import PROFILES
 
     faults = PROFILES[profile] if profile != "none" else None
+    device_cell = faults is not None and faults.has_device_faults()
+    control_bound = None
+    if args.bind_parity and faults is not None:
+        # fault-free control run FIRST (same seed, no profile): device
+        # faults ride their own rng streams and the device profiles
+        # carry zero API-fault probabilities, so the two runs' churn
+        # sequences are bit-identical and their end states comparable
+        from nhd_tpu.solver.guard import GUARD
+
+        GUARD.reset()
+        control = ChaosSim(
+            seed=seed, n_nodes=args.nodes, api_faults=None,
+            ha=args.ha, federation=args.federation,
+            n_replicas=args.replicas,
+        )
+        control.run(steps=args.steps)
+        control.quiesce()
+        control_bound = control.bound_set()
+    if device_cell:
+        from nhd_tpu.solver.guard import GUARD
+
+        GUARD.reset()
     sim = ChaosSim(
         seed=seed, n_nodes=args.nodes, api_faults=faults,
         ha=args.ha, federation=args.federation, n_replicas=args.replicas,
@@ -58,6 +80,19 @@ def _run_cell(args, profile: str, seed: int) -> dict:
     stats = sim.run(steps=args.steps)
     sim.quiesce()
     stuck = sim.stuck_pods()
+    if device_cell:
+        # the device-faults acceptance invariants: the resident state
+        # ends bit-exact with the host mirror (every corruption found
+        # and repaired in-process — zero restarts by construction, the
+        # sim never replaced the scheduler object for a device fault)
+        audit = sim.device_audit_errors()
+        for err in audit:
+            stats.violations.append(f"end-state device audit: {err}")
+    if control_bound is not None and sim.bound_set() != control_bound:
+        stats.violations.append(
+            "bind parity: faulted end state differs from the fault-free "
+            "run of the same seed"
+        )
     fleet_artifact = None
     if args.federation and args.fleet_out:
         # one schema-validated fleet artifact per federation cell: the
@@ -91,6 +126,13 @@ def _run_cell(args, profile: str, seed: int) -> dict:
         "lease_epoch": stats.lease_epoch,
         "max_leader_gap": stats.max_leader_gap,
     }
+    if args.bind_parity and control_bound is not None:
+        record["bind_parity"] = sim.bound_set() == control_bound
+    if device_cell:
+        from nhd_tpu.solver.guard import GUARD
+
+        record["guard_rung_end"] = GUARD.floor
+        record["bit_flips"] = stats.bit_flips
     if args.federation:
         record.update({
             "shards": args.federation,
@@ -109,7 +151,73 @@ def _run_cell(args, profile: str, seed: int) -> dict:
     return record
 
 
-def main() -> int:
+def _run_cell_timed(args, profile: str, seed: int) -> dict:
+    """_run_cell under a per-cell wall-clock budget: one hung cell (a
+    wedged solve, a deadlocked drive) must not eat the whole matrix.
+    The cell runs on a daemon thread; on timeout the record reports the
+    cell BY NAME as failed and the matrix moves on (the leaked thread
+    dies with the process — this is a tool, not a daemon)."""
+    import threading
+
+    if not args.cell_timeout or args.cell_timeout <= 0:
+        return _run_cell(args, profile, seed)
+    box: dict = {}
+
+    def _target() -> None:
+        try:
+            box["record"] = _run_cell(args, profile, seed)
+        except BaseException as exc:  # the matrix must see cell crashes
+            box["error"] = exc
+
+    t = threading.Thread(
+        target=_target, name=f"chaos-cell-{profile}-{seed}", daemon=True
+    )
+    t.start()
+    t.join(args.cell_timeout)
+    if t.is_alive():
+        # the leaked thread keeps mutating PROCESS-GLOBAL solver-guard
+        # state (floor, counters, the injector seam) while later cells
+        # run: quiet the injector best-effort and stamp every later
+        # cell `after_timeout` so its verdict is read as suspect — the
+        # timed-out cell already fails the whole matrix either way
+        try:
+            from nhd_tpu.solver import guard
+
+            guard.set_fault_injector(None)
+        except Exception as exc:  # best-effort hygiene on a failing run
+            print(f"  (could not quiet the fault injector: {exc})")
+        _TIMED_OUT.append(f"{profile}/seed{seed}")
+        return {
+            "profile": profile, "seed": seed, "nodes": args.nodes,
+            "steps": args.steps,
+            "mode": ("federation" if args.federation
+                     else "ha" if args.ha else "single"),
+            "ok": False, "timeout": True,
+            "violations": [
+                f"cell {profile}/seed{seed} timed out after "
+                f"{args.cell_timeout:.0f}s (still running; matrix "
+                "continued without it — later cells marked "
+                "after_timeout share its leaked thread's process)"
+            ],
+            "stuck_pods": [], "faults_injected": {},
+            "lease_epoch": 0, "max_leader_gap": 0,
+        }
+    err = box.get("error")
+    if err is not None:
+        raise err
+    record = box["record"]
+    if _TIMED_OUT:
+        record["after_timeout"] = list(_TIMED_OUT)
+    return record
+
+
+#: cells that timed out so far this run (their daemon threads may still
+#: be mutating process-global state under later cells)
+_TIMED_OUT: list = []
+
+
+def main(argv=None) -> int:
+    del _TIMED_OUT[:]  # fresh run (main is re-entrant under tests)
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seeds", type=int, default=6,
                     help="seeds per profile (default 6)")
@@ -146,7 +254,36 @@ def main() -> int:
                          "(obs/fleet.py; spillover-hop + SLO burn "
                          "summaries; make fed-chaos uses artifacts/fleet)")
     ap.add_argument("--start-seed", type=int, default=0)
-    args = ap.parse_args()
+    ap.add_argument("--cell-timeout", type=float, default=600.0,
+                    metavar="SEC",
+                    help="wall-clock budget per (profile, seed) cell: a "
+                         "cell still running past this is reported by "
+                         "name as failed (timeout: true in --json-out) "
+                         "and the matrix continues — one hung cell "
+                         "can't eat the whole run (default 600; 0 "
+                         "disables)")
+    ap.add_argument("--device-plane", action="store_true",
+                    help="solver data-plane posture for device-fault "
+                         "profiles: forces the resident-state path on "
+                         "the CPU backend (NHD_TPU_DEVICE_STATE=1) and "
+                         "an every-batch full-coverage guard audit "
+                         "(NHD_GUARD_AUDIT_INTERVAL=1, "
+                         "NHD_GUARD_AUDIT_ROWS=0) — the posture under "
+                         "which faulted binds are provably bit-identical "
+                         "to fault-free ones (make device-chaos)")
+    ap.add_argument("--bind-parity", action="store_true",
+                    help="run a fault-free CONTROL sim per cell (same "
+                         "seed, no profile) and fail the cell unless the "
+                         "faulted end state's bound set is bit-identical "
+                         "to the control's")
+    args = ap.parse_args(argv)
+
+    if args.device_plane:
+        # before any ChaosSim import builds a scheduler: these are read
+        # at context/batch build time
+        os.environ["NHD_TPU_DEVICE_STATE"] = "1"
+        os.environ.setdefault("NHD_GUARD_AUDIT_INTERVAL", "1")
+        os.environ.setdefault("NHD_GUARD_AUDIT_ROWS", "0")
 
     from nhd_tpu.sim.faults import PROFILES
 
@@ -165,7 +302,7 @@ def main() -> int:
         totals: dict = {}
         epochs, gaps, shard_gaps = 0, 0, 0
         for seed in range(args.start_seed, args.start_seed + args.seeds):
-            rec = _run_cell(args, profile, seed)
+            rec = _run_cell_timed(args, profile, seed)
             cells.append(rec)
             if not rec["ok"]:
                 mode_flags = (
