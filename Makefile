@@ -53,11 +53,13 @@ lint:
 # so the shard-lease/handoff/spillover lock surfaces run instrumented;
 # test_fleet.py puts the ISSUE 7 observability plane (per-replica span
 # rings, SLO trackers, journey merge, demotion dumps) under the same
-# instrumented locks.
+# instrumented locks; test_pipeline.py puts the r14 overlapped-commit
+# pipeline (scheduler/commitpipe.py condition + worker) and the
+# round-pipelining parity cells under them too.
 sanitize:
 	NHD_SAN=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
 		tests/test_streaming.py tests/test_faults.py tests/test_ha.py \
-		tests/test_fleet.py tests/test_guard.py -q
+		tests/test_fleet.py tests/test_guard.py tests/test_pipeline.py -q
 
 # full release gate: lint + suite + the seconds-scale bench-smoke leg
 # (writes a perf artifact and diffs it against the newest prior one, so
@@ -127,7 +129,7 @@ soak:
 # fault-storm matrix: chaos WITH API-layer fault injection, seeds x
 # profiles (docs/RESILIENCE.md; CI runs the fast cell in tests/test_faults.py)
 chaos:
-	python tools/chaos_storm.py --seeds $(CHAOS_SEEDS) --steps $(CHAOS_STEPS)
+	NHD_PIPELINE=1 python tools/chaos_storm.py --seeds $(CHAOS_SEEDS) --steps $(CHAOS_STEPS)
 
 # split-brain matrix: TWO scheduler replicas under leader election share
 # each cell's cluster, lease-renewal faults force leadership churn; zero
@@ -135,7 +137,7 @@ chaos:
 # (docs/RESILIENCE.md "HA & fencing"; CI runs the 3-seed subset in
 # tests/test_ha.py)
 ha-chaos:
-	python tools/chaos_storm.py --ha --profiles ha-light,ha-storm \
+	NHD_PIPELINE=1 python tools/chaos_storm.py --ha --profiles ha-light,ha-storm \
 		--seeds $(HA_SEEDS) --steps $(HA_STEPS) \
 		--json-out artifacts/chaos/ha_chaos.json
 
@@ -154,6 +156,9 @@ fed-chaos:
 		--json-out artifacts/chaos/fed_chaos.json \
 		--fleet-out artifacts/fleet
 
+# [the chaos/ha-chaos/device-chaos storm matrices force NHD_PIPELINE=1
+# so the round-pipelined posture — auto-off on CPU CI, on for
+# accelerators — is the one the chaos invariants prove out]
 # solver data-plane matrix: seeds x the device-faults profile (injected
 # dispatch/upload exceptions, slow dispatches, bit-flipped resident
 # rows) against the resident-state path, with a fault-free CONTROL run
@@ -162,7 +167,7 @@ fed-chaos:
 # (docs/RESILIENCE.md "Layer 8"; CI runs the fast cell in
 # tests/test_guard.py). Artifact per cell via --json-out.
 device-chaos:
-	python tools/chaos_storm.py --profiles device-faults --device-plane \
+	NHD_PIPELINE=1 python tools/chaos_storm.py --profiles device-faults --device-plane \
 		--bind-parity --seeds $(DEV_SEEDS) --steps $(DEV_STEPS) \
 		--json-out artifacts/chaos/device_chaos.json
 

@@ -391,9 +391,13 @@ def bench_churn(name, *, n_nodes, events_per_sec, sim_seconds,
             "engaging"
         )
 
-    # p99 time-to-bind scraped from the bind-latency histogram (smallest
-    # bucket edge covering >= 99% of observations)
-    p99_ms = 0.0
+    # p99 time-to-bind scraped from the bind-latency histogram —
+    # INTERPOLATED within the covering bucket (obs/histo.py
+    # quantile_from_buckets): the raw bucket upper edge made any
+    # regression inside a bucket invisible and crossing an edge read
+    # as a cliff (a 251 ms p99 reported as 500.0)
+    from nhd_tpu.obs.histo import quantile_from_buckets
+
     buckets = []
     for line in "\n".join(render_all()).splitlines():
         m = re_mod.match(
@@ -403,12 +407,7 @@ def bench_churn(name, *, n_nodes, events_per_sec, sim_seconds,
             edge = (float("inf") if m.group(1) == "+Inf"
                     else float(m.group(1)))
             buckets.append((edge, int(m.group(2))))
-    if buckets and buckets[-1][1] > 0:
-        total = buckets[-1][1]
-        for edge, count in buckets:
-            if count >= 0.99 * total:
-                p99_ms = (edge * 1e3 if edge != float("inf") else 30e3)
-                break
+    p99_ms = quantile_from_buckets(buckets, 0.99) * 1e3
 
     ev_rate = events_done / wall if wall > 0 else 0.0
     _log(
@@ -722,9 +721,12 @@ def bench_daemon(n_pods: int = 150) -> None:
             body = urllib.request.urlopen(
                 f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
             ).read().decode()
-            # p99 upper bound from the cumulative histogram: the smallest
-            # bucket edge covering >= 99% of observations (what
-            # histogram_quantile() would report from one scrape)
+            # p99 estimate from the cumulative histogram, interpolated
+            # within the covering bucket (obs/histo.py
+            # quantile_from_buckets — histogram_quantile() semantics,
+            # not the raw bucket edge)
+            from nhd_tpu.obs.histo import quantile_from_buckets
+
             buckets = []
             for line in body.splitlines():
                 m = re.match(
@@ -736,12 +738,8 @@ def bench_daemon(n_pods: int = 150) -> None:
                             else float(m.group(1)))
                     buckets.append((edge, int(m.group(2))))
             if buckets and buckets[-1][1] > 0:
-                total = buckets[-1][1]
-                for edge, count in buckets:
-                    if count >= 0.99 * total:
-                        gauge = (f"<={edge * 1e3:.1f}ms"
-                                 if edge != float("inf") else ">30s")
-                        break
+                p99 = quantile_from_buckets(buckets, 0.99)
+                gauge = f"~{p99 * 1e3:.1f}ms"
         except Exception as exc:
             gauge = f"scrape-failed ({exc})"
         lat_ms = np.asarray(lat[10:]) * 1e3  # drop warmup

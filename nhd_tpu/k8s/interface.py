@@ -197,6 +197,14 @@ class WatchEvent:
 class ClusterBackend(ABC):
     """The K8SMgr surface (reference file:line cited per method)."""
 
+    #: default posture for the overlapped commit pipeline
+    #: (scheduler/commitpipe.py, NHD_ASYNC_COMMIT): off unless the
+    #: backend's commits are real API round trips worth hiding — the
+    #: kube backend flips this to True; the fake backend (tests, chaos,
+    #: bench) stays synchronous so direct drives see their outcomes
+    #: before attempt_scheduling_batch returns
+    ASYNC_COMMIT_DEFAULT = False
+
     # ---- node reads ----
 
     @abstractmethod

@@ -95,6 +95,11 @@ def _parse_micro_time(raw: Optional[str]) -> Optional[float]:
 class KubeClusterBackend(ClusterBackend):
     """kubernetes-client implementation (reference: K8SMgr.py)."""
 
+    #: real API round trips per commit: overlap them with the next
+    #: batch's admission+solve by default (scheduler/commitpipe.py;
+    #: NHD_ASYNC_COMMIT=0 restores the strictly synchronous path)
+    ASYNC_COMMIT_DEFAULT = True
+
     def __init__(
         self,
         start_watches: bool = True,

@@ -177,7 +177,11 @@ def scrape_replica(base_url: str, *, timeout: float = 5.0) -> dict:
 
 def _bucketize(durations: List[float]) -> dict:
     """One bind-latency histogram (exact cumulative counts over the
-    standard latency ladder, obs/histo.py DEFAULT_BUCKETS)."""
+    standard latency ladder, obs/histo.py DEFAULT_BUCKETS) plus the
+    interpolated p99 (quantile_from_buckets — raw bucket edges hid
+    in-bucket regressions and read edge crossings as cliffs)."""
+    from nhd_tpu.obs.histo import quantile_from_buckets
+
     edges = tuple(DEFAULT_BUCKETS)
     # counts are cumulative by construction: each duration increments
     # EVERY edge it fits under, exactly the le= semantics
@@ -191,6 +195,9 @@ def _bucketize(durations: List[float]) -> dict:
         "sum_seconds": sum(durations),
         "max_seconds": max(durations, default=0.0),
         "buckets": {str(edge): c for edge, c in zip(edges, cum)},
+        "p99_seconds": quantile_from_buckets(
+            list(zip(edges, cum)) + [(float("inf"), len(durations))], 0.99
+        ),
     }
 
 
@@ -247,12 +254,25 @@ def build_fleet_payload(
     for v in views:
         fams = v.get("metrics") or {}
         if "nhd_bind_latency_seconds_bucket" in fams:
+            from nhd_tpu.obs.histo import quantile_from_buckets
+
+            raw = {
+                labels.get("le", "?"): value
+                for labels, value in
+                fams["nhd_bind_latency_seconds_bucket"]
+            }
             per_replica_bind[v["replica"]] = {
-                "buckets": {
-                    labels.get("le", "?"): value
-                    for labels, value in
-                    fams["nhd_bind_latency_seconds_bucket"]
-                },
+                "buckets": raw,
+                # interpolated, not the raw covering edge (same fix as
+                # the bench churn leg)
+                "p99_seconds": quantile_from_buckets(
+                    (
+                        (float("inf") if le == "+Inf" else float(le), c)
+                        for le, c in raw.items()
+                        if le != "?"
+                    ),
+                    0.99,
+                ),
             }
 
     # SLO: per-replica snapshots plus the fleet worst-of per window —

@@ -223,6 +223,44 @@ LABELED_HISTOGRAMS: Dict[str, LabeledHistogram] = {
 }
 
 
+def quantile_from_buckets(buckets, q: float) -> float:
+    """Interpolated quantile from cumulative (edge, count) pairs — the
+    PromQL ``histogram_quantile`` estimate, shared by every scrape-side
+    percentile render (bench.py churn/daemon legs, obs/fleet.py).
+
+    The old scrape-side p99 reported the raw upper EDGE of the covering
+    bucket: any regression inside a bucket was invisible, and crossing
+    an edge read as a cliff (a 251 ms p99 reported as 500 ms). Linear
+    interpolation within the bucket fixes both. The lower edge of the
+    first bucket is 0; a quantile landing in the +Inf bucket reports
+    the last finite edge (there is no upper bound to interpolate to —
+    PromQL's stance). Returns 0.0 with no observations.
+
+    ``buckets``: iterable of (upper_edge, cumulative_count), ascending,
+    +Inf edge last (``float('inf')`` accepted).
+    """
+    pairs = sorted(
+        ((float(e), int(c)) for e, c in buckets), key=lambda p: p[0]
+    )
+    if not pairs or pairs[-1][1] <= 0:
+        return 0.0
+    total = pairs[-1][1]
+    target = q * total
+    prev_edge, prev_cum = 0.0, 0
+    for edge, cum in pairs:
+        if cum >= target:
+            if edge == float("inf"):
+                return prev_edge
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return edge
+            return prev_edge + (edge - prev_edge) * (
+                (target - prev_cum) / in_bucket
+            )
+        prev_edge, prev_cum = edge, cum
+    return prev_edge
+
+
 def observe(name: str, value: float) -> None:
     """Observe into a registered histogram (KeyError on a typo'd name —
     misspelled instrumentation must fail tests, not vanish)."""

@@ -53,6 +53,36 @@ _LEGACY_SPEEDUP = re.compile(
 )
 
 
+#: the coarse HOST round-loop phases — the figure the r14 vectorize+
+#: pipeline work drives down, summed per config so the headline artifact
+#: shows the solve-vs-host split directly. ``assign`` already contains
+#: materialize+final_sync as sub-windows, which is exactly how the r14
+#: acceptance metric is defined (the sum is a tracked comparable, not a
+#: disjoint partition).
+HOST_PHASE_KEYS = ("select", "assign", "materialize", "final_sync")
+
+#: jit-stats attribution phases that are host-side work (the per-shape
+#: rollup below; device-side time lives in the solve/select/assign
+#: windows of the coarse trio and in the dispatch/readback entries)
+HOST_ATTRIBUTION_PHASES = frozenset({
+    "prepass", "encode", "fast_join", "native_assign", "materialize",
+    "final_sync", "backfill", "spec_expand", "guard_audit",
+})
+
+
+def host_phase_rollup(phase_seconds: Dict[str, float]) -> Dict[str, float]:
+    """Roll the jit-stats per-(phase, shape) attribution table up to a
+    host-seconds total per shape bucket — keys are ``"phase:shape"``
+    (obs/jitstats.py record_phase). The artifact's headline view of
+    where the host round loop spends per cluster shape."""
+    out: Dict[str, float] = {}
+    for key, secs in phase_seconds.items():
+        phase, _, shape = key.partition(":")
+        if phase in HOST_ATTRIBUTION_PHASES and shape:
+            out[shape] = out.get(shape, 0.0) + float(secs)
+    return out
+
+
 def config_record(
     *,
     wall_seconds: float,
@@ -67,13 +97,20 @@ def config_record(
     the legacy upgrader synthesizes the same shape from log lines).
     ``extra``: additional named sections (e.g. the sustained-churn leg's
     ``churn`` figures, gated by tools/bench_diff.py)."""
+    phases = dict(phases or {})
     rec = {
         "wall_seconds": wall_seconds,
         "placed": placed,
         "pods_per_sec": (placed / wall_seconds) if wall_seconds > 0 else 0.0,
         "speedup_vs_serial": speedup,
         "rounds": rounds,
-        "phases": dict(phases or {}),
+        "phases": phases,
+        # the solve-vs-host split, precomputed per config (the r14
+        # acceptance comparable): host = select+assign+materialize+
+        # final_sync as recorded
+        "host_phases_seconds": sum(
+            float(phases.get(k, 0.0)) for k in HOST_PHASE_KEYS
+        ),
         "p99_bind_ms": p99_bind_ms,
     }
     for key, value in (extra or {}).items():
@@ -95,10 +132,17 @@ def build_bench_artifact(
     """Payload + envelope in one step (what bench.py writes).
     ``phase_attribution`` is the jit-stats per-(phase, shape) table
     (obs/jitstats.py snapshot: phase_seconds + phase_counts)."""
+    attribution = dict(phase_attribution or {})
+    if "phase_seconds" in attribution:
+        # per-shape host total (host_phase_rollup): the solve-vs-host
+        # split per shape bucket, on the artifact's front page
+        attribution["host_seconds_by_shape"] = host_phase_rollup(
+            attribution["phase_seconds"]
+        )
     payload = {
         "platform": platform,
         "configs": {name: dict(rec) for name, rec in configs.items()},
-        "phase_attribution": dict(phase_attribution or {}),
+        "phase_attribution": attribution,
         "headline": dict(headline),
     }
     if micro:

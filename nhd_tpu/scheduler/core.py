@@ -101,6 +101,19 @@ if STREAM_PLACEMENT not in ("first-fit", "routed"):
 # (API-server round trips dominate gang bind latency on real clusters)
 COMMIT_WORKERS = int(os.environ.get("NHD_COMMIT_WORKERS", "1"))
 
+# overlapped fenced commit (scheduler/commitpipe.py, docs/PERFORMANCE.md
+# "Host round loop"): batch b's API-bound bind commits drain on a
+# bounded in-order pipeline while the scheduler thread admits and
+# solves batch b+1. Per-node order is preserved (strict FIFO), the
+# fencing epoch is read at drain (_commit_write runs on the worker when
+# the write happens), and outcomes — pod_state, unwind, requeue — are
+# processed back on the single-writer thread at its drain points.
+# NHD_ASYNC_COMMIT=1/0 overrides the backend default: off on the fake
+# backend (tests and chaos drive commits synchronously), on for kube,
+# where commits are real API round trips worth hiding. Depth bounds the
+# in-flight window; past it, submission backpressures the loop.
+COMMIT_DEPTH = int(os.environ.get("NHD_COMMIT_DEPTH", "256"))
+
 # incremental device-resident cluster state (solver/encode.py
 # ClusterDelta, docs/PERFORMANCE.md "Incremental device-resident
 # state"): the scheduler keeps ONE packed encode + FastCluster +
@@ -331,6 +344,26 @@ class Scheduler(threading.Thread):
 
         GUARD.heartbeat = self._beat
         self._stream = None   # built lazily past STREAM_NODE_THRESH
+        # overlapped fenced commit (COMMIT_DEPTH comment above): env
+        # override wins, else the backend's own default — kube turns it
+        # on, the fake backend stays synchronous
+        env_async = os.environ.get("NHD_ASYNC_COMMIT", "").lower()
+        if env_async in ("1", "true", "on"):
+            self._async_commit = True
+        elif env_async in ("0", "false", "off"):
+            self._async_commit = False
+        elif env_async in ("", "auto"):
+            self._async_commit = bool(
+                getattr(backend, "ASYNC_COMMIT_DEFAULT", False)
+            )
+        else:
+            # same word sets as NHD_PIPELINE; a typo'd value must fail
+            # loud, not silently flip a commit-path posture
+            raise ValueError(
+                f"NHD_ASYNC_COMMIT must be 1/0/true/false/on/off/auto, "
+                f"got {env_async!r}"
+            )
+        self._commitpipe = None   # lazy CommitPipeline when enabled
         # incremental cluster state (NHD_DELTA_STATE): the ClusterDelta
         # over self.nodes plus its delta-built ScheduleContext, reused
         # across batches; None until the first batch (and after
@@ -867,6 +900,34 @@ class Scheduler(threading.Thread):
             else:
                 winners.append((parser, item, result))
 
+        # overlapped fenced commit: submit the winners' commit closures
+        # to the bounded in-order pipeline and return — the API round
+        # trips drain on the worker (fencing epoch read at drain) while
+        # this thread admits and solves the next batch. Outcomes already
+        # completed (usually the PREVIOUS batch's) are processed now, on
+        # this thread; the rest land at the next run_once drain point.
+        # An explicit NHD_COMMIT_WORKERS>1 wins over the backend's async
+        # default: the pipeline's single FIFO worker overlaps batches
+        # but serializes WITHIN one, and silently disabling the
+        # operator's intra-batch commit parallelism would regress gang
+        # bind tails N-fold.
+        if self._async_commit and COMMIT_WORKERS <= 1 and winners:
+            from nhd_tpu.scheduler.commitpipe import CommitUnit
+
+            units = []
+            for parser, item, result in winners:
+                corr = corrs.get(item.key)
+                units.append(CommitUnit(
+                    item.key,
+                    (lambda p=parser, i=item, r=result, c=corr:
+                        self._commit_traced(p, i, r, c)),
+                    (parser, item, result, corr,
+                     uids.get(item.key, "0"), waits.get(item.key),
+                     bstats, t_adm),
+                ))
+            self._commit_pipeline().submit(units)
+            return self._drain_commits(block=False)
+
         # the commit path is >= 5 serial API round trips per pod — at gang
         # scale the API server, not the solver, bounds bind latency. With
         # NHD_COMMIT_WORKERS > 1 the per-pod backend call sequences run on
@@ -890,57 +951,133 @@ class Scheduler(threading.Thread):
         scheduled = 0
         for (parser, item, result), (outcome, t_done) in zip(winners, outcomes):
             self._beat()   # one commit outcome processed: progress
-            ns, pod = item.key
-            corr = corrs.get(item.key)
-            if outcome is CommitOutcome.OK:
-                scheduled += 1
-                # admission → commit-complete, the operator-facing figure
-                # (queue wait is its own histogram; their sum is receipt
-                # → bound)
-                obs_histo.observe(
-                    "bind_latency_seconds", max(t_done - t_adm, 0.0)
-                )
-                # SLO plane: creation → bound on the cluster's clock
-                # (one backend read per successful bind)
-                self._observe_slo_bind(pod, ns)
-                self._requeue_attempts.pop((ns, pod), None)
-                self.pod_state[(ns, pod)] = {
-                    "state": PodStatus.SCHEDULED, "time": time.time(),
-                    "uid": uids.get((ns, pod), "0"),
-                }
-                if rec is not None:
-                    rec.record_decision(self._decision(
-                        pod, ns, corr, "scheduled", node=result.node,
-                        queue_wait=waits.get(item.key), stats=bstats,
-                        bind=max(t_done - t_adm, 0.0),
-                    ))
-            elif outcome is CommitOutcome.RETRY and self._requeue_pod(
-                pod, ns, uids.get((ns, pod), "0"), self.nodes[result.node],
-                item, corr=corr,
+            if self._finish_commit(
+                parser, item, result, corrs.get(item.key),
+                uids.get(item.key, "0"), waits.get(item.key), bstats,
+                t_adm, outcome, t_done,
             ):
-                # claim unwound, pod back on the queue
-                if rec is not None:
-                    rec.record_decision(self._decision(
-                        pod, ns, corr, "requeued", node=result.node,
-                        queue_wait=waits.get(item.key), stats=bstats,
-                    ))
-            else:
-                self._requeue_attempts.pop((ns, pod), None)
-                self._unwind(pod, ns, self.nodes[result.node], item)
-                self.failed_schedule_count += 1
-                self.pod_state[(ns, pod)] = {
-                    "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
-                }
-                if rec is not None:
-                    rec.record_decision(self._decision(
-                        pod, ns, corr, "commit-failed", node=result.node,
-                        queue_wait=waits.get(item.key), stats=bstats,
-                    ))
-        # commit-level count: a pod is "scheduled" only once bound (a pod
-        # the solver placed but whose commit failed counts as failed, not
-        # both — dashboards divide these)
-        self.perf["scheduled_total"] += scheduled
+                scheduled += 1
         return scheduled
+
+    def _finish_commit(
+        self, parser: CfgParser, item: BatchItem, result, corr: Optional[str],
+        uid: str, wait: Optional[float], bstats, t_adm: float,
+        outcome: CommitOutcome, t_done: float,
+    ) -> bool:
+        """Process one pod's commit outcome on the single-writer thread
+        — every mirror mutation (pod_state, unwind, requeue) lives here,
+        shared by the synchronous loop and the async pipeline's drain.
+        Returns True when the pod ended up bound."""
+        ns, pod = item.key
+        rec = self._rec()
+        # the commit may have drained after the node left the mirror
+        # (async pipeline + NODE_REMOVE): its claims died with the node,
+        # so unwind becomes a no-op but the state machine still runs
+        node = self.nodes.get(result.node)
+        if outcome is CommitOutcome.OK:
+            # admission → commit-complete, the operator-facing figure
+            # (queue wait is its own histogram; their sum is receipt
+            # → bound). Commit-level count: a pod is "scheduled" only
+            # once bound (a pod the solver placed but whose commit
+            # failed counts as failed, not both — dashboards divide
+            # these).
+            self.perf["scheduled_total"] += 1
+            obs_histo.observe(
+                "bind_latency_seconds", max(t_done - t_adm, 0.0)
+            )
+            # SLO plane: creation → bound on the cluster's clock
+            # (one backend read per successful bind)
+            self._observe_slo_bind(pod, ns)
+            self._requeue_attempts.pop((ns, pod), None)
+            self.pod_state[(ns, pod)] = {
+                "state": PodStatus.SCHEDULED, "time": time.time(),
+                "uid": uid,
+            }
+            if rec is not None:
+                rec.record_decision(self._decision(
+                    pod, ns, corr, "scheduled", node=result.node,
+                    queue_wait=wait, stats=bstats,
+                    bind=max(t_done - t_adm, 0.0),
+                ))
+            return True
+        if outcome is CommitOutcome.RETRY and self._requeue_pod(
+            pod, ns, uid, node, item, corr=corr,
+        ):
+            # claim unwound, pod back on the queue
+            if rec is not None:
+                rec.record_decision(self._decision(
+                    pod, ns, corr, "requeued", node=result.node,
+                    queue_wait=wait, stats=bstats,
+                ))
+            return False
+        self._requeue_attempts.pop((ns, pod), None)
+        self._unwind(pod, ns, node, item)
+        self.failed_schedule_count += 1
+        self.pod_state[(ns, pod)] = {
+            "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
+        }
+        if rec is not None:
+            rec.record_decision(self._decision(
+                pod, ns, corr, "commit-failed", node=result.node,
+                queue_wait=wait, stats=bstats,
+            ))
+        return False
+
+    # ------------------------------------------------------------------
+    # overlapped fenced commit (scheduler/commitpipe.py)
+    # ------------------------------------------------------------------
+
+    def _commit_pipeline(self):
+        """The lazy commit pipeline (NHD_ASYNC_COMMIT); its worker
+        advances the loop heartbeat per drained commit so a long queue
+        against a slow API server reads as progress, not a stall."""
+        if self._commitpipe is None:
+            from nhd_tpu.scheduler.commitpipe import CommitPipeline
+
+            self._commitpipe = CommitPipeline(
+                depth=COMMIT_DEPTH, heartbeat=self._beat,
+            )
+        return self._commitpipe
+
+    def _drain_commits(self, *, block: bool) -> int:
+        """Process completed async-commit outcomes on this (the
+        single-writer) thread; returns how many pods ended up bound.
+        ``block`` = full barrier: used before any pass that re-reads
+        cluster state (periodic scan, mirror rebuild, promotion replay)
+        — an in-flight bind must not race a listing that still shows
+        its pod Pending."""
+        if self._commitpipe is None:
+            return 0
+        pairs = (
+            self._commitpipe.drain_all() if block
+            else self._commitpipe.drain_ready()
+        )
+        scheduled = 0
+        for unit, result in pairs:
+            self._beat()   # one commit outcome processed: progress
+            if isinstance(result, tuple):
+                outcome, t_done = result
+            else:
+                # the closure raised (contract violation, logged by the
+                # worker): the pod takes the terminal-failure path
+                outcome, t_done = CommitOutcome.FAILED, time.monotonic()
+            (parser, item, res, corr, uid, wait, bstats, t_adm) = unit.ctx
+            if self._finish_commit(
+                parser, item, res, corr, uid, wait, bstats, t_adm,
+                outcome, t_done,
+            ):
+                scheduled += 1
+        return scheduled
+
+    def _commit_barrier_for(self, ns: str, pod: str) -> None:
+        """Drain the pipeline before acting on a pod event whose commit
+        is still in flight (delete racing a bind, a duplicate create) —
+        the single-writer contract demands the outcome lands first."""
+        if (
+            self._commitpipe is not None
+            and (ns, pod) in self._commitpipe.inflight_keys()
+        ):
+            self._drain_commits(block=True)
 
     def _decision(
         self,
@@ -1023,8 +1160,8 @@ class Scheduler(threading.Thread):
         return outcome, t_done
 
     def _requeue_pod(
-        self, pod: str, ns: str, uid: str, node: HostNode, item: BatchItem,
-        *, corr: Optional[str] = None,
+        self, pod: str, ns: str, uid: str, node: Optional[HostNode],
+        item: BatchItem, *, corr: Optional[str] = None,
     ) -> bool:
         """Requeue a pod whose commit failed transiently (API-server
         health, not a verdict on the pod). Returns False once the per-pod
@@ -1362,7 +1499,17 @@ class Scheduler(threading.Thread):
 
     def _commit_pod_calls_inner(self, parser: CfgParser, item: BatchItem, result) -> bool:
         ns, pod = item.key
-        node = self.nodes[result.node]
+        node = self.nodes.get(result.node)
+        if node is None:
+            # async drain path: the node left the mirror while this
+            # commit sat queued (the NODE_REMOVE barrier closes the
+            # common window; a same-turn removal can still win). The
+            # bind target is gone — transient, so the pod requeues and
+            # the next attempt solves against the current mirror.
+            raise TransientBackendError(
+                f"target node {result.node} left the mirror before "
+                f"{ns}/{pod}'s commit drained"
+            )
         self.backend.generate_pod_event(
             pod, ns, "Scheduling", EventType.NORMAL,
             f"Node {result.node} selected for scheduling",
@@ -1420,14 +1567,20 @@ class Scheduler(threading.Thread):
         return True
 
 
-    def _unwind(self, pod: str, ns: str, node: HostNode, item: BatchItem) -> None:
+    def _unwind(
+        self, pod: str, ns: str, node: Optional[HostNode], item: BatchItem,
+    ) -> None:
         """Roll back an applied batch claim when the K8s commit path fails.
 
         The batch already mutated the host mirror, so release directly from
         the solved topology (the reference re-reads the annotation,
         NHDScheduler.py:174-205; at this point the annotation may not exist
-        yet, but the topology object in hand is the same data).
+        yet, but the topology object in hand is the same data). ``node``
+        may be None on the async drain path — the node left the mirror
+        while the commit was in flight, taking the claims with it.
         """
+        if node is None:
+            return
         if item.topology is not None:
             node.release_from_topology(item.topology)
         node.remove_scheduled_pod(pod, ns)
@@ -1443,6 +1596,9 @@ class Scheduler(threading.Thread):
         ones (reference: NHDScheduler.py:425-441), and reconcile the host
         mirror against the live pod list."""
         self._beat()
+        # async-commit barrier: a pod whose bind is still in flight must
+        # not be re-admitted off a listing that still shows it Pending
+        self._drain_commits(block=True)
         podlist = self.backend.service_pods(self.sched_name)
         self.reconcile_deleted_pods(
             {(ns, pod): uid for (ns, pod, uid) in podlist}
@@ -1645,6 +1801,21 @@ class Scheduler(threading.Thread):
 
     def handle_watch_item(self, item: WatchItem) -> None:
         """One controller event (reference: NHDScheduler.py:492-570)."""
+        if item.type in (
+            WatchType.TRIAD_POD_DELETE, WatchType.TRIAD_POD_CREATE
+        ):
+            # async-commit barrier, per pod: the event's outcome depends
+            # on whether the in-flight bind landed
+            self._commit_barrier_for(item.pod["ns"], item.pod["name"])
+        elif (
+            item.type == WatchType.NODE_REMOVE
+            and self._commitpipe is not None
+            and self._commitpipe.inflight_keys()
+        ):
+            # node events carry no pod key, and any in-flight commit may
+            # target the vanishing node (whose HostNode the worker reads
+            # unsynchronized) — full barrier before the mirror drops it
+            self._drain_commits(block=True)
         if item.type == WatchType.TRIAD_POD_DELETE:
             ns, pod = item.pod["ns"], item.pod["name"]
             self.release_pod_resources(
@@ -1807,6 +1978,7 @@ class Scheduler(threading.Thread):
         # heartbeat advances per phase: on a large cluster a legitimate
         # replay can outlast the watchdog's whole-turn budget, and a
         # crash mid-promotion would hand the NEXT replica the same wall
+        self._drain_commits(block=True)  # fenced-off stragglers resolve
         self.nodes.clear()
         self._invalidate_delta()  # node objects replaced wholesale
         self.build_initial_node_list()
@@ -1875,6 +2047,7 @@ class Scheduler(threading.Thread):
         annotations, then scan. Nodes on shards this replica already
         held keep their live mirror — gaining one shard must not pay a
         fleet-wide replay."""
+        self._drain_commits(block=True)  # held-shard stragglers resolve
         old = self.nodes
         self.nodes = {}
         try:
@@ -1948,6 +2121,10 @@ class Scheduler(threading.Thread):
         drained non-blocking each iteration — a stats call waits at
         most one loop turn, bind latency drops to solver time."""
         self._beat()
+        if self._commitpipe is not None:
+            # drain completed async commits first: their outcomes are
+            # the oldest pending single-writer work of this turn
+            self._drain_commits(block=False)
         acting = self.poll_leadership()
         try:
             rpc = self.rpcq.get(block=False)
@@ -1991,6 +2168,9 @@ class Scheduler(threading.Thread):
         """
         try:
             if self._mirror_dirty:
+                # outcomes of commits submitted before the failed pass
+                # must land before the mirror is rebuilt over them
+                self._drain_commits(block=True)
                 self.reset_resources()
                 self._mirror_dirty = False
             fn(*args)
@@ -2009,6 +2189,11 @@ class Scheduler(threading.Thread):
         idle = 0
         while not self._stop_event.is_set():
             idle = self.run_once(idle_count=idle)
+        if self._commitpipe is not None:
+            # flush accepted commits, then process their outcomes here —
+            # the last single-writer act of the loop
+            self._drain_commits(block=True)
+            self._commitpipe.stop(flush=False)
 
     def stop(self) -> None:
         self._stop_event.set()

@@ -230,6 +230,43 @@ class GcPin:
 _GC_PIN_MIN_ITEMS = 4096
 
 
+def _unique_rows(cols):
+    """``np.unique(axis=0)`` over parallel int columns, via ONE packed
+    int64 key — the structured-dtype sort behind axis-unique measured
+    4-10× a scalar unique at round-sized inputs (40-1000 rows), which
+    was the whole materialize win. Each column is shifted by its own
+    minimum before packing — NIC rows carry a ``-1`` no-NIC sentinel
+    (native nhd_assign writes it for CPU-only groups), and packing a
+    negative would break key injectivity (two distinct rows colliding
+    = a pod handed another row's consumed-NIC tuple). Falls back to
+    the axis form when the packed key would overflow int64 (never at
+    sane lattices — the bit budget is the sum of per-column ranges).
+
+    Returns ``(rows, inverse)``: the distinct rows (original,
+    unshifted values) as an [U, len(cols)] array and the per-input
+    index into it."""
+    bits = 0
+    spans = []
+    for c in cols:
+        lo = int(c.min()) if len(c) else 0
+        span = (int(c.max()) - lo + 1) if len(c) else 1
+        spans.append((lo, span))
+        bits += max(span - 1, 1).bit_length()
+    if bits <= 62:
+        key = np.zeros(len(cols[0]), np.int64)
+        for c, (lo, span) in zip(cols, spans):
+            key = key * span + (c.astype(np.int64, copy=False) - lo)
+        _, first_idx, inv = np.unique(
+            key, return_index=True, return_inverse=True
+        )
+        rows = np.stack([c[first_idx] for c in cols], axis=1)
+        return rows, inv
+    mat = np.stack(
+        [c.astype(np.int64, copy=False) for c in cols], axis=1
+    )
+    return np.unique(mat, axis=0, return_inverse=True)
+
+
 def _gc_pinned(fn):
     """Wrap a schedule call in GcPin acquire/release — but only for
     gang-scale batches (>= _GC_PIN_MIN_ITEMS items). Pinning every
@@ -267,6 +304,30 @@ def _rung_of(dev) -> int:
     if dev is None:
         return RUNG_HOST
     return RUNG_MESH if dev.mesh is not None else RUNG_SINGLE
+
+
+def _pipeline_enabled() -> bool:
+    """Universal round pipelining (docs/PERFORMANCE.md "Host round
+    loop"): every round dispatches round r+1's solves — respecting the
+    claims this round just staged — before running its own host phases,
+    so select/materialize/sync execute UNDER the in-flight device
+    compute. NHD_PIPELINE: ``1`` forces on, ``0`` is the kill switch
+    (strict dispatch-at-round-start ordering, the bit-exactness control
+    the pipeline-parity suite pins placements against), ``auto``
+    (default) = on exactly when the default backend is an accelerator —
+    the overlap needs a device to hide under, and on a host-only
+    backend the early dispatch just steals cores from the very host
+    phases it is supposed to hide (measured −1.5% sustained churn on
+    CPU CI). Read per schedule() call so tests can toggle it without
+    rebuilding schedulers."""
+    import os
+
+    val = os.environ.get("NHD_PIPELINE", "auto").lower()
+    if val in ("1", "true", "on"):
+        return True
+    if val in ("0", "false", "off"):
+        return False
+    return _accelerator_backend()
 
 
 def _cpu_small_max() -> int:
@@ -431,6 +492,71 @@ class BatchScheduler:
         except Exception:
             return None
         return make_mesh(devices) if len(devices) > 1 else None
+
+    def _select_winners(
+        self, pods, out: RankHost, node_claimed: Dict[int, int], G: int
+    ):
+        """Vectorized capacity-aware packing for one bucket's round: the
+        per-type winner extraction that used to run as a ``by_type``
+        dict build plus a per-pod ``zip(pod_type, pod_index)`` loop, as
+        pure array ops over the ranked candidates — bit-exact with the
+        loop by construction (same greedy rank-order fill against the
+        same optimistic capacity estimates, same one-bucket-per-node
+        blocking, same pod-index consumption order per type).
+
+        Returns ``(w_pod, w_node, w_type, w_rank)`` sorted by pod index
+        (the native apply order), or None when the bucket wins nothing
+        this round. Mutates ``node_claimed`` with this bucket's claimed
+        nodes, exactly like the loop's ``setdefault`` per claim."""
+        cap = self._capacity_at(pods, out)            # [T, R], 0 off-prefix
+        T, R = cap.shape
+        if node_claimed:
+            # one-bucket-per-node rule: nodes another bucket claimed this
+            # round are blocked (static within a bucket)
+            blocked = np.asarray(
+                [n for n, g in node_claimed.items() if g != G], np.int64
+            )
+            if len(blocked):
+                cap[np.isin(out.idx, blocked)] = 0
+        # greedy fill in rank order, whole bucket at once: each type
+        # takes min(cap, need left) at every rank position
+        need_t = np.bincount(pods.pod_type, minlength=T)
+        cap = np.minimum(cap, need_t[:, None])
+        cum = np.cumsum(cap, axis=1)
+        take = np.clip(need_t[:, None] - (cum - cap), 0, cap)
+        k_t = take.sum(axis=1)                        # winners per type
+        if not k_t.any():
+            return None
+        take_flat = take.ravel()
+        w_node = np.repeat(out.idx.ravel(), take_flat).astype(
+            np.int32, copy=False
+        )
+        w_rank = np.repeat(np.tile(np.arange(R, dtype=np.int32), T),
+                           take_flat)
+        w_type = np.repeat(np.arange(T, dtype=np.int32), k_t)
+        # pods of a type consume claims in pod-index order: pod_index is
+        # ascending within the encode, so a stable sort by type keeps it,
+        # and each type's first k_t pods are its winners
+        order = np.argsort(pods.pod_type, kind="stable")
+        podid_sorted = pods.pod_index[order]
+        types_sorted = pods.pod_type[order]
+        starts = np.concatenate(([0], np.cumsum(need_t)[:-1]))
+        ordinal = (
+            np.arange(len(types_sorted), dtype=np.int64)
+            - starts[types_sorted]
+        )
+        w_pod = podid_sorted[ordinal < k_t[types_sorted]].astype(
+            np.int64, copy=False
+        )
+        for n in np.unique(w_node).tolist():
+            node_claimed.setdefault(int(n), G)
+        o = np.argsort(w_pod, kind="stable")
+        return (
+            np.ascontiguousarray(w_pod[o]),
+            np.ascontiguousarray(w_node[o]),
+            np.ascontiguousarray(w_type[o]),
+            np.ascontiguousarray(w_rank[o]),
+        )
 
     def _capacity_at(self, pods, rank: RankHost) -> np.ndarray:
         """Optimistic copies-per-node estimate cap[T, R] over the ranked
@@ -1134,9 +1260,11 @@ class BatchScheduler:
         # top-R rank budget, fixed at round 1 (the largest round) so every
         # round's ranker hits the same jit program
         R = None
-        # solves for round r+1, dispatched by round r's native-assign path
-        # before it materializes results (round pipelining)
+        # solves for round r+1, dispatched by round r before it runs its
+        # host phases (universal round pipelining; NHD_PIPELINE=0 kills
+        # it for parity testing — placements are bit-exact either way)
         prelaunched = None
+        pipeline_on = apply and _pipeline_enabled()
         # speculative on-device multi-round (solver/speculate.py): round 0
         # runs the whole greedy-round loop in ONE device dispatch and the
         # host re-verifies its claims through the normal native apply;
@@ -1284,6 +1412,43 @@ class BatchScheduler:
                     and n_pending <= _cpu_small_max()
                     and cluster.n_nodes <= _cpu_small_nodes()
                 )
+
+            def _prelaunch() -> float:
+                """Dispatch round r+1's solves NOW — the arrays (and the
+                staged claim rows, via the stage_rows scatter the
+                dispatch flushes) already carry this round's claims, so
+                the host phases that follow overlap the next round's XLA
+                compute. Universal across postures: the native path, the
+                object fallback, CPU-routed small rounds (_route_cpu),
+                mesh-sharded and streaming-tile sub-calls all feed the
+                same ``prelaunched`` seam. A prelaunch fault costs only
+                the pipelining: recover the device plane now and let the
+                next round dispatch fresh under its own boundary (a
+                faulted batch never prelaunches again this round-trip).
+                Returns the host dispatch seconds — attributed to the
+                dedicated ``prelaunch`` phase (not solve, not assign):
+                the coarse phases stay comparable artifact-to-artifact,
+                and the dispatch cost stays visible in the phase table
+                instead of inflating whichever window it runs inside."""
+                nonlocal prelaunched, spec_ok, dev
+                is_pending[:] = False
+                is_pending[pending] = True
+                t_pl = time.perf_counter()
+                try:
+                    prelaunched = _dispatch_solves(_route_cpu(len(pending)))
+                    stats.count_add("prelaunched_rounds", 1)
+                except Exception as exc:
+                    if not guard_on or GUARD.on_fault(
+                        exc, rung=_rung_of(dev), attempt=1,
+                        shape_key=getattr(exc, "_nhd_shape_key", ""),
+                    ) != "retry":
+                        raise
+                    prelaunched = None
+                    spec_ok = False
+                    dev = self._guard_recover(dev, cluster, context)
+                dt = time.perf_counter() - t_pl
+                stats.phase_add("prelaunch", dt)
+                return dt
 
             # ---- solve phase, under the guard's fault boundary ------
             # Any exception out of a device dispatch, an async pull or
@@ -1462,16 +1627,21 @@ class BatchScheduler:
                 spec_winners, node_claimed = self._expand_speculative(
                     spec, claims_np, counts_np, cluster
                 )
+            # per-bucket vectorized winner arrays for this round:
+            # {G: (pods, w_pod, w_node, w_type, w_rank)} — claim TUPLES
+            # are only materialized for the dry-run and object-fallback
+            # paths (the per-pod tuple builds were the select phase's
+            # dominant cost at gang scale, r14 profile)
+            winners: Dict[int, tuple] = {}
             for G, (pods, out) in ({} if spec_round else bucket_out).items():
-                # candidates arrive pre-ranked from the device (desc sel
-                # value = pref then low-node-index, kernel._rank_body);
-                # valid prefix length per type:
-                n_cands = (out.val > 0).sum(axis=1)
-
                 if not apply:
                     # dry-run: every pod reports its own snapshot match (the
                     # reference's FindNode answer), with no contention model —
-                    # a conflict "loser" would wrongly read as unschedulable
+                    # a conflict "loser" would wrongly read as unschedulable.
+                    # Candidates arrive pre-ranked from the device (desc sel
+                    # value = pref then low-node-index, kernel._rank_body);
+                    # valid prefix length per type:
+                    n_cands = (out.val > 0).sum(axis=1)
                     for t, pod_i in zip(pods.pod_type, pods.pod_index):
                         t = int(t)
                         if n_cands[t] > 0:
@@ -1482,37 +1652,12 @@ class BatchScheduler:
 
                 # capacity-aware packing (the reference's first-fit shape):
                 # each type fills its ranked candidates up to an optimistic
-                # per-node capacity estimate — vectorized as a repeat of the
-                # ranked nodes by capacity (claims are re-verified against
+                # per-node capacity estimate (claims are re-verified against
                 # live state at assignment, so an overestimate just costs a
-                # retry). Pods of one type are in pod-index order already.
-                cap = self._capacity_at(pods, out)
-                # one-bucket-per-node rule: nodes another bucket claimed
-                # this round are blocked — static within a bucket, so
-                # computed once as a vector mask
-                blocked = np.asarray(
-                    [n for n, g in node_claimed.items() if g != G], np.int64
-                )
-                by_type: Dict[int, List[int]] = {}
-                for t, pod_i in zip(pods.pod_type, pods.pod_index):
-                    by_type.setdefault(int(t), []).append(int(pod_i))
-                for t, pod_ids in by_type.items():
-                    if n_cands[t] == 0:
-                        continue
-                    ranked = out.idx[t, : n_cands[t]]
-                    caps_r = cap[t, : n_cands[t]].copy()
-                    if len(blocked):
-                        caps_r[np.isin(ranked, blocked)] = 0
-                    need = len(pod_ids)
-                    caps_r = np.minimum(caps_r, need)
-                    cut = int(np.searchsorted(np.cumsum(caps_r), need)) + 1
-                    reps = caps_r[:cut]  # cut may overrun: slices clamp
-                    assigned = np.repeat(ranked[: len(reps)], reps)[:need]
-                    ranks = np.repeat(np.arange(len(reps)), reps)[:need]
-                    for pod_i, n, j in zip(pod_ids, assigned, ranks):
-                        n = int(n)
-                        node_claimed.setdefault(n, G)
-                        claims.append((pod_i, n, G, t, int(j)))
+                # retry) — one vectorized pass per bucket (_select_winners)
+                w = self._select_winners(pods, out, node_claimed, G)
+                if w is not None:
+                    winners[G] = (pods, *w)
             # assignment order = pod index order: per node this is a valid
             # sequential execution (claims re-verified as they apply); the
             # first claim a node actually processes ran against fresh
@@ -1522,7 +1667,7 @@ class BatchScheduler:
             applied_on_node: set = set()
             stats.select_seconds += time.perf_counter() - t0
 
-            if not claims and not spec_winners:
+            if not claims and not winners and not spec_winners:
                 if spec_round:
                     # an empty speculation is not a saturation verdict —
                     # fall through to a classic round (keep the round
@@ -1553,12 +1698,29 @@ class BatchScheduler:
                 # object-assignment fallback consumes claim tuples + a
                 # synthetic RankHost — materialize them from the arrays
                 claims, bucket_out = self._spec_tuples(spec_winners)
+            elif not round_ok and winners:
+                # classic object-assignment fallback: pod-sorted claim
+                # tuples from the vectorized winner arrays
+                claims = [
+                    (int(p), int(n), G, int(t), int(j))
+                    for G, (_po, w_pod, w_node, w_type, w_rank) in (
+                        winners.items()
+                    )
+                    for p, n, t, j in zip(
+                        w_pod.tolist(), w_node.tolist(),
+                        w_type.tolist(), w_rank.tolist(),
+                    )
+                ]
+                claims.sort()
             if round_ok:
                 # one native call per bucket places every winner of the
                 # round (native/nhd_assign.cc::nhd_assign_round) and
                 # mutates the packed state + solver arrays. The winner
                 # arrays come straight from the speculative expand, or
-                # from the classic round's claim tuples.
+                # from the classic round's vectorized select — the
+                # claims→array expansion (per-claim tuples regrouped via
+                # np.fromiter) is gone: the (c, m) gathers index the rank
+                # tensors with the winner arrays directly.
                 native_in = []
                 if spec_round:
                     for G, (pods, w_pod, w_node, w_type, w_c, w_m, _a) in (
@@ -1568,17 +1730,10 @@ class BatchScheduler:
                             (G, pods, w_pod, w_node, w_type, w_c, w_m)
                         )
                 else:
-                    by_bucket: Dict[int, List[Tuple[int, int, int, int]]] = {}
-                    for pod_i, n, G, t, j in claims:
-                        by_bucket.setdefault(G, []).append((pod_i, n, t, j))
-                    for G, winners in by_bucket.items():
-                        pods, out = bucket_out[G]
-                        w_pod = np.fromiter(
-                            (w[0] for w in winners), np.int64, len(winners)
-                        )
-                        w_node = np.asarray([w[1] for w in winners], np.int32)
-                        w_type = np.asarray([w[2] for w in winners], np.int32)
-                        w_rank = np.asarray([w[3] for w in winners], np.int32)
+                    for G, (pods, w_pod, w_node, w_type, w_rank) in (
+                        winners.items()
+                    ):
+                        out = bucket_out[G][1]
                         w_c = np.ascontiguousarray(
                             out.best_c[w_type, w_rank], np.int32)
                         w_m = np.ascontiguousarray(
@@ -1680,53 +1835,123 @@ class BatchScheduler:
                     )
                     pending = pending[:0]
 
-                # dispatch round r+1's solves NOW — the arrays already
-                # carry this round's claims, so the Python result
-                # materialization below overlaps the next XLA compute
-                # (a small leftover routes to the host CPU backend: its
-                # solve beats the accelerator's fixed relay turnaround)
-                if len(pending) and round_no + 1 < self.max_rounds:
-                    is_pending[:] = False
-                    is_pending[pending] = True
-                    try:
-                        prelaunched = _dispatch_solves(
-                            _route_cpu(len(pending))
-                        )
-                    except Exception as exc:
-                        # a prelaunch fault costs only the pipelining:
-                        # recover the device plane now and let the next
-                        # round dispatch fresh under its own boundary
-                        if not guard_on or GUARD.on_fault(
-                            exc, rung=_rung_of(dev), attempt=1,
-                            shape_key=getattr(exc, "_nhd_shape_key", ""),
-                        ) != "retry":
-                            raise
-                        prelaunched = None
-                        spec_ok = False
-                        dev = self._guard_recover(dev, cluster, context)
+                # dispatch round r+1's solves NOW (round pipelining,
+                # NHD_PIPELINE): the result materialization below runs
+                # under the next XLA compute (a small leftover routes to
+                # the host CPU backend: its solve beats the accelerator's
+                # fixed relay turnaround). The dispatch seconds shift the
+                # assign-phase clock (t0): they are solve work executing
+                # inside the assign window, and leaving them in `assign`
+                # made the pipelined figure incomparable to the
+                # NHD_PIPELINE=0 control.
+                if (
+                    pipeline_on
+                    and len(pending)
+                    and round_no + 1 < self.max_rounds
+                ):
+                    t0 += _prelaunch()
 
                 t_mat = time.perf_counter()
+                U_, K_ = cluster.U, cluster.K
+                names = cluster.names
+                want_record = self.register_pods
+                BA_make = BatchAssignment._make
                 for bi, (G, pods, w_pod, w_node, w_type, buffers, w_c, w_m) in (
                     enumerate(native_out)
                 ):
-                    # winner loop runs ~10k times a round at gang scale:
-                    # one .tolist() per buffer up front (C speed) so the
-                    # loop touches only Python ints, per-type NIC
-                    # templates so nic lists need no object-graph walks,
-                    # and a local (c, m, pick) memo in front of the
-                    # decode_mapping lru (dict.get beats the lru wrapper).
-                    # Failures are handled in a separate small pass (their
-                    # final-vs-retry verdict is the precomputed `first`
-                    # mask), so the success loop stays branch-light even
-                    # on contended rounds.
+                    # materialize, vectorized: the round's mapping points
+                    # and consumed-NIC tuples are batch-decoded in one
+                    # numpy uniquing pass each — winners draw from a
+                    # handful of distinct (combo, misc, pick) points, so
+                    # decode_mapping runs once per point, not once per
+                    # pod, and the per-winner Python loop shrinks to the
+                    # BatchAssignment._make scatter (tuple.__new__
+                    # directly; the generated __new__ is a Python frame,
+                    # ~2x the cost). Failures are handled in a separate
+                    # small pass (their final-vs-retry verdict is the
+                    # precomputed `first` mask).
                     status = buffers[0]
-                    picks_l = buffers[5].tolist()
-                    w_c_l = w_c.tolist()
-                    w_m_l = w_m.tolist()
-                    out_nic_l = buffers[3].tolist()
-                    w_pod_l = w_pod.tolist()
+                    ok = status >= 0
                     w_node_l = w_node.tolist()
-                    w_type_l = w_type.tolist()
+                    applied_on_node.update(w_node_l)
+                    all_ok = bool(ok.all())
+                    if not all_ok:
+                        # failure pass: a first-on-node failure is final
+                        # (it ran against fresh feasibility); later
+                        # same-node failures — and every speculative
+                        # failure — retry classically
+                        first = first_masks[bi]
+                        w_pod_all = w_pod.tolist()
+                        for w in np.nonzero(~ok)[0].tolist():
+                            if spec_round or not first[w]:
+                                continue
+                            pod_i, n = w_pod_all[w], w_node_l[w]
+                            item = items[pod_i]
+                            self.logger.error(
+                                f"assignment failed for {item.key} on "
+                                f"{names[n]}: stage {int(status[w])}"
+                            )
+                            results[pod_i] = BatchAssignment(
+                                item.key, None, failed=True
+                            )
+                            stats.failed += 1
+                        sel = np.nonzero(ok)[0]
+                        n_ok = len(sel)
+                        if n_ok == 0:
+                            continue
+                        widx_l = sel.tolist()
+                        pods_sel = w_pod[sel].tolist()
+                        nodes_sel = w_node[sel].tolist()
+                        types_sel = w_type[sel]
+                        cc, mm = w_c[sel], w_m[sel]
+                        pp, rows_sel = buffers[5][sel], buffers[3][sel]
+                    else:
+                        n_ok = len(w_node_l)
+                        widx_l = range(n_ok)
+                        pods_sel = w_pod.tolist()
+                        nodes_sel = w_node_l
+                        types_sel = w_type
+                        cc, mm = w_c, w_m
+                        pp, rows_sel = buffers[5], buffers[3]
+                    busy_nodes.update(nodes_sel)
+                    # the NIC pick is re-selected against live state in
+                    # the native call — decode the actual choices, one
+                    # lru hit per DISTINCT point
+                    uq, inv = _unique_rows((cc, mm, pp))
+                    mappings = [
+                        decode_mapping(G, U_, K_, c_, m_, a_)
+                        for c_, m_, a_ in uq.tolist()
+                    ]
+                    maps_sel = [mappings[i] for i in inv.ravel().tolist()]
+                    names_sel = [names[n] for n in nodes_sel]
+                    types_l = types_sel.tolist()
+                    if want_record:
+                        # record path (registration or topology fills
+                        # pending): per-pod object work by necessity
+                        for w, pod_i, nm, n, t, mp in zip(
+                            widx_l, pods_sel, names_sel, nodes_sel,
+                            types_l, maps_sel,
+                        ):
+                            item = items[pod_i]
+                            rec = fast.record_from_round(
+                                pods, w, n, t, buffers
+                            )
+                            records[pod_i] = rec
+                            results[pod_i] = BA_make((
+                                item.key, nm, mp, rec.nic_list,
+                                round_no, False,
+                            ))
+                        stats.scheduled += n_ok
+                        continue
+                    # consumed-NIC tuples, batch-built per DISTINCT
+                    # (type, per-group NIC row) key — shared immutable
+                    # TUPLES by design (the record path keeps its
+                    # per-pod list from the assignment record)
+                    rows2d = np.asarray(rows_sel).reshape(n_ok, -1)
+                    uqk, ninv = _unique_rows(
+                        (np.asarray(types_sel),)
+                        + tuple(rows2d[:, g] for g in range(rows2d.shape[1]))
+                    )
                     nic_tmpl: Dict[int, list] = {
                         t: [
                             (g, bw, d)
@@ -1737,92 +1962,26 @@ class BatchScheduler:
                             )
                             if bw > 0
                         ]
-                        for t in set(w_type_l)
+                        for t in set(uqk[:, 0].tolist())
                     }
-                    U_, K_ = cluster.U, cluster.K
-                    names = cluster.names
-                    want_record = self.register_pods
-                    memo: Dict[tuple, object] = {}
-                    ok = status >= 0
-                    applied_on_node.update(w_node_l)
-                    all_ok = bool(ok.all())
-                    if not all_ok:
-                        # failure pass: a first-on-node failure is final
-                        # (it ran against fresh feasibility); later
-                        # same-node failures — and every speculative
-                        # failure — retry classically
-                        first = first_masks[bi]
-                        for w in np.nonzero(~ok)[0].tolist():
-                            if spec_round or not first[w]:
-                                continue
-                            pod_i, n = w_pod_l[w], w_node_l[w]
-                            item = items[pod_i]
-                            self.logger.error(
-                                f"assignment failed for {item.key} on "
-                                f"{names[n]}: stage {int(status[w])}"
-                            )
-                            results[pod_i] = BatchAssignment(
-                                item.key, None, failed=True
-                            )
-                            stats.failed += 1
-                        ok_idx = np.nonzero(ok)[0].tolist()
-                        busy_nodes.update(w_node_l[w] for w in ok_idx)
-                        winner_iter = [
-                            (w, w_pod_l[w], w_node_l[w], w_type_l[w],
-                             w_c_l[w], w_m_l[w], picks_l[w], out_nic_l[w])
-                            for w in ok_idx
-                        ]
-                    else:
-                        busy_nodes.update(w_node_l)
-                        # all columns ride the zip: per-iteration list
-                        # indexing (6 subscript ops/winner) was measurable
-                        # at gang scale
-                        winner_iter = zip(
-                            range(len(w_pod_l)), w_pod_l, w_node_l,
-                            w_type_l, w_c_l, w_m_l, picks_l, out_nic_l,
-                        )
-                        ok_idx = None
-                    n_ok = len(w_pod_l) if all_ok else len(ok_idx)
-                    # BatchAssignment construction runs once per winner
-                    # (100k/round at federation scale): _make feeds
-                    # tuple.__new__ directly (the generated __new__ is a
-                    # Python frame, ~2x the cost), and the consumed-NIC
-                    # tuples are memoized per (type, per-group NIC row)
-                    # — a round draws them from a handful of distinct
-                    # combos, so the per-pod list build (formerly ~45%
-                    # of the materialize phase, r8 profile) collapses to
-                    # a dict hit. The memoized nic_list is a shared
-                    # immutable TUPLE by design; the record path keeps
-                    # its per-pod list from the assignment record.
-                    BA_make = BatchAssignment._make
-                    memo_get = memo.get
-                    nic_memo: Dict[tuple, tuple] = {}
-                    nic_memo_get = nic_memo.get
-                    for w, pod_i, n, t, c_, m_, pk, row in winner_iter:
+                    nics = [
+                        tuple((row[g], bw, d) for g, bw, d in nic_tmpl[t])
+                        for t, *row in uqk.tolist()
+                    ]
+                    nic_sel = [nics[i] for i in ninv.ravel().tolist()]
+                    for w, pod_i, nm, n, t, mp, nl in zip(
+                        widx_l, pods_sel, names_sel, nodes_sel, types_l,
+                        maps_sel, nic_sel,
+                    ):
                         item = items[pod_i]
-                        # the NIC pick is re-selected against live state
-                        # in the native call — decode the actual choice
-                        mk = (c_, m_, pk)
-                        mapping = memo_get(mk)
-                        if mapping is None:
-                            mapping = memo[mk] = decode_mapping(
-                                G, U_, K_, c_, m_, pk,
+                        if item.topology is not None:
+                            rec = fast.record_from_round(
+                                pods, w, n, t, buffers
                             )
-                        if want_record or item.topology is not None:
-                            rec = fast.record_from_round(pods, w, n, t, buffers)
                             records[pod_i] = rec
-                            nic_list = rec.nic_list
-                        else:
-                            nk = (t, *row)
-                            nic_list = nic_memo_get(nk)
-                            if nic_list is None:
-                                nic_list = nic_memo[nk] = tuple(
-                                    (row[g], bw, d)
-                                    for g, bw, d in nic_tmpl[t]
-                                )
+                            nl = rec.nic_list
                         results[pod_i] = BA_make((
-                            item.key, names[n], mapping, nic_list,
-                            round_no, False,
+                            item.key, nm, mp, nl, round_no, False,
                         ))
                     stats.scheduled += n_ok
                 stats.phase_add("materialize", time.perf_counter() - t_mat)
@@ -1945,6 +2104,12 @@ class BatchScheduler:
                 pending = pending[~np.isin(pending, newly_scheduled)]
             if not apply:
                 break  # without claims, later rounds would repeat choices
+            # universal pipelining, object-fallback leg: the claims above
+            # applied to the packed arrays (fast.assign / the row
+            # refreshes), so round r+1's solves can dispatch before this
+            # round's trailing bookkeeping
+            if pipeline_on and len(pending) and round_no + 1 < self.max_rounds:
+                _prelaunch()
 
         # fast path: one final sync of the HostNode mirror + topology fills
         if fast is not None:

@@ -598,3 +598,59 @@ def test_parse_prometheus_exposition():
     assert fams["nhd_x"] == [({}, 3.0)]
     assert fams["nhd_y"] == [({"shard": "0", "window": "5m"}, 1.5)]
     assert "nhd_bad" not in fams
+
+
+def test_quantile_from_buckets_interpolates():
+    """The histogram-edge p99 fix (r14): the scrape-side quantile is
+    linearly interpolated within the covering bucket, not the raw
+    bucket upper edge — a regression inside a bucket moves the figure,
+    and crossing an edge is continuous, not a cliff."""
+    from nhd_tpu.obs.histo import quantile_from_buckets
+
+    inf = float("inf")
+    # 100 observations, all inside (0.25, 0.5]: the old edge scrape
+    # reported 0.5 flat; interpolation places p99 near the bucket top
+    buckets = [(0.25, 0), (0.5, 100), (inf, 100)]
+    assert abs(quantile_from_buckets(buckets, 0.99) - 0.4975) < 1e-9
+    # p50 of the same data sits mid-bucket, not at the edge
+    assert abs(quantile_from_buckets(buckets, 0.5) - 0.375) < 1e-9
+    # first bucket interpolates from 0
+    assert abs(
+        quantile_from_buckets([(0.5, 10), (inf, 10)], 0.5) - 0.25
+    ) < 1e-9
+    # quantile landing in +Inf: the last finite edge (PromQL stance)
+    assert quantile_from_buckets([(0.5, 0), (inf, 10)], 0.99) == 0.5
+    # no observations
+    assert quantile_from_buckets([], 0.99) == 0.0
+    assert quantile_from_buckets([(0.5, 0), (inf, 0)], 0.99) == 0.0
+
+
+def test_fleet_bucketize_carries_interpolated_p99():
+    from nhd_tpu.obs.fleet import _bucketize
+
+    rec = _bucketize([0.3] * 99 + [0.4])
+    assert 0.25 < rec["p99_seconds"] <= 0.5
+    assert rec["p99_seconds"] != 0.5  # not the raw edge
+
+
+def test_host_phase_rollup_and_config_split():
+    """obs/perf.py r14: the attribution table rolls host phases up per
+    shape bucket, and every config record carries the solve-vs-host
+    split the acceptance metric tracks."""
+    from nhd_tpu.obs.perf import config_record, host_phase_rollup
+
+    rollup = host_phase_rollup({
+        "materialize:U2_K2_N256": 0.2,
+        "final_sync:U2_K2_N256": 0.1,
+        "encode:U2_K7_N512": 0.05,
+        "solve:U2_K2_N256": 9.9,       # not a host phase key
+    })
+    assert abs(rollup["U2_K2_N256"] - 0.3) < 1e-9
+    assert abs(rollup["U2_K7_N512"] - 0.05) < 1e-9
+
+    rec = config_record(
+        wall_seconds=1.0, placed=10, speedup=2.0,
+        phases={"solve": 0.5, "select": 0.1, "assign": 0.2,
+                "materialize": 0.05, "final_sync": 0.01},
+    )
+    assert abs(rec["host_phases_seconds"] - 0.36) < 1e-9

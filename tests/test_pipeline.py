@@ -1,0 +1,535 @@
+"""Round-pipelining determinism + overlapped-commit pipeline (r14).
+
+Three surfaces:
+
+1. **Pipeline determinism** — seeded property tests pinning bit-exact
+   placements (node, mapping, NIC list, round, failure verdict) for
+   ``NHD_PIPELINE=1`` vs ``=0`` across the classic, speculative,
+   mesh-sharded and streaming postures: prelaunching round r+1's solves
+   before round r's host phases must be a pure reordering.
+2. **Device-faults × pipelining** — the `make device-chaos` extension:
+   a fault landing mid-prelaunch (the guard's prelaunch boundary) still
+   ends in a bound set bit-identical to a fault-free NHD_PIPELINE=0 run
+   of the same seed.
+3. **Overlapped fenced commit** (scheduler/commitpipe.py,
+   NHD_ASYNC_COMMIT): binds land through the bounded in-order pipeline,
+   outcomes are processed on the single-writer thread at drain points,
+   transient failures still requeue, per-node order is preserved, and
+   the watchdog heartbeat advances per drained commit.
+"""
+
+import queue
+import threading
+import time
+
+from nhd_tpu.k8s.fake import FakeClusterBackend
+from nhd_tpu.k8s.interface import TransientBackendError
+from nhd_tpu.k8s.retry import API_COUNTERS
+from nhd_tpu.scheduler.commitpipe import CommitPipeline, CommitUnit
+from nhd_tpu.scheduler.controller import Controller
+from nhd_tpu.scheduler.core import PodStatus, Scheduler
+from nhd_tpu.scheduler.events import WatchQueue
+from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+from nhd_tpu.sim.workloads import cap_cluster, workload_mix
+from nhd_tpu.solver import BatchItem, BatchScheduler
+from nhd_tpu.solver.guard import GUARD
+
+GROUPS = ["default", "edge"]
+
+
+def _placements(results):
+    return [
+        (
+            r.key, r.node,
+            None if r.mapping is None else dict(r.mapping),
+            tuple(r.nic_list or ()), r.round_no, r.failed,
+        )
+        for r in results
+    ]
+
+
+def _schedule_once(pipeline, monkeypatch, *, posture, n_pods=96, n_nodes=12):
+    """One deterministic gang schedule under the given pipeline setting
+    and solver posture; returns the placement fingerprint."""
+    monkeypatch.setenv("NHD_PIPELINE", pipeline)
+    nodes = cap_cluster(n_nodes, GROUPS)
+    reqs = workload_mix(n_pods, GROUPS)
+    items = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
+    if posture == "classic":
+        monkeypatch.setenv("NHD_TPU_SPECULATE", "0")
+        sched = BatchScheduler(
+            respect_busy=False, register_pods=False, device_state=False,
+        )
+        results, stats = sched.schedule(nodes, items, now=0.0)
+    elif posture == "speculative":
+        monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+        sched = BatchScheduler(
+            respect_busy=False, register_pods=False, device_state=True,
+            mesh=None,
+        )
+        results, stats = sched.schedule(nodes, items, now=0.0)
+    elif posture == "mesh":
+        import jax
+
+        from nhd_tpu.parallel.sharding import make_mesh
+
+        monkeypatch.setenv("NHD_TPU_SPECULATE", "0")
+        sched = BatchScheduler(
+            respect_busy=False, register_pods=False, device_state=True,
+            mesh=make_mesh(jax.devices()[:2]),
+        )
+        results, stats = sched.schedule(nodes, items, now=0.0)
+    elif posture == "streaming":
+        from nhd_tpu.solver.streaming import StreamingScheduler
+
+        monkeypatch.setenv("NHD_TPU_SPECULATE", "0")
+        sched = StreamingScheduler(
+            tile_nodes=4, chunk_pods=48, placement="first-fit",
+            respect_busy=False, register_pods=False, persistent=True,
+        )
+        results, stats = sched.schedule(nodes, items, now=0.0)
+    else:  # pragma: no cover - test bug
+        raise AssertionError(posture)
+    assert stats.scheduled > 0  # the posture actually placed pods
+    if pipeline == "1" and posture != "streaming":
+        # the pipeline genuinely engaged (multi-round workloads only;
+        # one-round batches have nothing to prelaunch)
+        assert (
+            stats.rounds <= 1
+            or stats.counters.get("prelaunched_rounds", 0) > 0
+        )
+    return _placements(results)
+
+
+def test_pipeline_parity_classic(monkeypatch):
+    a = _schedule_once("1", monkeypatch, posture="classic")
+    b = _schedule_once("0", monkeypatch, posture="classic")
+    assert a == b
+
+
+def test_pipeline_parity_speculative(monkeypatch):
+    a = _schedule_once("1", monkeypatch, posture="speculative")
+    b = _schedule_once("0", monkeypatch, posture="speculative")
+    assert a == b
+
+
+def test_pipeline_parity_mesh(monkeypatch):
+    a = _schedule_once("1", monkeypatch, posture="mesh")
+    b = _schedule_once("0", monkeypatch, posture="mesh")
+    assert a == b
+
+
+def test_pipeline_parity_streaming(monkeypatch):
+    a = _schedule_once("1", monkeypatch, posture="streaming")
+    b = _schedule_once("0", monkeypatch, posture="streaming")
+    assert a == b
+
+
+def test_pipeline_parity_contended_seeds(monkeypatch):
+    """Property sweep: saturated clusters (contention → rejects, multi-
+    round retries) stay bit-exact across several seeds. Uses a small
+    cluster so claims genuinely conflict."""
+    import random
+
+    for seed in (1, 2, 3):
+        rng = random.Random(seed)
+        n_nodes = rng.choice((4, 6, 8))
+        n_pods = rng.choice((64, 96))
+        a = _schedule_once(
+            "1", monkeypatch, posture="classic",
+            n_pods=n_pods, n_nodes=n_nodes,
+        )
+        b = _schedule_once(
+            "0", monkeypatch, posture="classic",
+            n_pods=n_pods, n_nodes=n_nodes,
+        )
+        assert a == b, (seed, n_nodes, n_pods)
+
+
+# ---------------------------------------------------------------------------
+# device-faults × pipelining (the `make device-chaos` extension)
+# ---------------------------------------------------------------------------
+
+
+def test_device_chaos_with_pipelining_binds_identical(monkeypatch):
+    """A dispatch/upload fault landing while the pipeline has a
+    prelaunched round in flight (the guard's "faulted batch never
+    prelaunches" boundary) still ends in a bound set bit-identical to a
+    fault-free NHD_PIPELINE=0 control of the same seed."""
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import PROFILES
+
+    monkeypatch.setenv("NHD_TPU_DEVICE_STATE", "1")
+    monkeypatch.setenv("NHD_GUARD_AUDIT_INTERVAL", "1")
+    monkeypatch.setenv("NHD_GUARD_AUDIT_ROWS", "0")
+
+    seed = 1
+    GUARD.reset()
+    monkeypatch.setenv("NHD_PIPELINE", "0")
+    control = ChaosSim(seed=seed, api_faults=None)
+    control.run(steps=25)
+    control.quiesce()
+
+    GUARD.reset()
+    monkeypatch.setenv("NHD_PIPELINE", "1")
+    base_giveups = API_COUNTERS.get("guard_giveups_total")
+    sim = ChaosSim(seed=seed, api_faults=PROFILES["device-faults"])
+    sim.run(steps=25)
+    sim.quiesce()
+    assert sim.stats.violations == []
+    assert sim.stuck_pods() == []
+    assert sim.bound_set() == control.bound_set()
+    assert sim.device_audit_errors() == []
+    assert API_COUNTERS.get("guard_giveups_total") == base_giveups
+    faults = sim.fault_totals()
+    assert (
+        faults["device_dispatch_errors"]
+        + faults["device_upload_errors"]
+        + faults["device_bit_flips"]
+    ) > 0  # the storm was real, not vacuous
+
+
+# ---------------------------------------------------------------------------
+# overlapped fenced commit (scheduler/commitpipe.py, NHD_ASYNC_COMMIT)
+# ---------------------------------------------------------------------------
+
+
+def _stack(n_nodes=2):
+    backend = FakeClusterBackend()
+    for i in range(n_nodes):
+        spec = SynthNodeSpec(name=f"node{i}")
+        backend.add_node(
+            spec.name, make_node_labels(spec), hugepages_gb=spec.hugepages_gb
+        )
+    sched = Scheduler(backend, WatchQueue(), queue.Queue(), respect_busy=False)
+    ctrl = Controller(backend, sched.nqueue)
+    sched.build_initial_node_list()
+    return backend, sched, ctrl
+
+
+def _drive(sched, ctrl, rounds=8):
+    for _ in range(rounds):
+        ctrl.run_once(now=0.0)
+        while not sched.nqueue.empty():
+            sched.run_once()
+        sched._drain_commits(block=True)
+
+
+def test_async_commit_defaults():
+    """Off on the fake backend, on for kube (env overrides both)."""
+    backend, sched, _ = _stack()
+    assert sched._async_commit is False
+    from nhd_tpu.k8s.interface import ClusterBackend
+    from nhd_tpu.k8s.kube import KubeClusterBackend
+
+    assert ClusterBackend.ASYNC_COMMIT_DEFAULT is False
+    assert KubeClusterBackend.ASYNC_COMMIT_DEFAULT is True
+
+
+def test_async_commit_binds_through_pipeline(monkeypatch):
+    monkeypatch.setenv("NHD_ASYNC_COMMIT", "1")
+    backend, sched, ctrl = _stack()
+    assert sched._async_commit is True
+    for i in range(5):
+        backend.create_pod(f"p{i}", cfg_text=make_triad_config())
+    _drive(sched, ctrl)
+    for i in range(5):
+        assert backend.pods[("default", f"p{i}")].node is not None, i
+        assert (
+            sched.pod_state[("default", f"p{i}")]["state"]
+            is PodStatus.SCHEDULED
+        )
+    assert sched.perf["scheduled_total"] == 5
+    # the pipeline (not the sync path) carried the commits
+    assert sched._commitpipe is not None
+    assert sched._commitpipe.inflight_keys() == set()
+
+
+def test_async_commit_transient_failure_requeues(monkeypatch):
+    """A transient commit fault drained from the pipeline unwinds and
+    requeues through the PR 2 path, then lands on the retry."""
+    from tests.test_faults import FaultProfile, FaultyBackend
+
+    monkeypatch.setenv("NHD_ASYNC_COMMIT", "1")
+    backend, sched, ctrl = _stack()
+    faulty = FaultyBackend(
+        backend, FaultProfile(name="t", transient_bind=1.0)
+    )
+    sched.backend = faulty
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    _drive(sched, ctrl)
+    pod = backend.pods[("default", "p1")]
+    assert pod.node is not None
+    assert faulty.fault_stats["transient_binds"] == 1
+    assert sched.failed_schedule_count == 0
+    assert sched.pod_state[("default", "p1")]["state"] is PodStatus.SCHEDULED
+    assert sched._requeue_attempts == {}
+
+
+def test_async_commit_preserves_order(monkeypatch):
+    """Strict FIFO: binds reach the backend in submission order even
+    across batches — per-node commit order is a sub-order of it."""
+    monkeypatch.setenv("NHD_ASYNC_COMMIT", "1")
+    backend, sched, ctrl = _stack()
+    order = []
+    real_bind = backend.bind_pod_to_node
+
+    def spy_bind(pod, node, ns):
+        order.append(pod)
+        return real_bind(pod, node, ns)
+
+    backend.bind_pod_to_node = spy_bind
+    for i in range(6):
+        backend.create_pod(f"p{i}", cfg_text=make_triad_config())
+        ctrl.run_once(now=0.0)
+        while not sched.nqueue.empty():
+            sched.run_once()
+    sched._drain_commits(block=True)
+    bound = [p for p in order]
+    assert bound == sorted(bound, key=lambda p: int(p[1:]))
+
+
+def test_commit_pipeline_bounded_and_in_order():
+    """Unit level: depth bounds in-flight work (submit backpressures),
+    results drain in submission order, and the heartbeat ticks per
+    drained commit."""
+    beats = []
+    pipe = CommitPipeline(depth=2, heartbeat=lambda: beats.append(1))
+    gate = threading.Event()
+    ran = []
+
+    def work(i):
+        def run():
+            gate.wait(5.0)
+            ran.append(i)
+            return ("ok", i)
+        return run
+
+    try:
+        pipe.submit([CommitUnit(("ns", "a"), work(0), 0)])
+        pipe.submit([CommitUnit(("ns", "b"), work(1), 1)])
+        assert pipe.inflight_keys() == {("ns", "a"), ("ns", "b")}
+        # third submit must block until the worker frees a slot
+        blocked = threading.Event()
+
+        def late_submit():
+            pipe.submit([CommitUnit(("ns", "c"), work(2), 2)])
+            blocked.set()
+
+        t = threading.Thread(target=late_submit, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not blocked.is_set()  # backpressure while full
+        gate.set()
+        t.join(5.0)
+        pairs = pipe.drain_all()
+        assert ran == [0, 1, 2]
+        assert [u.ctx for u, _ in pairs] == [0, 1, 2]
+        assert [r for _, r in pairs] == [("ok", 0), ("ok", 1), ("ok", 2)]
+        assert len(beats) == 3
+        assert pipe.inflight_keys() == set()
+    finally:
+        gate.set()
+        pipe.stop()
+
+
+def test_commit_pipeline_surfaces_closure_raise():
+    """A raising closure (contract violation) must not hang drain_all:
+    the exception becomes the unit's result."""
+    pipe = CommitPipeline(depth=4)
+    try:
+        pipe.submit([CommitUnit(
+            ("ns", "x"), lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+            None,
+        )])
+        pairs = pipe.drain_all()
+        assert len(pairs) == 1
+        assert isinstance(pairs[0][1], RuntimeError)
+    finally:
+        pipe.stop()
+
+
+def test_async_commit_delete_event_barriers(monkeypatch):
+    """A delete watch event for a pod whose commit is in flight drains
+    the pipeline first — the outcome lands before the release runs (the
+    single-writer race the barrier exists for)."""
+    monkeypatch.setenv("NHD_ASYNC_COMMIT", "1")
+    backend, sched, ctrl = _stack()
+    slow = threading.Event()
+    real_bind = backend.bind_pod_to_node
+
+    def slow_bind(pod, node, ns):
+        slow.wait(5.0)
+        return real_bind(pod, node, ns)
+
+    backend.bind_pod_to_node = slow_bind
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    ctrl.run_once(now=0.0)
+    while not sched.nqueue.empty():
+        sched.run_once()
+    assert ("default", "p1") in sched._commitpipe.inflight_keys()
+    # the delete event arrives while the bind is still in flight
+    backend.bind_pod_to_node = real_bind
+    backend.delete_pod("p1", emit_watch=True)
+    ctrl.run_once(now=0.0)   # forward the delete watch event
+    threading.Timer(0.05, slow.set).start()
+    while not sched.nqueue.empty():
+        sched.run_once()   # handles the delete AFTER draining the bind
+    assert sched._commitpipe.inflight_keys() == set()
+    # the bind outcome was processed (pod reached SCHEDULED or was
+    # released by the delete); either way no claim leaks on the mirror
+    assert ("default", "p1") not in sched.pod_state or (
+        sched.pod_state[("default", "p1")]["state"] is not None
+    )
+
+
+def test_bench_diff_gates_host_phases():
+    """tools/bench_diff.py: the r14 host phases gate with the same
+    relative-threshold + 30 ms absolute-floor stance as solve."""
+    import sys
+    sys.path.insert(0, "tools")
+    from tools.bench_diff import PHASE_FLOOR, WATCHED_PHASES, diff_artifacts
+
+    for phase in ("select", "assign", "materialize", "final_sync"):
+        assert phase in WATCHED_PHASES
+    assert PHASE_FLOOR == 0.03
+
+    def art(assign):
+        return {
+            "git_rev": "x",
+            "payload": {
+                "configs": {
+                    "cfg2": {
+                        "wall_seconds": 1.0, "placed": 10,
+                        "phases": {"solve": 0.1, "assign": assign},
+                    },
+                },
+                "headline": {},
+            },
+        }
+
+    # +50% AND +50ms: fatal
+    _, regressions = diff_artifacts(
+        art(0.10), art(0.15), threshold=0.10, floor=0.005,
+    )
+    assert any("assign" in r for r in regressions)
+    # +50% but only +5ms growth: under the 30 ms absolute floor — noise
+    _, regressions = diff_artifacts(
+        art(0.010), art(0.015), threshold=0.10, floor=0.005,
+    )
+    assert regressions == []
+
+
+def test_drain_all_timeout_is_a_deadline():
+    """drain_all's timeout bounds the WHOLE wait: a worker that keeps
+    completing (and notifying) must not restart the budget, and 0 is a
+    genuinely non-blocking probe."""
+    pipe = CommitPipeline(depth=8)
+    gate = threading.Event()
+    try:
+        pipe.submit([CommitUnit(("ns", "slow"), lambda: gate.wait(10.0), 0)])
+        t0 = time.monotonic()
+        out = pipe.drain_all(timeout=0)      # non-blocking probe
+        assert time.monotonic() - t0 < 1.0
+        assert out == []
+        t0 = time.monotonic()
+        out = pipe.drain_all(timeout=0.2)    # bounded barrier
+        dt = time.monotonic() - t0
+        assert 0.1 < dt < 2.0
+        assert out == []
+    finally:
+        gate.set()
+        pipe.stop()
+
+
+def test_async_commit_yields_to_commit_workers(monkeypatch):
+    """An explicit NHD_COMMIT_WORKERS>1 wins over the async default:
+    the thread-pool path keeps intra-batch commit parallelism."""
+    import nhd_tpu.scheduler.core as core_mod
+
+    monkeypatch.setenv("NHD_ASYNC_COMMIT", "1")
+    monkeypatch.setattr(core_mod, "COMMIT_WORKERS", 4)
+    backend, sched, ctrl = _stack()
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    ctrl.run_once(now=0.0)
+    while not sched.nqueue.empty():
+        sched.run_once()
+    # the sync/pool path committed before returning: no pipeline built
+    assert sched._commitpipe is None
+    assert backend.pods[("default", "p1")].node is not None
+
+
+def test_async_commit_node_remove_barriers_and_requeues(monkeypatch):
+    """A NODE_REMOVE racing an in-flight commit: the watch handler
+    barriers first, and a commit whose target node is ALREADY gone maps
+    to a transient requeue (fresh solve against the current mirror),
+    never a worker-thread KeyError."""
+    from nhd_tpu.scheduler.core import CommitOutcome
+
+    monkeypatch.setenv("NHD_ASYNC_COMMIT", "1")
+    backend, sched, ctrl = _stack()
+    backend.create_pod("p1", cfg_text=make_triad_config())
+    _drive(sched, ctrl)
+    bound_node = backend.pods[("default", "p1")].node
+    assert bound_node is not None
+    # direct contract check: a commit draining after its node left the
+    # mirror is RETRY, not a raise
+    item_key_node = sched.nodes.pop(bound_node)
+    try:
+        class R:
+            node = bound_node
+            nic_list = ()
+
+        item = BatchItem(("default", "p1"), None)
+        outcome = sched._commit_pod_calls(None, item, R())
+        assert outcome is CommitOutcome.RETRY
+    finally:
+        sched.nodes[bound_node] = item_key_node
+
+
+def test_unique_rows_handles_negative_sentinels():
+    """The packed-key uniquing behind the batch-decoded materialize must
+    stay injective with the native core's -1 no-NIC sentinel in a
+    column (a collision hands a pod another row's consumed-NIC tuple)
+    — each column shifts by its own minimum before packing."""
+    import numpy as np
+
+    from nhd_tpu.solver.batch import _unique_rows
+
+    cols = (np.array([0, 0]), np.array([2, 1]), np.array([-1, 3]))
+    rows, inv = _unique_rows(cols)
+    assert len(rows) == 2
+    assert rows[np.asarray(inv).ravel()[0]].tolist() == [0, 2, -1]
+    assert rows[np.asarray(inv).ravel()[1]].tolist() == [0, 1, 3]
+    # ground truth across shapes, sentinels included
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        mat = rng.integers(
+            -2, 9, size=(int(rng.integers(1, 40)), int(rng.integers(1, 5)))
+        ).astype(np.int64)
+        got_rows, got_inv = _unique_rows(
+            tuple(mat[:, j] for j in range(mat.shape[1]))
+        )
+        want_rows, want_inv = np.unique(mat, axis=0, return_inverse=True)
+        assert np.array_equal(got_rows, want_rows)
+        assert np.array_equal(
+            np.asarray(got_inv).ravel(), np.asarray(want_inv).ravel()
+        )
+
+
+def test_async_commit_env_words(monkeypatch):
+    """NHD_ASYNC_COMMIT parses the same word sets as NHD_PIPELINE
+    ('true'/'on' enable — they must never silently disable), and a
+    typo fails loud at construction."""
+    import pytest
+
+    for word, want in (
+        ("true", True), ("on", True), ("1", True),
+        ("false", False), ("off", False), ("0", False), ("auto", False),
+    ):
+        monkeypatch.setenv("NHD_ASYNC_COMMIT", word)
+        _backend, sched, _ctrl = _stack()
+        assert sched._async_commit is want, word
+    monkeypatch.setenv("NHD_ASYNC_COMMIT", "yes-please")
+    with pytest.raises(ValueError):
+        _stack()

@@ -38,8 +38,16 @@ from nhd_tpu.obs.perf import load_bench_artifact  # noqa: E402
 #: catches regressions that hide between phases; prewarm and
 #: first_bind_prewarmed are the zero-cold-start serving promise —
 #: present only in the synthetic "first-bind" config, absent phases are
-#: simply skipped elsewhere)
-WATCHED_PHASES = ("solve", "prewarm", "first_bind_prewarmed")
+#: simply skipped elsewhere). The HOST round-loop phases — select /
+#: assign / materialize / final_sync, the figures the r14 vectorize+
+#: pipeline work drove down — gate under the same relative-threshold +
+#: PHASE_FLOOR absolute-floor stance as solve, so a host-side
+#: regression fails the smoke gate instead of hiding behind a flat
+#: solve number.
+WATCHED_PHASES = (
+    "solve", "prewarm", "first_bind_prewarmed",
+    "select", "assign", "materialize", "final_sync",
+)
 
 #: configs whose figures are subprocess LATENCY measurements, not solver
 #: throughput: their cold wall is dominated by trace/compile jitter, so
