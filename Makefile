@@ -16,8 +16,10 @@ FED_SHARDS ?= 3
 FED_REPLICAS ?= 3
 DEV_SEEDS ?= 3
 DEV_STEPS ?= 40
+POLICY_SEEDS ?= 3
+POLICY_STEPS ?= 40
 
-.PHONY: test lint sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos trace-demo fleet-demo docker docker-smoke release
+.PHONY: test lint sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos policy-chaos trace-demo fleet-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -59,7 +61,8 @@ lint:
 sanitize:
 	NHD_SAN=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
 		tests/test_streaming.py tests/test_faults.py tests/test_ha.py \
-		tests/test_fleet.py tests/test_guard.py tests/test_pipeline.py -q
+		tests/test_fleet.py tests/test_guard.py tests/test_pipeline.py \
+		tests/test_policy.py -q
 
 # full release gate: lint + suite + the seconds-scale bench-smoke leg
 # (writes a perf artifact and diffs it against the newest prior one, so
@@ -70,6 +73,7 @@ check: lint test
 	$(MAKE) bench-smoke
 	$(MAKE) fleet-demo
 	$(MAKE) device-chaos
+	$(MAKE) policy-chaos
 
 # Regenerate protobuf message bindings. Service stubs are hand-written in
 # nhd_tpu/rpc/server.py (no grpc_python_plugin needed).
@@ -170,6 +174,19 @@ device-chaos:
 	NHD_PIPELINE=1 python tools/chaos_storm.py --profiles device-faults --device-plane \
 		--bind-parity --seeds $(DEV_SEEDS) --steps $(DEV_STEPS) \
 		--json-out artifacts/chaos/device_chaos.json
+
+# scheduling-policy matrix: the policy engine's scenario sweep
+# (mixed-generation fleet, tenant quota storm, maintenance waves —
+# sim/chaos.py POLICY_PROFILES), seeds x profiles. Every cell runs a
+# NHD_POLICY=0 CONTROL of the same storm first (must behave exactly
+# like the pre-policy scheduler: zero evictions), then the NHD_POLICY=1
+# run under the preemption-bound / no-cascade / tier-inversion /
+# victim-rebind invariants (docs/SCHEDULING_POLICIES.md; CI runs the
+# fast cell in tests/test_policy.py).
+policy-chaos:
+	python tools/chaos_storm.py --policy \
+		--seeds $(POLICY_SEEDS) --steps $(POLICY_STEPS) \
+		--json-out artifacts/chaos/policy_chaos.json
 
 # flight-recorder demo: run the sim with tracing on, dump the Chrome
 # trace, validate its schema + per-pod span pipeline (docs/OBSERVABILITY.md)

@@ -495,6 +495,133 @@ def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40,
     }
 
 
+def _hetero_preempt_cell() -> int:
+    """Tiered-preemption micro-cell for the hetero leg: saturate a tiny
+    fake cluster with tier-0 pods, submit tier-2 pods, count the fenced
+    evictions the policy engine executes. Returns the eviction count
+    (bench artifact: hetero.preemptions — a zero means the preemption
+    path went dead)."""
+    from nhd_tpu.sim.synth import make_triad_config
+
+    backend, sched = make_fake_sched(2, "pre", hugepages_gb=8)
+    cfg = make_triad_config(cpu_workers=2, hugepages_gb=4)
+    low = []
+    for i in range(5):
+        p = backend.create_pod(f"low{i}", cfg_text=cfg, tier=0)
+        low.append((p.name, p.namespace, p.uid))
+    sched.attempt_scheduling_batch(low)
+    high = []
+    for i in range(2):
+        p = backend.create_pod(f"high{i}", cfg_text=cfg, tier=2)
+        high.append((p.name, p.namespace, p.uid))
+    sched.attempt_scheduling_batch(high)
+    for _ in range(16):
+        if sched.nqueue.empty():
+            break
+        sched.run_once()
+    return len(backend.evict_log)
+
+
+def bench_hetero(smoke: bool) -> dict:
+    """cfg8-hetero / policy-smoke (ISSUE 15): heterogeneity-aware
+    scoring on a mixed node-class fleet, measured as AGGREGATE PLACED
+    THROUGHPUT — the sum over placed pods of the matrix throughput of
+    (workload kind, landing node's class) — for the uniform (policy-off)
+    run vs the matrix-scored run of the same fleet and workload, plus
+    the tiered-preemption eviction count from a saturated micro-cell.
+
+    The SLOW generation sits first in dict order, so the uniform
+    ranking's low-node-index tiebreak prefers it: any improvement the
+    policy run shows is the score term reordering placements, not
+    iteration-order luck. The acceptance bar (gated by bench_diff):
+    the matrix run strictly improves aggregate throughput."""
+    from nhd_tpu.policy import scoring
+    from nhd_tpu.policy.scoring import workload_kind
+    from nhd_tpu.sim.synth import SynthNodeSpec, make_node
+    from nhd_tpu.sim.workloads import workload_mix
+
+    n_nodes = 32 if smoke else 256
+    # under capacity on the fast half alone, so placement CHOICE (not
+    # feasibility) decides the figure
+    n_pods = 96 if smoke else 1536
+    matrix = {
+        "gpu": {"gen-a": 1.0, "gen-b": 0.5},
+        "cpu": {"gen-a": 1.0, "gen-b": 0.5},
+    }
+    half = n_nodes // 2
+
+    def fleet():
+        base = SynthNodeSpec(
+            phys_cores=64, gpus_per_numa=4, nics_per_numa=7,
+            hugepages_gb=256,
+        )
+        nodes = {}
+        for i in range(n_nodes):
+            s = SynthNodeSpec(**{
+                **base.__dict__, "name": f"het{i:05d}",
+                "node_class": "gen-b" if i < half else "gen-a",
+            })
+            nodes[s.name] = make_node(s)
+        return nodes
+
+    reqs = workload_mix(n_pods, ["default"])
+
+    def agg_tput(results):
+        tot = 0.0
+        for r, req in zip(results, reqs):
+            if r.node:
+                cls = "gen-b" if int(r.node[3:]) < half else "gen-a"
+                tot += matrix[workload_kind(req)][cls]
+        return tot
+
+    prior_policy = os.environ.get("NHD_POLICY")
+    try:
+        os.environ["NHD_POLICY"] = "0"
+        scoring.set_matrix(None)
+        wall_u, placed_u, _stats_u, res_u = run_batch(fleet(), reqs)
+        tput_u = agg_tput(res_u)
+
+        os.environ["NHD_POLICY"] = "1"
+        scoring.set_matrix(matrix)
+        wall_p, placed_p, stats_p, res_p = run_batch(fleet(), reqs)
+        tput_p = agg_tput(res_p)
+        preemptions = _hetero_preempt_cell()
+    finally:
+        scoring.set_matrix(None)
+        if prior_policy is None:
+            os.environ.pop("NHD_POLICY", None)
+        else:
+            os.environ["NHD_POLICY"] = prior_policy
+
+    improvement = (tput_p / tput_u - 1.0) if tput_u > 0 else 0.0
+    name = "policy-smoke" if smoke else "cfg8:hetero"
+    _log(
+        f"bench[{name}]: {n_pods} pods x {n_nodes} mixed-class nodes -> "
+        f"placed tput uniform {tput_u:.1f} (placed {placed_u}, "
+        f"{wall_u:.3f}s) vs policy {tput_p:.1f} (placed {placed_p}, "
+        f"{wall_p:.3f}s): {improvement:+.1%}; "
+        f"preempt cell evictions {preemptions}"
+    )
+    return {
+        "wall": wall_p, "placed": placed_p, "speedup": 0.0,
+        "rounds": stats_p.rounds,
+        "phases": {
+            "solve": stats_p.solve_seconds,
+            "select": stats_p.select_seconds,
+            "assign": stats_p.assign_seconds,
+        },
+        "p99_bind_ms": stats_p.bind_latency_percentile(res_p, 99) * 1e3,
+        "hetero": {
+            "placed_tput_uniform": round(tput_u, 2),
+            "placed_tput_policy": round(tput_p, 2),
+            "improvement_pct": round(improvement * 100.0, 2),
+            "placed_uniform": placed_u,
+            "placed_policy": placed_p,
+            "preemptions": preemptions,
+        },
+    }
+
+
 def make_fake_sched(n_nodes: int, prefix: str, hugepages_gb: int = None):
     """Fake backend + initialized Scheduler — shared bench scaffolding."""
     import queue as queue_mod
@@ -897,6 +1024,11 @@ def main() -> None:
         if not os.environ.get("NHD_BENCH_SKIP_SPMD"):
             name, rec = bench_spmd(platform, smoke=True)
             configs[name] = rec
+        # seconds-scale policy smoke (ISSUE 15): heterogeneity scoring
+        # must strictly improve aggregate placed throughput on a mixed
+        # fleet, and the preemption micro-cell must evict — both gated
+        # by tools/bench_diff.py's hetero gates on every `make check`
+        configs["policy-smoke"] = bench_hetero(smoke=True)
 
     if not smoke:
         # cfg3: NIC-saturated contention shape (places ~4k of 10k — the
@@ -955,6 +1087,11 @@ def main() -> None:
             except Exception as exc:
                 _log(f"bench[cfg6-spmd]: probe failed (leg skipped): {exc}")
 
+        # cfg8: the heterogeneity-policy leg (ISSUE 15) — mixed
+        # node-class fleet at bench scale, tiered preemption counts;
+        # aggregate placed throughput gated by bench_diff's hetero gates
+        configs["cfg8:hetero"] = bench_hetero(smoke=False)
+
     headline = {
         # the smoke leg's headline is cfg2 under its own metric name, so
         # bench_diff never compares a smoke headline against a full one
@@ -983,9 +1120,10 @@ def main() -> None:
                     wall_seconds=r["wall"], placed=r["placed"],
                     speedup=r["speedup"], rounds=r["rounds"],
                     phases=r["phases"], p99_bind_ms=r["p99_bind_ms"],
-                    extra=(
-                        {"churn": r["churn"]} if "churn" in r else None
-                    ),
+                    extra={
+                        k: r[k]
+                        for k in ("churn", "hetero", "spmd") if k in r
+                    } or None,
                 )
                 for name, r in configs.items()
             },

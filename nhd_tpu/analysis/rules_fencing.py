@@ -36,6 +36,9 @@ from nhd_tpu.analysis.core import Finding, _dotted
 _SCOPE_PARTS = ("scheduler",)
 
 #: the commit-path mutators that MUST carry a fencing epoch
+#: (``evict_pod`` is the policy engine's preemption eviction — an
+#: unfenced eviction is the preemption analog of the double-bind hole:
+#: a deposed leader could evict a victim the new leader just placed)
 FENCED_MUTATORS = frozenset({
     "bind_pod_to_node",
     "annotate_pod_config",
@@ -43,6 +46,7 @@ FENCED_MUTATORS = frozenset({
     "add_nad_to_pod",
     "annotate_pod_meta",
     "claim_spillover_pod",
+    "evict_pod",
 })
 
 #: the controller's cluster mutators (TriadSet reconciliation) — gated

@@ -40,6 +40,10 @@ MIN_BUSY_SECS = float(_os.environ.get("NHD_MIN_BUSY_SECS", "30"))
 
 MAINTENANCE_LABEL = "sigproc.viasat.io/maintenance"
 
+# hardware-generation class label (heterogeneity-aware scoring,
+# nhd_tpu/policy/): explicit operator override of the derived class
+NODE_CLASS_LABEL = "NHD_NODE_CLASS"
+
 _CPU_CORES_LABEL = "feature.node.kubernetes.io/nfd-extras-cpu.num_cores"
 _CPU_SOCKETS_LABEL = "feature.node.kubernetes.io/nfd-extras-cpu.numSockets"
 _CPU_SMT_LABEL = "feature.node.kubernetes.io/cpu-hardware_multithreading"
@@ -256,6 +260,11 @@ class HostNode:
         self.addr = ""
         self.maintenance = False
         self.groups: List[str] = ["default"]
+        # hardware-generation class (policy/classes.py): set at label
+        # parse — explicit NHD_NODE_CLASS label, else GPU-model-derived,
+        # else "cpu". Scored by the heterogeneity-aware policy terms;
+        # "default" scores as the uniform baseline.
+        self.node_class = "default"
         self.cores: List[NodeCpuCore] = []
         self.gpus: List[NodeGpu] = []
         self.nics: List[NodeNic] = []
@@ -398,8 +407,23 @@ class HostNode:
             and self._init_misc(labels)
         )
         if ok:
+            self._init_node_class(labels)
             self._pack_state()
         return ok
+
+    def _init_node_class(self, labels: Dict[str, str]) -> None:
+        """Hardware-generation class for heterogeneity-aware scoring
+        (policy/classes.py): the explicit NHD_NODE_CLASS label wins;
+        otherwise derive from the GPU model inventory (the axis
+        generations actually differ on), else "cpu". Runs after
+        _init_gpus so the derivation sees the parsed inventory."""
+        explicit = labels.get(NODE_CLASS_LABEL)
+        if explicit:
+            self.node_class = explicit
+        elif self.gpus:
+            self.node_class = f"gpu-{self.gpus[0].kind.name.lower()}"
+        else:
+            self.node_class = "cpu"
 
     def _init_groups(self, labels: Dict[str, str]) -> bool:
         """NHD_GROUP label: dot-separated group list (reference: Node.py:312-321)."""

@@ -122,6 +122,12 @@ class PodRequest:
     hugepages_gb: int
     map_mode: MapMode
     node_groups: FrozenSet[str] = frozenset({"default"})
+    # scheduling priority tier (policy engine, nhd_tpu/policy/): 0 =
+    # best-effort; higher tiers may trigger bounded preemption of
+    # strictly lower tiers when unplaceable. Part of the dedupe key by
+    # construction (mechanical field tuple), so mixed-tier gangs split
+    # into per-tier solver rows.
+    tier: int = 0
 
     _key = _field_key
     __hash__ = _cached_hash
@@ -176,7 +182,9 @@ class PodRequest:
 
     @staticmethod
     def from_topology(
-        top: PodTopology, node_groups: FrozenSet[str] = frozenset({"default"})
+        top: PodTopology,
+        node_groups: FrozenSet[str] = frozenset({"default"}),
+        tier: int = 0,
     ) -> "PodRequest":
         groups = tuple(
             GroupRequest(
@@ -194,4 +202,5 @@ class PodRequest:
             hugepages_gb=top.hugepages_gb,
             map_mode=top.map_mode,
             node_groups=node_groups,
+            tier=tier,
         ).interned()

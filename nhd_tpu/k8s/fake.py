@@ -23,6 +23,7 @@ from nhd_tpu.k8s.interface import (
     NAD_ANNOTATION,
     SCHEDULER_TAINT,
     SPILLOVER_ANNOTATION,
+    TIER_ANNOTATION,
     ClusterBackend,
     LeaseView,
     PodEvent,
@@ -107,6 +108,12 @@ class FakeClusterBackend(ClusterBackend):
         self.bind_log: List[
             Tuple[str, str, str, str, Optional[int], Optional[str]]
         ] = []
+        # every SUCCESSFUL preemption eviction: (ns, pod, uid, node,
+        # epoch, lease) — the policy-chaos harness's preemption-bound /
+        # no-cascade invariants read this (sim/chaos.py)
+        self.evict_log: List[
+            Tuple[str, str, str, str, Optional[int], Optional[str]]
+        ] = []
 
     # ------------------------------------------------------------------
     # simulation controls (test-facing, not part of ClusterBackend)
@@ -164,6 +171,7 @@ class FakeClusterBackend(ClusterBackend):
         resources: Optional[Dict[str, str]] = None,
         scheduler_name: str = "nhd-scheduler",
         emit_watch: bool = True,
+        tier: int = 0,
     ) -> FakePod:
         """Create a Pending pod with its ConfigMap, like a TriadSet would."""
         with self._lock:
@@ -175,6 +183,8 @@ class FakeClusterBackend(ClusterBackend):
             pod.annotations[CFG_TYPE_ANNOTATION] = cfg_type
             if groups:
                 pod.annotations[GROUPS_ANNOTATION] = groups
+            if tier:
+                pod.annotations[TIER_ANNOTATION] = str(int(tier))
             if cfg_text is not None:
                 cm = f"{name}-cfg"
                 self.configmaps[(ns, cm)] = cfg_text
@@ -459,6 +469,31 @@ class FakeClusterBackend(ClusterBackend):
                 (fence_lease or self.fence_lease_name)
                 if epoch is not None else None,
             ))
+            return True
+
+    def evict_pod(
+        self, pod: str, ns: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        """Preemption eviction: unbind the pod back to Pending. The
+        solved-config annotations (and the ConfigMap) survive so the
+        scheduler's unwind/release path works from them, and the pod
+        keeps its uid — an evicted pod is the SAME incarnation requeued,
+        which is what lets the flight recorder show one preempt→rebind
+        journey per victim. Fenced exactly like bind (a deposed leader's
+        in-flight preemption must not land)."""
+        with self._lock:
+            self._check_fence(epoch, fence_lease)
+            p = self._pod(pod, ns)
+            if p is None or p.node is None:
+                return False
+            self.evict_log.append((
+                ns, pod, p.uid, p.node, epoch,
+                (fence_lease or self.fence_lease_name)
+                if epoch is not None else None,
+            ))
+            p.node = None
+            p.phase = "Pending"
             return True
 
     def generate_pod_event(self, pod, ns, reason, event_type, message) -> None:

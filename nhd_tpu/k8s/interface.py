@@ -47,6 +47,12 @@ LEASE_NAME = "nhd-scheduler-leader"
 #: the first-spill stamp ("since", for the orphan-age metrics)
 SPILLOVER_ANNOTATION = f"{DOMAIN}/nhd_spillover"
 
+#: scheduling priority tier (policy engine, nhd_tpu/policy/ +
+#: docs/SCHEDULING_POLICIES.md): integer annotation, 0/absent =
+#: best-effort; higher tiers may trigger bounded preemption of strictly
+#: lower tiers when unplaceable
+TIER_ANNOTATION = f"{DOMAIN}/nhd_tier"
+
 #: cross-replica trace context (docs/OBSERVABILITY.md "Federation"): one
 #: JSON annotation stamped at a pod's FIRST receipt by any replica —
 #: the correlation ID, the origin replica, and the receipt wall stamp.
@@ -370,6 +376,30 @@ class ClusterBackend(ABC):
         epoch: Optional[int] = None, fence_lease: Optional[str] = None,
     ) -> bool:
         """THE schedule commit point — V1Binding (K8SMgr.py:468-492)."""
+
+    def evict_pod(
+        self, pod: str, ns: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        """Preemption eviction (policy engine, nhd_tpu/policy/preempt):
+        unbind the pod so it returns to Pending and can requeue — the
+        solved-config annotations survive so the scheduler's unwind path
+        can release the victim's claims exactly like a transient-commit
+        unwind. Fenced like every other commit-path mutator (nhdlint
+        NHD501: callable only through Scheduler._commit_write). Default:
+        unsupported — a backend that can't evict disables preemption
+        rather than faking it."""
+        return False
+
+    def get_pod_tier(self, pod: str, ns: str) -> int:
+        """The pod's scheduling priority tier (TIER_ANNOTATION; 0 =
+        best-effort / absent / unparseable — a malformed tier must never
+        unschedule a pod, only deprioritize it)."""
+        try:
+            annots = self.get_pod_annotations(pod, ns)
+            return max(0, int((annots or {}).get(TIER_ANNOTATION, "0")))
+        except (TransientBackendError, ValueError, TypeError):
+            return 0
 
     @abstractmethod
     def generate_pod_event(

@@ -557,6 +557,45 @@ class KubeClusterBackend(ClusterBackend):
             return False
         return True
 
+    def evict_pod(
+        self, pod: str, ns: str, *,
+        epoch: Optional[int] = None, fence_lease: Optional[str] = None,
+    ) -> bool:
+        """Preemption eviction via the Eviction subresource (the API
+        server honors PodDisruptionBudgets, which is exactly the extra
+        guard an operator wants under policy preemption). Fenced like
+        bind; a transient server fault surfaces as
+        TransientBackendError so the scheduler's preemption attempt
+        aborts cleanly (unevicted victims keep their bindings, the
+        preemptor requeues).
+
+        Semantics note (docs/SCHEDULING_POLICIES.md): Kubernetes has no
+        unbind — Eviction DELETES the pod, and its owning controller
+        (TriadSet) recreates it as a NEW incarnation with a fresh uid.
+        The scheduler's same-incarnation victim requeue is therefore a
+        best-effort fast path here: the deleted pod fails its
+        pod_exists gate at re-admission and the replacement schedules
+        through the normal create path instead. The fake backend's
+        unbind-to-Pending (same uid, one corr journey) is the
+        SIMULATION model the chaos invariants run against."""
+        self._check_fence(epoch, fence_lease)
+        client = self._client
+        body = client.V1Eviction(
+            metadata=client.V1ObjectMeta(name=pod, namespace=ns),
+        )
+        try:
+            self.v1.create_namespaced_pod_eviction(pod, ns, body)
+        except ValueError:
+            pass  # empty-body client quirk, same as bind: evict succeeded
+        except client.exceptions.ApiException as exc:
+            if retryable(exc):
+                raise TransientBackendError(
+                    f"evict of {ns}/{pod} failed transiently: {exc}"
+                ) from exc
+            self.logger.error(f"evict failed for {ns}/{pod}: {exc}")
+            return False
+        return True
+
     def generate_pod_event(self, pod, ns, reason, event_type, message) -> None:
         """'NHD:'-prefixed V1Event on the pod (K8SMgr.py:518-559)."""
         import datetime

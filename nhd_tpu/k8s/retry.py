@@ -170,6 +170,20 @@ class ApiCounters:
         "guard_quarantined_shapes":
             ("gauge", "Shape keys quarantined for repeated program "
                       "faults (AOT artifact retired, live re-trace)"),
+        # scheduling-policy engine (nhd_tpu/policy/,
+        # docs/SCHEDULING_POLICIES.md): heterogeneity scoring posture +
+        # the bounded-preemption ledger. The labeled complement
+        # nhd_policy_preemptions_total{tier=...} is rendered from
+        # policy.preempt_tier_snapshot() in rpc/metrics.py (tier labels
+        # clamp to a bounded vocabulary, NHD603 stance).
+        "policy_preemptions_total":
+            ("counter", "Pods evicted by bounded policy preemption"),
+        "policy_preempt_budget_exhausted_total":
+            ("counter", "Preemption plans refused by the round/tenant "
+                        "budgets"),
+        "policy_score_mode":
+            ("gauge", "Heterogeneity scoring mode (0 off, 1 uniform, "
+                      "2 matrix)"),
         # AOT export worker (solver/aot.py): background-thread failures
         # were invisible before this counter
         "aot_export_failures_total":

@@ -325,6 +325,8 @@ def build_fleet_payload(
             "guard_audits_total",
             "guard_corruptions_total",
             "guard_repairs_total",
+            "policy_preemptions_total",
+            "policy_preempt_budget_exhausted_total",
         ):
             total, seen = 0.0, False
             for v in views:
@@ -398,6 +400,18 @@ def build_fleet_payload(
         },
     }
 
+    # scheduling-policy engine (nhd_tpu/policy/): the fleet-wide
+    # preemption ledger. score_mode is an in-process gauge (the scrape
+    # path carries it per replica as nhd_policy_score_mode; summing a
+    # mode across replicas is meaningless, so it stays 0 there).
+    policy = {
+        "preemptions_total": counters.get("policy_preemptions_total", 0),
+        "budget_exhausted_total": counters.get(
+            "policy_preempt_budget_exhausted_total", 0
+        ),
+        "score_mode": int(counters.get("policy_score_mode", 0)),
+    }
+
     shard_epochs: Dict[str, int] = {}
     for v in views:
         for shard, epoch in (v.get("shards") or {}).items():
@@ -426,6 +440,7 @@ def build_fleet_payload(
         "slo": slo_summary,
         "fencing": fencing,
         "device_state": device_state,
+        "policy": policy,
         "leadership": lead,
         "violations": list(violations or []),
         "journeys": {

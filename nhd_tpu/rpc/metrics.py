@@ -52,6 +52,11 @@ def render_metrics(
         f"nhd_failed_schedule_total {failed_count}",
     ]
     if api_stats is None:
+        # the scoring-mode gauge is computed at scrape (the policy env
+        # and matrix can change without a scheduler restart)
+        from nhd_tpu.policy.scoring import score_mode
+
+        API_COUNTERS.set("policy_score_mode", float(score_mode()))
         api_stats = API_COUNTERS.snapshot()
     # fault-tolerance layer: ApiCounters.KNOWN is the single name → (kind,
     # help) table, so a counter added there surfaces here with no edit
@@ -117,6 +122,23 @@ def render_metrics(
         for reason, n in sorted(reasons.items()):
             lines.append(
                 f'nhd_device_state_rebuilds_total{{reason="{reason}"}} {n}'
+            )
+
+    # policy preemptions by victim tier (nhd_tpu/policy/): the labeled
+    # complement of nhd_policy_preemptions_total — tier labels clamp to
+    # policy.MAX_TIER_LABEL, so cardinality is bounded (NHD603 stance)
+    from nhd_tpu.policy import preempt_tier_snapshot
+
+    tiers = preempt_tier_snapshot()
+    if tiers:
+        lines += [
+            "# HELP nhd_policy_preemptions_by_tier_total Policy "
+            "preemption evictions by victim tier",
+            "# TYPE nhd_policy_preemptions_by_tier_total counter",
+        ]
+        for tier, n in sorted(tiers.items()):
+            lines.append(
+                f'nhd_policy_preemptions_by_tier_total{{tier="{tier}"}} {n}'
             )
 
     # latency distributions (obs/histo.py) — the last_* gauge replacement

@@ -201,7 +201,13 @@ def build_explain_request(
             raise ValueError("no usable topology in config")
         top.add_pod_reservations(pod_spec_reservations(backend, pod, ns))
         groups = frozenset(backend.get_pod_node_groups(pod, ns))
-        return PodRequest.from_topology(top, node_groups=groups), None
+        from nhd_tpu import policy as _policy
+
+        tier = backend.get_pod_tier(pod, ns) if _policy.enabled() else 0
+        return (
+            PodRequest.from_topology(top, node_groups=groups, tier=tier),
+            None,
+        )
     except Exception as exc:
         # user-supplied config text: any parse failure IS the diagnosis
         # (the scheduler fails such pods with FailedCfgParse)
@@ -376,6 +382,11 @@ class Scheduler(threading.Thread):
         # consecutive transient-commit requeues per pod (capped by
         # REQUEUE_MAX; cleared on success, terminal failure, or delete)
         self._requeue_attempts: Dict[Tuple[str, str], int] = {}
+        # preemption attempts per pod (policy engine; capped by
+        # policy.preempt.max_attempts — the livelock bound: a pod that
+        # preempts and still can't place stops burning victims and takes
+        # the plain unschedulable verdict). Cleared on success or delete.
+        self._preempt_attempts: Dict[Tuple[str, str], int] = {}
         # set when a run-loop pass died mid-mutation (API outage past the
         # retry deadline); the next successful pass rebuilds the mirror
         # from the cluster before trusting it (_guarded)
@@ -536,8 +547,18 @@ class Scheduler(threading.Thread):
             return
         node.add_scheduled_pod(pod, ns, top)
         self._note_node(node_name)
+        from nhd_tpu import policy as _policy
+
         self.pod_state[(ns, pod)] = {
-            "state": PodStatus.SCHEDULED, "time": time.time(), "uid": uid
+            "state": PodStatus.SCHEDULED, "time": time.time(), "uid": uid,
+            # replayed pods re-read their tier (victim eligibility after
+            # a restart); bound_at 0.0 = "bound before this process" —
+            # the FTF tiebreak then prefers evicting fresher binds first
+            "tier": (
+                self.backend.get_pod_tier(pod, ns)
+                if _policy.enabled() else 0
+            ),
+            "node": node_name, "bound_at": 0.0,
         }
 
     def load_deployed_configs(self) -> None:
@@ -631,7 +652,12 @@ class Scheduler(threading.Thread):
             return None
         top.add_pod_reservations(self._pod_reservations(pod, ns))
         groups = frozenset(self.backend.get_pod_node_groups(pod, ns))
-        req = PodRequest.from_topology(top, node_groups=groups)
+        from nhd_tpu import policy as _policy
+
+        # tier read gated on the policy switch: with it off the request
+        # is built exactly as before (no extra annotation read per pod)
+        tier = self.backend.get_pod_tier(pod, ns) if _policy.enabled() else 0
+        req = PodRequest.from_topology(top, node_groups=groups, tier=tier)
         return parser, BatchItem((ns, pod), req, top)
 
     # ------------------------------------------------------------------
@@ -790,6 +816,12 @@ class Scheduler(threading.Thread):
             prepared.append(got)
         if not prepared:
             return 0
+        # priority tiers (policy engine): higher tiers admit first —
+        # claims apply in batch order, so a contended batch gives
+        # high-tier pods first pick. Stable sort: with the policy off
+        # every tier is 0 and the order (and placements) are untouched.
+        if any(item.request.tier for _parser, item in prepared):
+            prepared.sort(key=lambda pi: -pi[1].request.tier)
 
         t_batch = time.perf_counter()
         t_batch_mono = time.monotonic()
@@ -865,6 +897,27 @@ class Scheduler(threading.Thread):
                 rec.record("assign", t_asn0, bstats.assign_seconds,
                            cat="pod", corr=c, attrs=p_attrs)
 
+        # bounded preemption (policy engine): one eviction budget per
+        # scheduling batch — the per-ROUND bound of the policy contract
+        from nhd_tpu import policy as _policy
+
+        preempt_budget = None
+        pod_tiers: Optional[Dict[Tuple[str, str], Tuple[int, float]]] = None
+        if _policy.preemption_enabled() and self.sharded is None:
+            from nhd_tpu.policy.preempt import PreemptBudget
+
+            preempt_budget = PreemptBudget.fresh()
+            # the victim-eligibility projection, built ONCE per batch (a
+            # quota storm can carry hundreds of unplaceable high-tier
+            # pods; per-pod rebuilds were O(unplaceable × bound) on the
+            # single-writer thread). _maybe_preempt prunes the entries
+            # it evicts — the only in-batch mutation source.
+            pod_tiers = {
+                k: (st.get("tier", 0), st.get("bound_at", 0.0))
+                for k, st in self.pod_state.items()
+                if st.get("state") == PodStatus.SCHEDULED
+            }
+
         winners: List[Tuple[CfgParser, BatchItem, object]] = []
         for (parser, item), result in zip(prepared, results):
             ns, pod = item.key
@@ -874,6 +927,13 @@ class Scheduler(threading.Thread):
                     # spill to the untried shards (the explicit failure
                     # fires only once every shard has tried)
                     self._spill_unplaced(pod, ns, corrs.get(item.key))
+                    continue
+                if preempt_budget is not None and self._maybe_preempt(
+                    item, corrs.get(item.key), uids.get(item.key, "0"),
+                    preempt_budget, nodes_view, pod_tiers,
+                ):
+                    # victims evicted (fenced) + requeued; the preemptor
+                    # requeued behind the freed capacity — no verdict yet
                     continue
                 self.backend.generate_pod_event(
                     pod, ns, "FailedScheduling", EventType.WARNING,
@@ -989,9 +1049,15 @@ class Scheduler(threading.Thread):
             # (one backend read per successful bind)
             self._observe_slo_bind(pod, ns)
             self._requeue_attempts.pop((ns, pod), None)
+            self._preempt_attempts.pop((ns, pod), None)
+            # tier/bound_at/corr/node feed the policy engine: victim
+            # eligibility (strictly lower tier), finish-time-fairness
+            # tiebreak, and the preserved corr ID a preempted pod
+            # requeues under
             self.pod_state[(ns, pod)] = {
                 "state": PodStatus.SCHEDULED, "time": time.time(),
-                "uid": uid,
+                "uid": uid, "tier": item.request.tier, "corr": corr,
+                "node": result.node, "bound_at": time.monotonic(),
             }
             if rec is not None:
                 rec.record_decision(self._decision(
@@ -1588,6 +1654,170 @@ class Scheduler(threading.Thread):
         self._note_node(node.name)
 
     # ------------------------------------------------------------------
+    # bounded preemption (policy engine, nhd_tpu/policy/preempt)
+    # ------------------------------------------------------------------
+
+    def _maybe_preempt(
+        self, item: BatchItem, corr: Optional[str], uid: str,
+        budget, nodes_view: Dict[str, HostNode],
+        pod_tiers: Dict[Tuple[str, str], Tuple[int, float]],
+    ) -> bool:
+        """Try to free capacity for an unplaceable higher-tier pod by
+        evicting a minimal lower-tier victim set, within the batch's
+        budgets. Returns True when evictions executed (the preemptor and
+        every victim are requeued; the next batch re-solves against the
+        freed capacity), False when the pod should take its normal
+        unschedulable verdict.
+
+        Safety: every eviction routes through the fenced
+        ``_commit_write`` chokepoint — a deposed leader's in-flight
+        preemption is rejected by the backend (StaleLeaseError), the
+        victim keeps its claims here and its binding there, and the new
+        leader owns the pod's next attempt. A victim's mirror claims are
+        released only AFTER its eviction landed, through the same
+        stored-topology release the unwind path uses. Victims keep their
+        corr IDs, so the flight recorder shows one preempt→rebind
+        journey per victim."""
+        from nhd_tpu import policy as _policy
+        from nhd_tpu.policy import preempt as _preempt
+
+        tier = item.request.tier
+        if tier <= 0 or budget.round_left <= 0:
+            return False
+        ns, pod = item.key
+        key = (ns, pod)
+        attempts = self._preempt_attempts.get(key, 0)
+        if attempts >= _preempt.max_attempts():
+            # livelock bound spent: plain verdict, counter reset so a
+            # later incarnation starts fresh
+            self._preempt_attempts.pop(key, None)
+            return False
+        plan, why = _preempt.plan_preemption(
+            nodes_view, item.request, tier, pod_tiers, budget,
+            respect_busy=self.batch.respect_busy,
+        )
+        rec = self._rec()
+        if plan is None:
+            if why == "budget-exhausted":
+                API_COUNTERS.inc("policy_preempt_budget_exhausted_total")
+                if rec is not None:
+                    d = self._decision(
+                        pod, ns, corr, "preempt-budget-exhausted",
+                    )
+                    d["budget"] = budget.state()
+                    rec.record_decision(d)
+            return False
+
+        # execute: fenced evictions first (cluster truth moves before
+        # mirror truth — the reverse order could release claims for a
+        # victim whose eviction then fences off)
+        evicted: List[Tuple[str, str, int]] = []
+        for vns, vpod, vtier in plan.victims:
+            try:
+                ok = self._commit_write(
+                    self.backend.evict_pod, vpod, vns, node=plan.node,
+                )
+            except TransientBackendError as exc:
+                self.logger.warning(
+                    f"preemption evict of {vns}/{vpod} fenced off or "
+                    f"failed transiently: {exc}; aborting the remaining "
+                    "victim set"
+                )
+                break
+            if not ok:
+                break
+            evicted.append((vns, vpod, vtier))
+        if not evicted:
+            return False
+        budget.charge(evicted)
+
+        # the preemptor requeues FIRST: the watch queue is FIFO, so its
+        # next solve runs before any victim's — a victim requeued ahead
+        # of it would re-bind straight into the capacity just freed and
+        # starve the higher-tier pod into its attempts cap (observed in
+        # the end-to-end cell; tests/test_policy.py pins the order)
+        self._preempt_attempts[key] = attempts + 1
+        self.pod_state.pop(key, None)
+        self.nqueue.put(WatchItem(
+            WatchType.TRIAD_POD_CREATE,
+            pod={"ns": ns, "name": pod, "uid": uid, "cfg": "", "node": ""},
+            corr=corr,
+            t_enqueue=time.monotonic(),
+        ))
+
+        node = self.nodes.get(plan.node)
+        for vns, vpod, vtier in evicted:
+            pod_tiers.pop((vns, vpod), None)  # no longer a victim candidate
+            vstate = self.pod_state.pop((vns, vpod), None) or {}
+            vcorr = vstate.get("corr")
+            vuid = vstate.get("uid", "0")
+            # release the victim's claims from the stored topology (the
+            # same mirror-held release the unwind and reconcile paths
+            # use); fall back to the annotation-driven release if the
+            # mirror has no record
+            top = node.pod_info.get((vpod, vns)) if node is not None else None
+            if node is not None and top is not None:
+                node.release_from_topology(top)
+                node.remove_scheduled_pod(vpod, vns)
+                # deliberately NO set_busy() here, unlike the unwind and
+                # release paths: the busy stamp rate-limits GPU
+                # *placements* per node, and stamping the freed node
+                # would make it infeasible for a GPU preemptor for
+                # MIN_BUSY_SECS — evicting victims and then hiding the
+                # freed capacity from the very pod it was freed for
+                # (self-defeating; pinned by test_policy.py)
+                self._note_node(node.name)
+            else:
+                self.release_pod_resources(vpod, vns, node_name=plan.node)
+            _policy.note_preemption(tier, vtier)
+            API_COUNTERS.inc("policy_preemptions_total")
+            self.backend.generate_pod_event(
+                vpod, vns, "Preempted", EventType.WARNING,
+                f"Preempted from {plan.node} by higher-tier pod "
+                f"{ns}/{pod} (tier {tier} > {vtier})",
+            )
+            if rec is not None:
+                d = self._decision(
+                    vpod, vns, vcorr, "preempted", node=plan.node,
+                )
+                d["preemptor"] = f"{ns}/{pod}"
+                rec.record_decision(d)
+            # requeue the victim under its ORIGINAL corr ID: the flight
+            # recorder's journey view shows preempt→rebind as one trace
+            self.nqueue.put(WatchItem(
+                WatchType.TRIAD_POD_CREATE,
+                pod={"ns": vns, "name": vpod, "uid": vuid, "cfg": "",
+                     "node": ""},
+                corr=vcorr,
+                t_enqueue=time.monotonic(),
+            ))
+
+        self.backend.generate_pod_event(
+            pod, ns, "PreemptionScheduling", EventType.NORMAL,
+            f"Preempted {len(evicted)} lower-tier pod(s) on {plan.node}; "
+            f"requeued for placement",
+        )
+        if rec is not None:
+            now_mono = time.monotonic()
+            rec.record(
+                "preempt", now_mono, 0.0, cat="pod", corr=corr,
+                attrs={
+                    "pod": f"{ns}/{pod}", "node": plan.node,
+                    "victims": [f"{v[0]}/{v[1]}" for v in evicted],
+                    "budget": budget.state(),
+                },
+            )
+            d = self._decision(
+                pod, ns, corr, "preempt-requeued", node=plan.node,
+            )
+            d["victims"] = [
+                {"pod": f"{v[0]}/{v[1]}", "tier": v[2]} for v in evicted
+            ]
+            d["budget"] = budget.state()
+            rec.record_decision(d)
+        return True
+
+    # ------------------------------------------------------------------
     # reconciliation
     # ------------------------------------------------------------------
 
@@ -1779,7 +2009,7 @@ class Scheduler(threading.Thread):
             rep = explain(
                 self.nodes, req, respect_busy=self.batch.respect_busy
             )
-            return {
+            out = {
                 "pod": label,
                 "request": rep.pod_summary,
                 "summary": rep.summary,
@@ -1789,6 +2019,11 @@ class Scheduler(threading.Thread):
                     for v in rep.verdicts
                 ],
             }
+            if rep.policy is not None:
+                # policy verdict (NHD_POLICY=1): tier, scoring mode and
+                # the per-schedulable-node score-term breakdown
+                out["policy"] = rep.policy
+            return out
         except Exception as exc:
             # a diagnostics query must answer with the failure, not kill
             # the single-writer thread
@@ -1825,6 +2060,7 @@ class Scheduler(threading.Thread):
             )
             self.pod_state.pop((ns, pod), None)
             self._requeue_attempts.pop((ns, pod), None)
+            self._preempt_attempts.pop((ns, pod), None)
 
         elif item.type == WatchType.TRIAD_POD_CREATE:
             ns, pod, uid = item.pod["ns"], item.pod["name"], item.pod["uid"]
@@ -1986,6 +2222,7 @@ class Scheduler(threading.Thread):
         self.pod_state.clear()
         self._missing_once.clear()
         self._requeue_attempts.clear()
+        self._preempt_attempts.clear()
         self.load_deployed_configs()
         self._beat()
         self.check_pending_pods()

@@ -82,6 +82,46 @@ from nhd_tpu.sim.synth import SynthNodeSpec, make_node_labels, make_triad_config
 # TriadSet cadence and, in HA mode, lease expiry both run off it)
 STEP_SEC = 10.0
 
+# ---------------------------------------------------------------------------
+# policy-chaos profiles (ISSUE 15: the scheduling-policy engine's scenario
+# machinery — mixed-generation fleets, tenant quota storms, maintenance
+# waves, each with invariants). Solo mode only: the policy counters and
+# the scoring matrix are process-global like the device plane.
+# ---------------------------------------------------------------------------
+
+#: node hardware generations a policy storm spreads the fleet over
+POLICY_CLASSES = ("gen-a", "gen-b", "gen-c")
+
+#: the storm's throughput matrix: gen-a is the fast generation — the
+#: scoring invariously prefers it, creating exactly the contention that
+#: makes preemption and budgets earn their keep
+POLICY_TPUT = {
+    "gpu": {"gen-a": 1.0, "gen-b": 0.6, "gen-c": 0.35},
+    "cpu": {"gen-a": 1.0, "gen-b": 0.8, "gen-c": 0.6},
+}
+
+#: tenant namespaces the quota-storm profile spreads pods over
+POLICY_TENANTS = ("default", "tenant-a", "tenant-b")
+
+#: per-pod lifetime eviction ceiling — the no-preemption-cascade /
+#: no-livelock invariant: budgets and the per-pod attempts cap mean no
+#: pod should ever be evicted more than a handful of times in a storm
+POLICY_CASCADE_BOUND = 4
+
+#: scheduling passes one chaos step can drive (controller + up to 8
+#: queue drains + the periodic scan) — the per-step eviction bound is
+#: round_budget × this
+POLICY_PASSES_PER_STEP = 10
+
+#: the three policy storm profiles (make policy-chaos sweeps them):
+#: mixed-gen  — tiered pods on a mixed-generation fleet (the baseline
+#:              heterogeneity scenario)
+#: quota-storm — multi-tenant bursts of high-tier pods (the per-tenant
+#:              budget's scenario)
+#: maint-wave — periodic cordon/maintenance waves shrink the fleet and
+#:              force rebinds under preemption pressure
+POLICY_PROFILES = ("mixed-gen", "quota-storm", "maint-wave")
+
 # kill/restart waves leave a federation replica down for at most this
 # many steps before its fresh incarnation rejoins (crash-only restart)
 KILL_DOWN_MAX_STEPS = 2
@@ -305,9 +345,42 @@ class ChaosSim:
         n_replicas: int = 3,
         lease_ttl: float = 3 * STEP_SEC,
         tracing: Optional[bool] = None,
+        policy: Optional[str] = None,
+        policy_off: bool = False,
     ):
         if ha and federation:
             raise ValueError("ha=True and federation=S are exclusive modes")
+        # policy storms (POLICY_PROFILES): mixed-generation fleet, tiered
+        # pods, the scoring matrix installed — solo mode only (the policy
+        # counters and scoring matrix are process-global, like the device
+        # plane). ``policy_off`` runs the SAME storm (same rng draws:
+        # tiers are still annotated, classes still labeled) as the
+        # negative/bit-exactness control — the scheduler must behave
+        # exactly like the pre-policy one: zero evictions.
+        if policy is not None:
+            if policy not in POLICY_PROFILES:
+                raise ValueError(
+                    f"unknown policy profile {policy!r}; "
+                    f"have {POLICY_PROFILES}"
+                )
+            if ha or federation:
+                raise ValueError("policy profiles run solo mode only")
+            from nhd_tpu import policy as _policy
+            from nhd_tpu.policy import scoring as _scoring
+
+            if _policy.enabled() == policy_off:
+                raise ValueError(
+                    "policy storm needs NHD_POLICY="
+                    + ("0 for the control run" if policy_off else
+                       "1 (make policy-chaos sets it)")
+                )
+            _policy.reset_policy_metrics()
+            _scoring.set_matrix(dict(POLICY_TPUT))
+        self.policy = policy
+        self.policy_off = policy_off
+        self._evicts_seen = 0          # per-step eviction-bound cursor
+        self._maint_wave_left = 0      # maint-wave profile state
+        self._maint_wave_nodes: List[str] = []
         self.seed = seed
         self.rng = random.Random(seed)
         self.hardened = hardened
@@ -396,6 +469,16 @@ class ChaosSim:
             if self.federation:
                 # spread node groups so every shard lease fronts nodes
                 spec.groups = self.group_pool[i % len(self.group_pool)]
+            if self.policy:
+                # mixed-generation fleet: classes cycle so every storm
+                # exercises scoring across generations — and nodes are
+                # SMALL (a couple of pods each), so the storm actually
+                # saturates and preemption pressure is real, not
+                # vacuous (a fleet that never fills never preempts)
+                spec.node_class = POLICY_CLASSES[i % len(POLICY_CLASSES)]
+                spec.phys_cores = 8
+                spec.gpus_per_numa = 1
+                spec.hugepages_gb = 8
             self.backend.add_node(
                 spec.name, make_node_labels(spec), hugepages_gb=spec.hugepages_gb
             )
@@ -638,9 +721,23 @@ class ChaosSim:
                 hugepages_gb=self.rng.choice([2, 4]),
                 map_type=self.rng.choice(["NUMA", "NUMA", "PCI"]),
             )
+        tier = 0
+        ns = "default"
+        if self.policy:
+            # tiered workloads: the quota-storm profile spreads tenants
+            # and leans high-tier (the per-tenant budget's scenario);
+            # the other profiles keep a best-effort-heavy mix. The draws
+            # run in BOTH the policy-on and the policy_off control run
+            # (same rng stream → same churn sequence; the control's
+            # scheduler just ignores the tiers).
+            if self.policy == "quota-storm":
+                ns = self.rng.choice(POLICY_TENANTS)
+                tier = self.rng.choices((0, 1, 2), weights=(4, 3, 3))[0]
+            else:
+                tier = self.rng.choices((0, 1, 2), weights=(6, 3, 1))[0]
         self.backend.create_pod(
-            f"chaos-{self._pod_seq}", cfg_text=cfg, cfg_type=cfg_type,
-            groups=groups,
+            f"chaos-{self._pod_seq}", ns, cfg_text=cfg, cfg_type=cfg_type,
+            groups=groups, tier=tier,
         )
         self.stats.created += 1
 
@@ -830,11 +927,112 @@ class ChaosSim:
         else:
             self._node_seq += 1
             spec = SynthNodeSpec(name=f"flap{self._node_seq}")
+            if self.policy:
+                spec.node_class = POLICY_CLASSES[
+                    self._node_seq % len(POLICY_CLASSES)
+                ]
             self.backend.add_node(
                 spec.name, make_node_labels(spec),
                 hugepages_gb=spec.hugepages_gb, emit_watch=True,
             )
         self.stats.node_flaps += 1
+
+    def _policy_wave_step(self) -> None:
+        """maint-wave profile: periodically cordon ~a third of the fleet
+        for a few steps, then uncordon — bound pods survive (cordon only
+        blocks NEW placements) but the shrunken fleet forces rebinds and
+        preemption pressure onto the remaining generations."""
+        if self._maint_wave_left > 0:
+            self._maint_wave_left -= 1
+            if self._maint_wave_left == 0:
+                for name in self._maint_wave_nodes:
+                    if name in self.backend.nodes:
+                        self.backend.cordon_node(name, False)
+                self._maint_wave_nodes = []
+            return
+        if self.rng.random() < 0.15:
+            names = list(self.backend.nodes)
+            k = max(1, len(names) // 3)
+            self._maint_wave_nodes = self.rng.sample(names, k)
+            for name in self._maint_wave_nodes:
+                self.backend.cordon_node(name, True)
+            self._maint_wave_left = self.rng.randint(2, 3)
+            self.stats.cordons += k
+
+    def _check_policy_invariants(self) -> None:
+        """The policy storm's standing invariants (ISSUE 15):
+
+        * preemption bounded per step — evictions this step can never
+          exceed the per-batch round budget times the passes one step
+          can drive (POLICY_PASSES_PER_STEP);
+        * no preemption cascade/livelock — no pod is ever evicted more
+          than POLICY_CASCADE_BOUND times across the run;
+        * no tier inversion — every executed eviction's victim was
+          strictly lower-tier than its preemptor;
+        * policy-off control — the ``policy_off`` run of the same storm
+          must execute ZERO evictions (the scheduler with NHD_POLICY=0
+          is the pre-policy scheduler, bit-exactly).
+        """
+        if self.policy is None:
+            return
+        log = self.base.evict_log
+        new = len(log) - self._evicts_seen
+        self._evicts_seen = len(log)
+        v = self.stats.violations
+        if self.policy_off:
+            if log:
+                v.append(
+                    f"step {self.stats.steps}: policy-off control "
+                    f"executed {len(log)} eviction(s)"
+                )
+            return
+        from nhd_tpu.policy import preempt as _preempt
+        from nhd_tpu.policy import preempt_pairs
+
+        bound = _preempt.round_budget() * POLICY_PASSES_PER_STEP
+        if new > bound:
+            v.append(
+                f"step {self.stats.steps}: {new} evictions in one step "
+                f"exceed the per-step bound {bound}"
+            )
+        per_pod: Dict[Tuple[str, str], int] = {}
+        for ns, pod, _uid, _node, _e, _l in log:
+            per_pod[(ns, pod)] = per_pod.get((ns, pod), 0) + 1
+        for key, n in per_pod.items():
+            if n > POLICY_CASCADE_BOUND:
+                v.append(
+                    f"step {self.stats.steps}: pod {key[0]}/{key[1]} "
+                    f"evicted {n} times (cascade bound "
+                    f"{POLICY_CASCADE_BOUND})"
+                )
+        for p_tier, v_tier in preempt_pairs():
+            if v_tier >= p_tier:
+                v.append(
+                    f"step {self.stats.steps}: tier inversion — victim "
+                    f"tier {v_tier} >= preemptor tier {p_tier}"
+                )
+
+    def policy_victims_unresolved(self) -> List[Tuple[str, str]]:
+        """Evicted pods that neither rebound nor reached an explicit
+        verdict (unschedulable event, or deletion) — must be empty after
+        quiesce: the victim-rebind invariant."""
+        evicted = {
+            (ns, pod) for ns, pod, _uid, _node, _e, _l in self.base.evict_log
+        }
+        no_candidate = {
+            (e.namespace, e.pod)
+            for e in self.base.events
+            if e.reason == "FailedScheduling"
+            and "No valid candidate" in e.message
+        }
+        out = []
+        for ns, pod in sorted(evicted):
+            p = self.base.pods.get((ns, pod))
+            if p is None:
+                continue  # deleted mid-storm: resolved
+            if p.node is None and (ns, pod) not in no_candidate:
+                out.append((ns, pod))
+        return out
 
     def _resident_dev(self):
         """The solo scheduler's live device-resident state, or None
@@ -957,6 +1155,8 @@ class ChaosSim:
             weights.append(4)
         action = self.rng.choices(actions, weights=weights)[0]
         action()
+        if self.policy == "maint-wave":
+            self._policy_wave_step()
         if not self.federation and not self.ha and (
             self._flap_rng.random() < 0.08
         ):
@@ -1218,21 +1418,31 @@ class ChaosSim:
                     self._check_scheduler_invariants(r.sched)
         else:
             self._check_scheduler_invariants(self.sched)
+            self._check_policy_invariants()
         self._check_single_epoch_binds()
 
     def _check_single_epoch_binds(self) -> None:
         """The split-brain acceptance invariant: every pod incarnation is
         bound by AT MOST one leadership. Two successful binds for one uid
         — same epoch or different, same shard lease or different — mean
-        a deposed owner's write landed past the fence."""
+        a deposed owner's write landed past the fence.
+
+        Policy preemption (ISSUE 15) legitimately re-binds a uid: the
+        victim is evicted (through the same fenced chokepoint) and
+        requeued, so the allowance is 1 + that uid's evictions — an
+        unmatched extra bind still fires exactly as before."""
+        evicts_per_uid: Dict[str, int] = {}
+        for _ns, _pod, uid, _node, _e, _l in self.base.evict_log:
+            evicts_per_uid[uid] = evicts_per_uid.get(uid, 0) + 1
         per_uid: Dict[str, List] = {}
         for ns, pod, uid, node, epoch, lease in self.backend.bind_log:
             per_uid.setdefault(uid, []).append((ns, pod, node, epoch, lease))
         for uid, binds in per_uid.items():
-            if len(binds) > 1:
+            if len(binds) > 1 + evicts_per_uid.get(uid, 0):
                 self.stats.violations.append(
                     f"step {self.stats.steps}: pod uid {uid} bound "
-                    f"{len(binds)} times: {binds}"
+                    f"{len(binds)} times "
+                    f"({evicts_per_uid.get(uid, 0)} evictions): {binds}"
                 )
 
     def _check_slo_plane(self) -> None:
@@ -1355,6 +1565,18 @@ class ChaosSim:
                         f"{window} window exceeds the profile's limit "
                         f"{limit:.1f}"
                     )
+        if self.policy is not None:
+            # the victim-rebind invariant, judged once the storm settled:
+            # every evicted pod rebound, was deleted, or holds its
+            # explicit unschedulable verdict
+            for ns, pod in self.policy_victims_unresolved():
+                self.stats.violations.append(
+                    f"quiesce: evicted pod {ns}/{pod} neither rebound "
+                    "nor reached a verdict"
+                )
+            from nhd_tpu.policy import scoring as _scoring
+
+            _scoring.set_matrix(None)  # re-arm env for the next cell
         self._maybe_capture_violation()
         if self.device_injector is not None:
             # leave the process-global seam clean for the next cell

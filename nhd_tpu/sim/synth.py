@@ -34,6 +34,10 @@ class SynthNodeSpec:
     hugepages_gb: int = 64
     reserved_hugepages_gb: int = 0
     groups: str = "default"
+    # hardware-generation class label (policy engine heterogeneity
+    # scoring, NHD_NODE_CLASS); "" = let the node derive its class from
+    # the GPU inventory
+    node_class: str = ""
     data_vlan: int = 100
     gw: str = "10.1.0.1/32"
     sriov_pfs: int = 0            # extra PF NICs that must be excluded
@@ -93,6 +97,8 @@ def make_node_labels(spec: SynthNodeSpec) -> Dict[str, str]:
             gpu_i += 1
 
     labels["NHD_GROUP"] = spec.groups
+    if spec.node_class:
+        labels["NHD_NODE_CLASS"] = spec.node_class
     labels["DATA_PLANE_VLAN"] = str(spec.data_vlan)
     labels["DATA_DEFAULT_GW"] = spec.gw
     if spec.reserved_hugepages_gb:

@@ -1274,10 +1274,19 @@ class BatchScheduler:
             speculate_enabled,
         )
 
+        from nhd_tpu.policy.scoring import scoring_active
+
         spec_ok = (
             apply
             and dev is not None
             and speculate_enabled()
+            # the megaround claims on feasibility alone — under a live
+            # (non-uniform) heterogeneity scoring matrix its round-0
+            # claims would bypass the policy ranking, so policy batches
+            # run classic rounds (whose fused solve+rank carries the
+            # score terms). NHD_POLICY=0 and the uniform matrix keep the
+            # speculative fast path.
+            and not scoring_active()
         )
 
         t_batch = time.perf_counter()

@@ -378,17 +378,15 @@ class DeviceClusterState:
         self._scatter(_ARG_ORDER, rows.astype(np.int64))
 
     def _pod_args(self, pods) -> list:
-        """The 9 pod-type arrays padded to the pow-2 type bucket, in
-        _solve's positional order — shared by the plain and fused solve
-        paths so the argument list cannot drift between them."""
+        """The 10 pod-type arrays padded to the pow-2 type bucket, in
+        _solve's positional order (kernel._POD_ARG_ORDER) — shared by
+        the plain and fused solve paths so the argument list cannot
+        drift between them."""
+        from nhd_tpu.solver.kernel import _POD_ARG_ORDER
+
         Tp = _pad_pow2(pods.n_types)
         return [
-            _pad_rows(a, Tp)
-            for a in (
-                pods.cpu_dem_smt, pods.cpu_dem_raw, pods.gpu_dem,
-                pods.rx, pods.tx, pods.hp, pods.needs_gpu, pods.map_pci,
-                pods.group_mask,
-            )
+            _pad_rows(getattr(pods, name), Tp) for name in _POD_ARG_ORDER
         ]
 
     def update_rows(self, indices: Iterable[int]) -> None:

@@ -22,6 +22,14 @@ from nhd_tpu.core.node import HostNode
 from nhd_tpu.core.request import PodRequest
 from nhd_tpu.core.topology import MapMode
 
+# policy score-term inputs (node_class rows, per-type score rows):
+# imported at module load, NOT lazily inside the encode functions — the
+# first encode sits inside the timed first-bind window, and the lazy
+# import showed up as a ~3 ms first_bind_prewarmed regression on the
+# bench-smoke gate. No cycle: nhd_tpu.policy never imports the solver.
+from nhd_tpu.policy.classes import MAX_CLASSES, node_class_index
+from nhd_tpu.policy.scoring import score_row
+
 MAX_GROUP_BITS = 63  # node-group bitmask width (int64, sign bit unused)
 
 
@@ -83,6 +91,13 @@ class ClusterArrays:
     nic_free: np.ndarray       # [N, U, K, 2] float32 — rx/tx headroom Gbps
     nic_sw: np.ndarray         # [N, U, K] int32 — dense per-node switch id, -1 none
     gpu_free_sw: np.ndarray    # [N, S] int32 — free GPUs per dense switch id
+    node_class: np.ndarray     # [N] int32 — hardware-generation class index
+    #                            (policy/classes.py process-global interner;
+    #                            0 = default class). Scored against the
+    #                            per-type class_score rows in the fused
+    #                            megaround; all-zero scoring leaves
+    #                            placements bit-exact with the pre-policy
+    #                            ranking.
     interner: GroupInterner = field(default_factory=GroupInterner)
     # every node's NICs share one capacity (speed): with NIC sharing off,
     # candidacy then depends only on free-NIC COUNTS per NUMA, which the
@@ -196,6 +211,15 @@ class EncodeStatic:
         self.nic_sw_mat = np.full((N, U, K), -1, np.int32)
         self.nic_sw_mat[self.nic_node, self.nic_u, self.nic_k] = self.nic_sw_dense
 
+        # hardware-generation class indices (policy/classes.py): static
+        # per pack generation (node_class only changes on a label
+        # reparse, which bumps _pack_gen and misses this cache), and the
+        # process-global interner never re-maps a name, so the resolved
+        # indices are safe to cache
+        self.node_class = np.array(
+            [node_class_index(n) for n in nl], np.int32
+        )
+
 
 # id-keyed EncodeStatic cache. The entries pin their node lists, keeping
 # the id() keys valid (same pattern as FastCluster._bucket_arrays — an
@@ -269,6 +293,7 @@ def encode_cluster(
         nic_free=np.full((N, U, K, 2), -1.0, np.float32),
         nic_sw=np.full((N, U, K), -1, np.int32),
         gpu_free_sw=np.zeros((N, S), np.int32),
+        node_class=np.zeros(N, np.int32),
         interner=interner,
     )
     arr.uniform_nic_caps = all(
@@ -290,6 +315,7 @@ def encode_cluster(
     arr.numa_nodes[:] = st.numa_nodes
     arr.smt[:] = st.smt
     arr.gpuless[:] = st.gpuless
+    arr.node_class[:] = st.node_class
     arr.nic_count[:] = st.nic_count_mat
     arr.nic_sw[:] = st.nic_sw_mat
     arr.active[:] = [n.active for n in nl]
@@ -364,6 +390,7 @@ def refresh_node_row(
     arr.gpuless[i] = len(node.gpus) == 0
     arr.group_mask[i] = arr.interner.mask(node.groups)
     arr.hp_free[i] = node.mem.free_hugepages_gb
+    arr.node_class[i] = node_class_index(node)
 
     arr.cpu_free[i] = 0
     cpu = node.free_cpu_cores_per_numa()
@@ -425,7 +452,7 @@ def refresh_node_row(
 DELTA_FIELDS = (
     "numa_nodes", "smt", "active", "maintenance", "busy", "gpuless",
     "group_mask", "hp_free", "cpu_free", "gpu_free", "nic_count",
-    "nic_free", "nic_sw", "gpu_free_sw",
+    "nic_free", "nic_sw", "gpu_free_sw", "node_class",
 )
 
 #: the bounded rebuild-reason vocabulary (NHD603: the metrics label set
@@ -988,6 +1015,12 @@ class PodTypeArrays:
     needs_gpu: np.ndarray           # [T] bool
     map_pci: np.ndarray             # [T] bool
     group_mask: np.ndarray          # [T] int64
+    class_score: np.ndarray         # [T, policy.classes.MAX_CLASSES] int32 —
+    #                                 quantized per-node-class throughput
+    #                                 scores (policy/scoring.py), gathered
+    #                                 against node_class in the fused
+    #                                 megaround. All-zero with NHD_POLICY=0
+    #                                 (the bit-exact placement control).
 
     @property
     def n_types(self) -> int:
@@ -1046,6 +1079,7 @@ def encode_pods(
             needs_gpu=np.zeros(T, bool),
             map_pci=np.zeros(T, bool),
             group_mask=np.zeros(T, np.int64),
+            class_score=np.zeros((T, MAX_CLASSES), np.int32),
         )
         for t, r in enumerate(reqs):
             arr.cpu_dem_smt[t] = r.cpu_slot_counts(node_smt=True)
@@ -1058,5 +1092,6 @@ def encode_pods(
             arr.needs_gpu[t] = r.needs_gpu
             arr.map_pci[t] = r.map_mode == MapMode.PCI
             arr.group_mask[t] = interner.mask(r.node_groups)
+            arr.class_score[t] = score_row(r)  # one cached row per kind
         out[G] = arr
     return out

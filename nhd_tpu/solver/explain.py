@@ -49,6 +49,11 @@ class ExplainReport:
     pod_summary: str
     verdicts: List[NodeVerdict] = field(default_factory=list)
     schedulable_nodes: List[str] = field(default_factory=list)
+    # policy verdict (nhd_tpu/policy/, present only with NHD_POLICY=1):
+    # the pod's tier, the scoring mode, and the score-term breakdown per
+    # schedulable node — (class, quantized score); the highest-scoring
+    # schedulable node is what the fused megaround's ranking picks first
+    policy: Optional[dict] = None
 
     @property
     def summary(self) -> Dict[str, int]:
@@ -70,6 +75,18 @@ class ExplainReport:
             )
         else:
             lines.append("UNSCHEDULABLE on every node")
+        if self.policy is not None and self.policy.get("scores"):
+            ranked = sorted(
+                self.policy["scores"].items(),
+                key=lambda kv: -kv[1]["score"],
+            )
+            lines.append(
+                f"policy: tier={self.policy['tier']} "
+                f"mode={self.policy['score_mode']} "
+                + ", ".join(
+                    f"{n}={s['class']}:{s['score']}" for n, s in ranked[:8]
+                )
+            )
         for v in self.verdicts:
             if v.reason != R_OK:
                 lines.append(
@@ -119,7 +136,34 @@ def explain(
     report.schedulable_nodes = [
         v.node for v in report.verdicts if v.reason == R_OK
     ]
+    _attach_policy(report, nodes, req)
     return report
+
+
+def _attach_policy(report: ExplainReport, nodes, req) -> None:
+    """Score-term breakdown for the schedulable nodes (policy engine):
+    answers "the pod CAN run on 12 nodes — why did it land THERE" as
+    data. Off (None) unless NHD_POLICY=1."""
+    from nhd_tpu import policy as _policy
+
+    if not _policy.enabled():
+        return
+    from nhd_tpu.policy.classes import CLASSES, node_class_index
+    from nhd_tpu.policy.scoring import score_mode, score_row
+
+    row = score_row(req)
+    scores = {}
+    for name in report.schedulable_nodes:
+        idx = node_class_index(nodes[name])
+        scores[name] = {
+            "class": CLASSES.name_of(idx),
+            "score": int(row[min(idx, len(row) - 1)]),
+        }
+    report.policy = {
+        "tier": getattr(req, "tier", 0),
+        "score_mode": score_mode(),
+        "scores": scores,
+    }
 
 
 def _explain_node(

@@ -43,10 +43,16 @@ def _solve(
     # node arrays
     numa_nodes, smt, active, maintenance, busy, gpuless, node_gmask,
     hp_free, cpu_free, gpu_free, nic_count, nic_free, nic_sw, gpu_free_sw,
+    node_class,
     # pod-type arrays
     cpu_dem_smt, cpu_dem_raw, gpu_dem, rx, tx, hp, needs_gpu, map_pci,
-    pod_gmask,
+    pod_gmask, class_score,
 ) -> SolveOut:
+    # node_class/class_score are the policy engine's score-term inputs
+    # (nhd_tpu/policy/): feasibility never reads them — the fused ranked
+    # programs fold them into the selection value via _policy_pref, and
+    # the plain solve (this function's SolveOut) stays the pure
+    # feasibility surface.
     C, A, U, K = tables.C, tables.A, tables.U, tables.K
     combo_onehot = jnp.asarray(tables.combo_onehot)          # [C,G,U]
     need_max = jnp.asarray(tables.need_max)                  # [C,A,U]
@@ -202,20 +208,22 @@ def _solve(
 # kernel dispatches, device-resident state (solver/device_state.py), the
 # speculative megaround (solver/speculate.py) and the AOT export/prewarm
 # layer (solver/aot.py) all build their argument lists from these tuples,
-# so the 23-array positional signature cannot drift between them.
+# so the 25-array positional signature (15 node + 10 pod-type, grown from
+# 23 by the policy engine's node_class/class_score score-term inputs)
+# cannot drift between them.
 _MUTABLE = ("busy", "hp_free", "cpu_free", "gpu_free", "nic_free", "gpu_free_sw")
 _STATIC = (
     "numa_nodes", "smt", "active", "maintenance", "gpuless", "group_mask",
-    "nic_count", "nic_sw",
+    "nic_count", "nic_sw", "node_class",
 )
 _ARG_ORDER = (
     "numa_nodes", "smt", "active", "maintenance", "busy", "gpuless",
     "group_mask", "hp_free", "cpu_free", "gpu_free", "nic_count",
-    "nic_free", "nic_sw", "gpu_free_sw",
+    "nic_free", "nic_sw", "gpu_free_sw", "node_class",
 )
 _POD_ARG_ORDER = (
     "cpu_dem_smt", "cpu_dem_raw", "gpu_dem", "rx", "tx", "hp", "needs_gpu",
-    "map_pci", "group_mask",
+    "map_pci", "group_mask", "class_score",
 )
 
 # combo-lattice ceiling: (U^G) * (K^G) above this routes the bucket to the
@@ -309,6 +317,27 @@ def _rank_body(R, cand, pref, best_c, best_m, best_a, n_picks,
     ])
 
 
+def _policy_pref(pref, node_class, class_score):
+    """Fold the heterogeneity score term into the selection preference
+    (nhd_tpu/policy/): the fused ranking value becomes
+
+        sel = (score * 3 + pref) * (N + 1) + (N - node_index)
+
+    i.e. throughput class is the primary key, the gpuless preference the
+    tiebreak, low node index last — Gavel's throughput-matrix scoring as
+    one extra vmapped gather inside the existing megaround. With the
+    policy off, class_score is all-zero and sel reduces bit-exactly to
+    the pre-policy ``pref * (N + 1) + (N - idx)`` (the pinned
+    NHD_POLICY=0 control). int32 headroom: score <= 255 (SCORE_QUANT),
+    pref <= 2, so sel stays in-range past a 2M-row node axis — far
+    beyond the streaming tiler's per-solve tile bound."""
+    idx = jnp.clip(
+        node_class.astype(jnp.int32), 0, class_score.shape[1] - 1
+    )
+    score = jnp.take(class_score, idx, axis=1)  # [T, N]
+    return pref + 3 * score
+
+
 def rank_cap(accelerator: bool) -> int:
     """Ceiling for the top-R rank width.
 
@@ -365,7 +394,7 @@ def get_ranked_solver(G: int, U: int, K: int, R: int):
     into the rank's top_k/gather inputs and dead-code-eliminates outputs
     the rank never reads (n_combos), where the old two-program pipeline
     materialized all seven SolveOut tensors between dispatches. Takes
-    the 14 node arrays (``_ARG_ORDER``) followed by the 9 pod-type
+    the 15 node arrays (``_ARG_ORDER``) followed by the 10 pod-type
     arrays (``_POD_ARG_ORDER``); returns the packed [9, T, R] int32 rank
     tensor (RankOut order). This is THE production program — the AOT
     layer (solver/aot.py) exports and prewarm-loads exactly this
@@ -374,11 +403,14 @@ def get_ranked_solver(G: int, U: int, K: int, R: int):
     i_hp = _ARG_ORDER.index("hp_free")
     i_cpu = _ARG_ORDER.index("cpu_free")
     i_gpu = _ARG_ORDER.index("gpu_free")
+    i_nc = _ARG_ORDER.index("node_class")
+    i_cs = len(_ARG_ORDER) + _POD_ARG_ORDER.index("class_score")
 
     def fn(*args):
         out = _solve(tables, *args)
+        pref = _policy_pref(out.pref, args[i_nc], args[i_cs])
         return _rank_body(
-            R, out.cand, out.pref, out.best_c, out.best_m, out.best_a,
+            R, out.cand, pref, out.best_c, out.best_m, out.best_a,
             out.n_picks, args[i_gpu], args[i_cpu], args[i_hp],
         )
 
@@ -419,8 +451,8 @@ def mesh_shardings(mesh):
 @lru_cache(maxsize=None)
 def get_ranked_solver_mesh(G: int, U: int, K: int, R: int, mesh):
     """The fused solve+rank megaround (get_ranked_solver) lowered onto a
-    device mesh: the 14 node arrays shard along the ``nodes`` axis, the
-    9 pod-type arrays replicate, and the packed [9, T, R] rank tensor
+    device mesh: the 15 node arrays shard along the ``nodes`` axis, the
+    10 pod-type arrays replicate, and the packed [9, T, R] rank tensor
     comes back replicated — the top-k over the sharded node axis is the
     one collective GSPMD inserts. SAME program text as the single-device
     megaround, so mesh results are bit-exact with it by construction
@@ -436,11 +468,14 @@ def get_ranked_solver_mesh(G: int, U: int, K: int, R: int, mesh):
     i_hp = _ARG_ORDER.index("hp_free")
     i_cpu = _ARG_ORDER.index("cpu_free")
     i_gpu = _ARG_ORDER.index("gpu_free")
+    i_nc = _ARG_ORDER.index("node_class")
+    i_cs = len(_ARG_ORDER) + _POD_ARG_ORDER.index("class_score")
 
     def fn(*args):
         out = _solve(tables, *args)
+        pref = _policy_pref(out.pref, args[i_nc], args[i_cs])
         return _rank_body(
-            R, out.cand, out.pref, out.best_c, out.best_m, out.best_a,
+            R, out.cand, pref, out.best_c, out.best_m, out.best_a,
             out.n_picks, args[i_gpu], args[i_cpu], args[i_hp],
         )
 
@@ -479,7 +514,7 @@ def dispatch_ranked(G, U, K, R, Tp, Np, args, mesh=None) -> jax.Array:
     shape: the AOT prewarm cache first (zero-cold-start — the program
     was deserialized from StableHLO and compiled at daemon start), else
     the live jit, which is exported back to the AOT artifact cache when
-    saving is on (solver/aot.py). ``args`` is the full 23-array
+    saving is on (solver/aot.py). ``args`` is the full 25-array
     positional list; host and device-resident callers share this single
     entry so their programs (and AOT artifacts) are one and the same.
     With ``mesh`` the SAME fused program runs SPMD over the node axis
@@ -522,7 +557,7 @@ def _pad_rows_to(a: np.ndarray, size: int) -> np.ndarray:
 
 
 def padded_args(cluster, pods, Tp: int, Np: int) -> list:
-    """The 23 padded solver arguments (node arrays in ``_ARG_ORDER``,
+    """The 25 padded solver arguments (node arrays in ``_ARG_ORDER``,
     then pod arrays in ``_POD_ARG_ORDER``) — the one place the host
     padding rule lives."""
     return [

@@ -144,9 +144,9 @@ def _get_megaround(
 
     Args (all device arrays):
       mutable: dict of the 6 claim-mutated node arrays (device_state)
-      static:  dict of the 8 never-mutated node arrays
+      static:  dict of the 9 never-mutated node arrays
       need:    [sum(Tp)] int32 — pending pod count per global type row
-      *pod_args: 9 padded pod-type arrays per bucket, flattened in
+      *pod_args: 10 padded pod-type arrays per bucket, flattened in
                  bucket order (device_state._pod_args layout)
 
     Returns (new_mutable, claims [iters, N] int32 packed words, need_left).
@@ -175,8 +175,15 @@ def _get_megaround(
         # of the loop so each iteration only re-solves and re-elects
         per_bucket = []
         for b, (tb, (G, Tp)) in enumerate(zip(tables, bucket_shapes)):
+            # 10-array pod stride (kernel._POD_ARG_ORDER): class_score
+            # is the policy engine's score-term input, unused here — the
+            # megaround claims on feasibility, so batch.py disables
+            # speculation whenever a non-uniform scoring matrix is live
+            # (round-0 claims must not bypass the policy ranking)
             (cpu_dem_smt, cpu_dem_raw, gpu_dem, rx, tx, hp, needs_gpu,
-             map_pci, group_mask) = pod_args[9 * b : 9 * b + 9]
+             map_pci, group_mask, _class_score) = (
+                pod_args[10 * b : 10 * b + 10]
+            )
             combo_onehot = jnp.asarray(tb.combo_onehot)
             choose = jnp.asarray(tb.choose_onehot)
             misc = jnp.asarray(tb.misc_onehot)
@@ -195,7 +202,7 @@ def _get_megaround(
                 "tg,caguk->tcauk", needs_nic_g, choose
             ).reshape(Tp, tb.C * tb.A, U, K)
             per_bucket.append(dict(
-                pod_args=pod_args[9 * b : 9 * b + 9],
+                pod_args=pod_args[10 * b : 10 * b + 10],
                 G=G, C=tb.C, A=tb.A,
                 nic_occ=(occ_slots > 0).astype(f32).sum(-1),  # [Tp,C*A,U]
                 # per-(u, k) GPU demand at (combo, pick), PCI types only:

@@ -23,6 +23,10 @@ class FencedScheduler:
             return False
         return self._commit_write(self.backend.bind_pod_to_node, pod, node, ns)
 
+    def preempt(self, pod, ns):
+        # the policy engine's eviction rides the same chokepoint
+        return self._commit_write(self.backend.evict_pod, pod, ns)
+
     def observe(self, pod, ns):
         # reads and the idempotent audit trail are out of the rule's scope
         self.backend.generate_pod_event(pod, ns, "Scheduling", None, "msg")

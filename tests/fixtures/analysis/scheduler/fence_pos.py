@@ -29,3 +29,10 @@ def free_function(sched, pod, ns, node):
 def bare_backend_param(backend, pod, ns, node):
     # a helper taking the backend directly must not evade the rule
     return backend.bind_pod_to_node(pod, node, ns)           # EXPECT[NHD501]
+
+
+def raw_eviction(sched, pod, ns):
+    # policy preemption: an unfenced eviction is the preemption analog
+    # of the double-bind hole — a deposed leader evicting a victim the
+    # new leader just placed
+    return sched.backend.evict_pod(pod, ns)                  # EXPECT[NHD501]

@@ -1,0 +1,580 @@
+"""Policy engine (ISSUE 15): heterogeneity scoring, tiers, preemption.
+
+The standing contracts:
+
+* ``NHD_POLICY=0`` is INERT — score rows are all-zero, the fused ranking
+  value reduces bit-exactly to the pre-policy formula, and placements
+  match the serial oracle across solve postures (classic host,
+  device-resident + speculative, mesh-sharded) exactly as the pre-policy
+  suites pin them.
+* a uniform matrix is placement-NEUTRAL by construction (constant
+  per-type shift of the ranking value cannot reorder nodes);
+* a non-uniform matrix reorders placements toward the fast class, and
+  flipping the matrix flips the placement;
+* preemption victim selection is deterministic under a fixed seed,
+  never exceeds the round/tenant budgets, and never selects a victim at
+  or above the preemptor's tier;
+* every eviction rides the fenced ``_commit_write`` chokepoint — a
+  deposed leader's in-flight preemption is fenced out (the HA cell);
+* the policy-chaos invariant checkers actually FIRE (negative control).
+"""
+
+from __future__ import annotations
+
+import queue
+
+import numpy as np
+import pytest
+
+from nhd_tpu.core.request import CpuRequest, GroupRequest, PodRequest
+from nhd_tpu.core.topology import MapMode, SmtMode
+from nhd_tpu.k8s.fake import FakeClusterBackend
+from nhd_tpu.k8s.interface import LEASE_NAME
+from nhd_tpu.obs.recorder import FlightRecorder
+from nhd_tpu.policy import (
+    preempt_pairs,
+    reset_policy_metrics,
+)
+from nhd_tpu.policy.preempt import (
+    PreemptBudget,
+    plan_preemption,
+    round_budget,
+)
+from nhd_tpu.policy.scoring import score_row, set_matrix
+from nhd_tpu.scheduler.core import Scheduler
+from nhd_tpu.scheduler.events import WatchQueue
+from nhd_tpu.sim.synth import (
+    SynthNodeSpec,
+    make_cluster,
+    make_node_labels,
+    make_triad_config,
+)
+from nhd_tpu.solver.batch import BatchItem, BatchScheduler
+from nhd_tpu.solver.oracle import find_node
+
+
+def _req(gpus=1, proc=4, hp=2, tier=0, groups=frozenset({"default"})):
+    return PodRequest(
+        groups=(GroupRequest(
+            proc=CpuRequest(proc, SmtMode.ON),
+            misc=CpuRequest(1, SmtMode.ON),
+            gpus=gpus, nic_rx_gbps=10.0, nic_tx_gbps=5.0,
+        ),),
+        misc=CpuRequest(1, SmtMode.ON),
+        hugepages_gb=hp, map_mode=MapMode.NUMA,
+        node_groups=groups, tier=tier,
+    ).interned()
+
+
+def _mixed_cluster(n=6):
+    """Small fleet whose classes cycle gen-a/gen-b/gen-c."""
+    nodes = {}
+    for i in range(n):
+        spec = SynthNodeSpec(
+            name=f"node{i:03d}",
+            node_class=("gen-a", "gen-b", "gen-c")[i % 3],
+        )
+        from nhd_tpu.sim.synth import make_node
+
+        nodes[spec.name] = make_node(spec)
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# NHD_POLICY=0: inert by construction
+# ---------------------------------------------------------------------------
+
+def test_score_rows_zero_with_policy_off(monkeypatch):
+    monkeypatch.delenv("NHD_POLICY", raising=False)
+    assert not score_row(_req()).any()
+    monkeypatch.setenv("NHD_POLICY", "0")
+    assert not score_row(_req()).any()
+
+
+@pytest.mark.parametrize("posture", ["classic", "spec", "mesh"])
+def test_policy_off_matches_oracle_across_postures(monkeypatch, posture):
+    """With the policy off, single-pod placements on a mixed-class fleet
+    match the serial oracle — the node_class/class_score arrays ride the
+    25-array signature without perturbing a single decision."""
+    monkeypatch.setenv("NHD_POLICY", "0")
+    kwargs = {}
+    if posture == "spec":
+        monkeypatch.setenv("NHD_TPU_DEVICE_STATE", "1")
+        monkeypatch.setenv("NHD_TPU_SPECULATE", "1")
+    elif posture == "mesh":
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        from nhd_tpu.parallel.sharding import make_mesh
+
+        kwargs = {"mesh": make_mesh(jax.devices()[:8]),
+                  "device_state": True}
+    reqs = [_req(gpus=g, proc=p) for g, p in ((1, 4), (0, 6), (1, 2))]
+    for r in reqs:
+        nodes = _mixed_cluster()
+        expect = find_node(nodes, r, now=0.0, respect_busy=False)
+        sched = BatchScheduler(
+            respect_busy=False, register_pods=False, **kwargs
+        )
+        results, _stats = sched.schedule(
+            _mixed_cluster(), [BatchItem(("ns", "p"), r)], now=0.0
+        )
+        assert results[0].node == (expect.node if expect else None)
+
+
+def test_uniform_matrix_is_placement_neutral(monkeypatch):
+    """NHD_POLICY=1 with the uniform matrix must place identically to
+    the policy-off run: a constant per-type score shift cannot reorder
+    nodes."""
+    reqs = [_req(gpus=i % 2, proc=3 + i % 3) for i in range(12)]
+    items = [BatchItem(("ns", f"p{i}"), r) for i, r in enumerate(reqs)]
+
+    monkeypatch.setenv("NHD_POLICY", "0")
+    base, _ = BatchScheduler(respect_busy=False).schedule(
+        _mixed_cluster(), items, now=0.0
+    )
+    monkeypatch.setenv("NHD_POLICY", "1")
+    set_matrix({})
+    try:
+        uni, _ = BatchScheduler(respect_busy=False).schedule(
+            _mixed_cluster(), items, now=0.0
+        )
+    finally:
+        set_matrix(None)
+    assert [r.node for r in base] == [r.node for r in uni]
+
+
+# ---------------------------------------------------------------------------
+# matrix scoring reorders placements
+# ---------------------------------------------------------------------------
+
+def test_matrix_scoring_prefers_fast_class_and_flips(monkeypatch):
+    monkeypatch.setenv("NHD_POLICY", "1")
+    r = _req()
+    try:
+        set_matrix({"gpu": {"gen-a": 0.3, "gen-b": 1.0}})
+        nodes = _mixed_cluster(2)  # node000=gen-a, node001=gen-b
+        res, _ = BatchScheduler(respect_busy=False).schedule(
+            nodes, [BatchItem(("ns", "p"), r)], now=0.0
+        )
+        assert res[0].node == "node001"
+        set_matrix({"gpu": {"gen-a": 1.0, "gen-b": 0.3}})
+        nodes = _mixed_cluster(2)
+        res, _ = BatchScheduler(respect_busy=False).schedule(
+            nodes, [BatchItem(("ns", "p"), r)], now=0.0
+        )
+        assert res[0].node == "node000"
+    finally:
+        set_matrix(None)
+
+
+def test_explain_reports_policy_scores(monkeypatch):
+    monkeypatch.setenv("NHD_POLICY", "1")
+    try:
+        set_matrix({"gpu": {"gen-a": 1.0, "gen-b": 0.5}})
+        from nhd_tpu.solver.explain import explain
+
+        rep = explain(_mixed_cluster(3), _req(tier=2), respect_busy=False)
+        assert rep.policy is not None
+        assert rep.policy["tier"] == 2
+        assert rep.policy["score_mode"] == 2
+        classes = {s["class"] for s in rep.policy["scores"].values()}
+        assert "gen-a" in classes
+        assert "policy:" in rep.render()
+    finally:
+        set_matrix(None)
+
+
+# ---------------------------------------------------------------------------
+# preemption planning: deterministic, budgeted, tier-safe
+# ---------------------------------------------------------------------------
+
+def _filled_mirror(seed=0):
+    """A small saturated mirror: tier-0 pods bound via the batch path
+    (register_pods fills node.pod_info, which the planner releases)."""
+    import random
+
+    rng = random.Random(seed)
+    nodes = make_cluster(
+        3, SynthNodeSpec(phys_cores=8, gpus_per_numa=1, hugepages_gb=8)
+    )
+    sched = BatchScheduler(respect_busy=False, register_pods=True)
+    items = [
+        BatchItem(("t" + str(rng.randrange(2)), f"low{i}"), _req(hp=4, gpus=0))
+        for i in range(6)
+    ]
+    results, _ = sched.schedule(nodes, items, now=0.0)
+    pod_tiers = {}
+    for it, r in zip(items, results):
+        if r.node is not None:
+            pod_tiers[it.key] = (0, float(rng.randrange(100)))
+    return nodes, pod_tiers
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_plan_preemption_deterministic_and_budgeted(seed):
+    nodes, pod_tiers = _filled_mirror(seed)
+    req = _req(hp=4, gpus=0, tier=2)
+    budget = PreemptBudget.fresh()
+    before = {
+        name: (n.mem.free_hugepages_gb,
+               sum(1 for c in n.cores if c.used))
+        for name, n in nodes.items()
+    }
+    plan1, why1 = plan_preemption(
+        nodes, req, 2, pod_tiers, budget, respect_busy=False
+    )
+    plan2, why2 = plan_preemption(
+        nodes, req, 2, pod_tiers, PreemptBudget.fresh(), respect_busy=False
+    )
+    # planning is pure: the probe released and re-claimed exactly
+    after = {
+        name: (n.mem.free_hugepages_gb,
+               sum(1 for c in n.cores if c.used))
+        for name, n in nodes.items()
+    }
+    assert before == after
+    assert why1 == why2
+    if plan1 is None:
+        assert plan2 is None
+        return
+    assert plan1.node == plan2.node
+    assert plan1.victims == plan2.victims
+    assert len(plan1.victims) <= round_budget()
+    per_ns = {}
+    for ns, _pod, tier in plan1.victims:
+        assert tier < 2
+        per_ns[ns] = per_ns.get(ns, 0) + 1
+    assert all(v <= budget.tenant_cap for v in per_ns.values())
+
+
+def test_budget_refusal_reports_exhausted():
+    nodes, pod_tiers = _filled_mirror(0)
+    req = _req(hp=4, gpus=0, tier=2)
+    # a zero budget refuses every plan — and says WHY
+    plan, why = plan_preemption(
+        nodes, req, 2, pod_tiers,
+        PreemptBudget(round_left=0, tenant_cap=0), respect_busy=False,
+    )
+    assert plan is None
+    assert why == "budget-exhausted"
+
+
+# ---------------------------------------------------------------------------
+# scheduler end-to-end: fenced evict, unwind, requeue, corr journey
+# ---------------------------------------------------------------------------
+
+def _policy_sched(n_nodes=1, recorder=None, elector=None):
+    backend = FakeClusterBackend()
+    for i in range(n_nodes):
+        spec = SynthNodeSpec(
+            name=f"pn{i}", phys_cores=8, gpus_per_numa=1, hugepages_gb=8,
+            node_class="gen-a",
+        )
+        backend.add_node(
+            spec.name, make_node_labels(spec), hugepages_gb=8
+        )
+    sched = Scheduler(
+        backend, WatchQueue(), queue.Queue(), respect_busy=False,
+        recorder=recorder, elector=elector,
+    )
+    sched.build_initial_node_list()
+    return backend, sched
+
+
+def test_preempt_end_to_end_corr_journey(monkeypatch):
+    monkeypatch.setenv("NHD_POLICY", "1")
+    reset_policy_metrics()
+    rec = FlightRecorder(capacity=512, identity="t")
+    backend, sched = _policy_sched(recorder=rec)
+    cfg = make_triad_config(cpu_workers=2, hugepages_gb=4)
+    low = backend.create_pod("low", cfg_text=cfg, tier=0)
+    sched.attempt_scheduling_batch([(low.name, low.namespace, low.uid)])
+    assert backend.pods[("default", "low")].node == "pn0"
+
+    high = backend.create_pod("high", cfg_text=cfg, tier=2)
+    sched.attempt_scheduling_batch([(high.name, high.namespace, high.uid)])
+    # the fenced eviction landed and was logged
+    assert [e[:2] for e in backend.evict_log] == [("default", "low")]
+    # drain: preemptor binds FIRST (FIFO — a victim requeued ahead of it
+    # would re-take the freed capacity), then the victim resolves
+    for _ in range(12):
+        if sched.nqueue.empty():
+            break
+        sched.run_once()
+    assert backend.pods[("default", "high")].node == "pn0"
+    assert backend.pods[("default", "low")].node is None
+    # explicit verdict for the victim (cluster full: unschedulable)
+    assert any(
+        e.pod == "low" and e.reason == "FailedScheduling"
+        for e in backend.events
+    )
+    # one corr ID per journey: the victim's scheduled → preempted →
+    # verdict decisions all carry the corr its first bind recorded
+    decs = rec.recent_decisions(100)
+    low_corrs = {
+        d["corr"] for d in decs if d["pod"] == "low" and d["corr"]
+    }
+    assert len(low_corrs) == 1
+    outcomes = [d["outcome"] for d in decs if d["pod"] == "low"]
+    assert "scheduled" in outcomes and "preempted" in outcomes
+    # the preemptor's decision carries the victim set + budget state
+    pre = [d for d in decs if d["outcome"] == "preempt-requeued"]
+    assert pre and pre[0]["victims"][0]["pod"] == "default/low"
+    assert "round_left" in pre[0]["budget"]
+    assert preempt_pairs() == [(2, 0)]
+
+
+def test_gpu_preemptor_rebinds_under_busy_backoff(monkeypatch):
+    """The freed node must be immediately claimable by a GPU preemptor
+    under respect_busy=True: the victim release does NOT stamp the node
+    busy (a stamped node is infeasible for GPU pods for MIN_BUSY_SECS —
+    evicting victims and then hiding the freed capacity from the pod it
+    was freed for would self-defeat the whole path)."""
+    monkeypatch.setenv("NHD_POLICY", "1")
+    reset_policy_metrics()
+    backend = FakeClusterBackend()
+    spec = SynthNodeSpec(
+        name="pn0", phys_cores=8, gpus_per_numa=1, hugepages_gb=8,
+        node_class="gen-a",
+    )
+    backend.add_node(spec.name, make_node_labels(spec), hugepages_gb=8)
+    sched = Scheduler(
+        backend, WatchQueue(), queue.Queue(), respect_busy=True,
+    )
+    sched.build_initial_node_list()
+    cfg = make_triad_config(cpu_workers=2, hugepages_gb=4, gpus_per_group=1)
+    low = backend.create_pod("low", cfg_text=cfg, tier=0)
+    sched.attempt_scheduling_batch([(low.name, low.namespace, low.uid)])
+    assert backend.pods[("default", "low")].node == "pn0"
+    # age out the bind-time busy stamp (the reference's placement
+    # rate-limit, not the preemption path under test)
+    for n in sched.nodes.values():
+        n._busy_time = float("-inf")
+    high = backend.create_pod("high", cfg_text=cfg, tier=2)
+    sched.attempt_scheduling_batch([(high.name, high.namespace, high.uid)])
+    assert [e[:2] for e in backend.evict_log] == [("default", "low")]
+    for _ in range(12):
+        if sched.nqueue.empty():
+            break
+        sched.run_once()
+    # the GPU preemptor landed on the freed node IMMEDIATELY — no
+    # MIN_BUSY_SECS window hid the capacity
+    assert backend.pods[("default", "high")].node == "pn0"
+
+
+def test_preempt_tier_ordering_never_evicts_equal_or_higher(monkeypatch):
+    monkeypatch.setenv("NHD_POLICY", "1")
+    reset_policy_metrics()
+    backend, sched = _policy_sched()
+    cfg = make_triad_config(cpu_workers=2, hugepages_gb=4)
+    mid = backend.create_pod("mid", cfg_text=cfg, tier=2)
+    sched.attempt_scheduling_batch([(mid.name, mid.namespace, mid.uid)])
+    same = backend.create_pod("same", cfg_text=cfg, tier=2)
+    sched.attempt_scheduling_batch([(same.name, same.namespace, same.uid)])
+    # equal tier: no eviction, plain unschedulable verdict
+    assert not backend.evict_log
+    assert any(
+        e.pod == "same" and e.reason == "FailedScheduling"
+        for e in backend.events
+    )
+
+
+def test_preempt_budget_bounds_one_batch(monkeypatch):
+    monkeypatch.setenv("NHD_POLICY", "1")
+    monkeypatch.setenv("NHD_POLICY_PREEMPT_ROUND_BUDGET", "1")
+    reset_policy_metrics()
+    backend, sched = _policy_sched(n_nodes=2)
+    cfg = make_triad_config(cpu_workers=2, hugepages_gb=4)
+    batch = []
+    for i in range(4):
+        p = backend.create_pod(f"low{i}", cfg_text=cfg, tier=0)
+        batch.append((p.name, p.namespace, p.uid))
+    sched.attempt_scheduling_batch(batch)
+    bound_before = len(backend.bind_log)
+    assert bound_before >= 2
+    batch = []
+    for i in range(3):
+        p = backend.create_pod(f"high{i}", cfg_text=cfg, tier=2)
+        batch.append((p.name, p.namespace, p.uid))
+    sched.attempt_scheduling_batch(batch)
+    # ONE batch may evict at most the round budget
+    assert len(backend.evict_log) <= 1
+
+
+def test_deposed_leader_preemption_is_fenced_out(monkeypatch):
+    """The HA cell: a deposed leader's in-flight preemption must not
+    land — the backend rejects the stale-epoch evict, the victim keeps
+    its binding AND its mirror claims."""
+    from nhd_tpu.k8s.lease import LeaderElector
+
+    monkeypatch.setenv("NHD_POLICY", "1")
+    reset_policy_metrics()
+    backend = FakeClusterBackend()
+    spec = SynthNodeSpec(
+        name="pn0", phys_cores=8, gpus_per_numa=1, hugepages_gb=8,
+        node_class="gen-a",
+    )
+    backend.add_node(spec.name, make_node_labels(spec), hugepages_gb=8)
+    elector = LeaderElector(backend, identity="a", ttl=60.0)
+    elector.tick()
+    assert elector.is_leader
+    sched = Scheduler(
+        backend, WatchQueue(), queue.Queue(), respect_busy=False,
+        elector=elector,
+    )
+    sched.build_initial_node_list()
+    cfg = make_triad_config(cpu_workers=2, hugepages_gb=4)
+    low = backend.create_pod("low", cfg_text=cfg, tier=0)
+    sched.attempt_scheduling_batch([(low.name, low.namespace, low.uid)])
+    assert backend.pods[("default", "low")].node == "pn0"
+    # a rival acquisition bumps the epoch behind this replica's back —
+    # the replica still BELIEVES it leads (the split-brain window)
+    backend.leases[LEASE_NAME].epoch += 1
+    high = backend.create_pod("high", cfg_text=cfg, tier=2)
+    sched.attempt_scheduling_batch([(high.name, high.namespace, high.uid)])
+    # the eviction was fenced out: no log entry, victim still bound,
+    # mirror claims intact
+    assert not backend.evict_log
+    assert backend.pods[("default", "low")].node == "pn0"
+    assert sched.nodes["pn0"].pod_present("low", "default")
+    assert not preempt_pairs()
+
+
+# ---------------------------------------------------------------------------
+# chaos cells: fast positive + the negative control
+# ---------------------------------------------------------------------------
+
+def test_policy_chaos_fast_cell(monkeypatch):
+    monkeypatch.setenv("NHD_POLICY", "1")
+    from nhd_tpu.sim.chaos import ChaosSim
+
+    sim = ChaosSim(seed=3, n_nodes=4, policy="mixed-gen")
+    sim.run(steps=15)
+    sim.quiesce()
+    assert sim.stats.violations == []
+    assert sim.stuck_pods() == []
+    assert sim.policy_victims_unresolved() == []
+
+
+def test_policy_chaos_control_cell(monkeypatch):
+    monkeypatch.setenv("NHD_POLICY", "0")
+    from nhd_tpu.sim.chaos import ChaosSim
+
+    sim = ChaosSim(seed=3, n_nodes=4, policy="mixed-gen", policy_off=True)
+    sim.run(steps=15)
+    sim.quiesce()
+    assert sim.stats.violations == []
+    assert sim.base.evict_log == []
+
+
+def test_policy_invariants_fire_negative_control(monkeypatch):
+    """The checkers must DETECT violations, not just pass clean runs:
+    an over-budget eviction burst, a cascade, and a tier inversion each
+    trip their invariant."""
+    monkeypatch.setenv("NHD_POLICY", "1")
+    from nhd_tpu import policy as pol
+    from nhd_tpu.sim.chaos import (
+        POLICY_CASCADE_BOUND,
+        POLICY_PASSES_PER_STEP,
+        ChaosSim,
+    )
+    from nhd_tpu.policy.preempt import round_budget as rb
+
+    reset_policy_metrics()
+    sim = ChaosSim(seed=0, n_nodes=3, policy="mixed-gen")
+    # per-step bound: a burst past round_budget × passes trips
+    burst = rb() * POLICY_PASSES_PER_STEP + 1
+    sim.base.evict_log.extend(
+        ("default", f"x{i}", f"u{i}", "node0", None, None)
+        for i in range(burst)
+    )
+    sim._check_policy_invariants()
+    assert any("per-step bound" in v for v in sim.stats.violations)
+    # cascade: one pod evicted past the bound
+    sim.stats.violations.clear()
+    sim.base.evict_log[:] = [
+        ("default", "same", "u", "node0", None, None)
+    ] * (POLICY_CASCADE_BOUND + 1)
+    sim._check_policy_invariants()
+    assert any("cascade" in v for v in sim.stats.violations)
+    # tier inversion: victim tier >= preemptor tier
+    sim.stats.violations.clear()
+    sim.base.evict_log.clear()
+    sim._evicts_seen = 0
+    pol.note_preemption(1, 2)
+    sim._check_policy_invariants()
+    assert any("tier inversion" in v for v in sim.stats.violations)
+    reset_policy_metrics()
+
+
+# ---------------------------------------------------------------------------
+# metrics + fleet payload
+# ---------------------------------------------------------------------------
+
+def test_policy_metrics_render_and_fleet_payload(monkeypatch):
+    monkeypatch.setenv("NHD_POLICY", "1")
+    from nhd_tpu import policy as pol
+    from nhd_tpu.rpc.metrics import render_metrics
+
+    reset_policy_metrics()
+    pol.note_preemption(2, 0)
+    pol.note_preemption(2, 1)
+    try:
+        set_matrix({"gpu": {"gen-a": 1.0}})
+        text = render_metrics([], 0)
+    finally:
+        set_matrix(None)
+    assert "nhd_policy_preemptions_total" in text
+    assert 'nhd_policy_preemptions_by_tier_total{tier="0"} 1' in text
+    assert 'nhd_policy_preemptions_by_tier_total{tier="1"} 1' in text
+    assert "nhd_policy_score_mode 2" in text
+
+    from nhd_tpu.obs.fleet import build_fleet_artifact, replica_view
+
+    art = build_fleet_artifact(
+        [replica_view("r1")],
+        counters={"policy_preemptions_total": 3, "policy_score_mode": 2},
+    )
+    assert art["payload"]["policy"]["preemptions_total"] == 3
+    assert art["payload"]["policy"]["score_mode"] == 2
+    reset_policy_metrics()
+
+
+def test_tier_label_vocabulary_is_bounded():
+    from nhd_tpu.policy import MAX_TIER_LABEL, preempt_tier_snapshot
+
+    reset_policy_metrics()
+    from nhd_tpu import policy as pol
+
+    pol.note_preemption(99, 42)
+    snap = preempt_tier_snapshot()
+    assert set(snap) == {MAX_TIER_LABEL}
+    reset_policy_metrics()
+
+
+# ---------------------------------------------------------------------------
+# encode/delta: node_class rides the incremental state
+# ---------------------------------------------------------------------------
+
+def test_node_class_rides_delta_parity(monkeypatch):
+    """A class-labeled node patched through the delta layer stays
+    bit-exact with a from-scratch encode (node_class is a DELTA_FIELDS
+    member like every other per-row array)."""
+    from nhd_tpu.solver.encode import ClusterDelta
+
+    nodes = _mixed_cluster(4)
+    delta = ClusterDelta(nodes, respect_busy=False)
+    assert delta.parity_errors() == []
+    # label reparse re-classes a node → generation rebuild, still exact
+    name = next(iter(nodes))
+    spec = SynthNodeSpec(name=name, node_class="gen-z")
+    nodes[name].parse_labels(make_node_labels(spec))
+    delta.note(name)
+    delta.refresh()
+    assert delta.parity_errors() == []
+    from nhd_tpu.policy.classes import CLASSES
+
+    row = delta.arrays.names.index(name)
+    assert delta.arrays.node_class[row] == CLASSES.index("gen-z")

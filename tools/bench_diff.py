@@ -154,6 +154,53 @@ def _spmd_gates(
                 )
 
 
+def _hetero_gates(
+    name: str, o: dict, n: dict, threshold: float, lines, regressions
+) -> None:
+    """Heterogeneity-policy configs (a ``hetero`` section in the NEW
+    record — cfg8:hetero / policy-smoke): the strict-improvement flag is
+    a hard gate (matrix scoring must beat uniform scoring on the mixed
+    fleet — the ISSUE 15 acceptance bar), as is a live preemption cell
+    (zero evictions means the path went dead); the aggregate
+    placed-throughput figure gates relatively when both sides carry the
+    section."""
+    nc = n.get("hetero")
+    if not isinstance(nc, dict):
+        return
+    imp = float(nc.get("improvement_pct", 0.0) or 0.0)
+    if imp <= 0.0:
+        lines.append(
+            f"{name:>24} hetero improvement: {imp:+.1f}% <-- REGRESSION"
+        )
+        regressions.append(
+            f"{name} heterogeneity scoring no longer improves aggregate "
+            f"placed throughput ({imp:+.1f}% vs uniform; must be > 0)"
+        )
+    if float(nc.get("preemptions", 0) or 0) <= 0:
+        lines.append(f"{name:>24} preemptions: 0 <-- REGRESSION")
+        regressions.append(
+            f"{name} preemption micro-cell executed zero evictions "
+            "(the bounded-preemption path went dead)"
+        )
+    oc = o.get("hetero")
+    if isinstance(oc, dict):
+        ov = float(oc.get("placed_tput_policy", 0.0) or 0.0)
+        nv = float(nc.get("placed_tput_policy", 0.0) or 0.0)
+        if ov > 0:
+            d = _pct(ov, nv)
+            fatal = -d > threshold
+            mark = " <-- REGRESSION" if fatal else ""
+            lines.append(
+                f"{name:>24} placed tput: {ov:8.1f} -> {nv:8.1f} "
+                f"({d:+.1%}){mark}"
+            )
+            if fatal:
+                regressions.append(
+                    f"{name} policy placed-throughput dropped {d:+.1%} "
+                    f"({ov:.1f} -> {nv:.1f}, threshold {threshold:.0%})"
+                )
+
+
 #: a wall regression is fatal only when BOTH the relative threshold and
 #: this absolute growth (seconds) are exceeded: at small scales the
 #: figure is scheduler fixed overhead + host jitter (a 3 ms blip on a
@@ -202,6 +249,7 @@ def diff_artifacts(
             # wall IS the inverse of the sustained rate)
             _churn_gates(name, o, n, threshold, lines, regressions)
         _spmd_gates(name, o, n, threshold, lines, regressions)
+        _hetero_gates(name, o, n, threshold, lines, regressions)
         cfg_threshold = (
             threshold * 2 if name in LATENCY_CONFIGS else threshold
         )

@@ -45,8 +45,84 @@ from nhd_tpu.utils import force_cpu_backend  # noqa: E402
 force_cpu_backend()
 
 
+def _run_policy_cell(args, profile: str, seed: int) -> dict:
+    """One policy-storm cell (make policy-chaos): the CONTROL run first —
+    the same storm (same rng draws, same tier annotations, same mixed
+    fleet) with NHD_POLICY=0, which must behave exactly like the
+    pre-policy scheduler (zero evictions, zero violations — the
+    bit-exactness control at storm scale) — then the policy run under
+    NHD_POLICY=1 with the preemption-bound / no-cascade / tier-inversion
+    / victim-rebind invariants live (sim/chaos.py)."""
+    from nhd_tpu import policy as pol
+    from nhd_tpu.sim.chaos import ChaosSim
+
+    # main() is test-callable: the policy toggles must not leak into the
+    # calling process (policy.enabled() is re-read per call everywhere,
+    # so a leaked NHD_POLICY=1 would silently flip every later test)
+    prior = os.environ.get("NHD_POLICY")
+    try:
+        return _run_policy_cell_inner(args, profile, seed, pol, ChaosSim)
+    finally:
+        if prior is None:
+            os.environ.pop("NHD_POLICY", None)
+        else:
+            os.environ["NHD_POLICY"] = prior
+
+
+def _run_policy_cell_inner(args, profile: str, seed: int, pol, ChaosSim):
+    os.environ["NHD_POLICY"] = "0"
+    pol.reset_policy_metrics()
+    control = ChaosSim(
+        seed=seed, n_nodes=args.nodes, policy=profile, policy_off=True,
+    )
+    control.run(steps=args.steps)
+    control.quiesce()
+    control_violations = [
+        f"policy-off control: {v}" for v in control.stats.violations
+    ]
+    if control.base.evict_log:
+        control_violations.append(
+            f"policy-off control executed {len(control.base.evict_log)} "
+            "eviction(s)"
+        )
+    if control.stuck_pods():
+        control_violations.append(
+            f"policy-off control stuck pods: {control.stuck_pods()}"
+        )
+
+    os.environ["NHD_POLICY"] = "1"
+    pol.reset_policy_metrics()
+    sim = ChaosSim(seed=seed, n_nodes=args.nodes, policy=profile)
+    stats = sim.run(steps=args.steps)
+    sim.quiesce()
+    stuck = sim.stuck_pods()
+    violations = list(stats.violations) + control_violations
+    return {
+        "profile": profile,
+        "seed": seed,
+        "nodes": args.nodes,
+        "steps": args.steps,
+        "mode": "policy",
+        "ok": not violations and not stuck,
+        "violations": violations,
+        "stuck_pods": [list(k) for k in stuck],
+        "faults_injected": {},
+        "lease_epoch": 0,
+        "max_leader_gap": 0,
+        "evictions": len(sim.base.evict_log),
+        "preempt_by_tier": {
+            str(t): n for t, n in sorted(pol.preempt_tier_snapshot().items())
+        },
+        "victims_unresolved": [
+            list(k) for k in sim.policy_victims_unresolved()
+        ],
+    }
+
+
 def _run_cell(args, profile: str, seed: int) -> dict:
     """One (profile, seed) cell → its machine-readable summary record."""
+    if getattr(args, "policy", False):
+        return _run_policy_cell(args, profile, seed)
     from nhd_tpu.sim.chaos import ChaosSim
     from nhd_tpu.sim.faults import PROFILES
 
@@ -271,6 +347,16 @@ def main(argv=None) -> int:
                          "NHD_GUARD_AUDIT_ROWS=0) — the posture under "
                          "which faulted binds are provably bit-identical "
                          "to fault-free ones (make device-chaos)")
+    ap.add_argument("--policy", action="store_true",
+                    help="policy-storm mode (make policy-chaos): "
+                         "profiles are the scheduling-policy scenarios "
+                         "(sim/chaos.py POLICY_PROFILES: mixed-gen, "
+                         "quota-storm, maint-wave); each cell runs a "
+                         "NHD_POLICY=0 control (must behave exactly like "
+                         "the pre-policy scheduler: zero evictions) then "
+                         "the NHD_POLICY=1 storm under the preemption-"
+                         "bound / no-cascade / tier-inversion / victim-"
+                         "rebind invariants")
     ap.add_argument("--bind-parity", action="store_true",
                     help="run a fault-free CONTROL sim per cell (same "
                          "seed, no profile) and fail the cell unless the "
@@ -290,11 +376,26 @@ def main(argv=None) -> int:
     if args.ha and args.federation:
         print("--ha and --federation are exclusive modes")
         return 2
-    profiles = [p.strip() for p in args.profiles.split(",") if p.strip()]
-    for p in profiles:
-        if p not in PROFILES:
-            print(f"unknown profile {p!r}; have {sorted(PROFILES)}")
-            return 2
+    if args.policy and (args.ha or args.federation):
+        print("--policy runs solo mode only")
+        return 2
+    if args.policy:
+        from nhd_tpu.sim.chaos import POLICY_PROFILES
+
+        if args.profiles == "light,storm,heavy,churn":  # the default
+            args.profiles = ",".join(POLICY_PROFILES)
+        profiles = [p.strip() for p in args.profiles.split(",") if p.strip()]
+        for p in profiles:
+            if p not in POLICY_PROFILES:
+                print(f"unknown policy profile {p!r}; "
+                      f"have {sorted(POLICY_PROFILES)}")
+                return 2
+    else:
+        profiles = [p.strip() for p in args.profiles.split(",") if p.strip()]
+        for p in profiles:
+            if p not in PROFILES:
+                print(f"unknown profile {p!r}; have {sorted(PROFILES)}")
+                return 2
 
     t0 = time.time()
     cells = []
@@ -341,7 +442,8 @@ def main(argv=None) -> int:
             "start_seed": args.start_seed,
             "steps": args.steps,
             "nodes": args.nodes,
-            "mode": ("federation" if args.federation
+            "mode": ("policy" if args.policy
+                     else "federation" if args.federation
                      else "ha" if args.ha else "single"),
             "federation_shards": args.federation,
             "federation_replicas": args.replicas if args.federation else 0,

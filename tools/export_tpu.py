@@ -42,22 +42,20 @@ def build_headline_buckets():
             [a, np.zeros((size - a.shape[0], *a.shape[1:]), a.dtype)], axis=0
         )
 
+    from nhd_tpu.solver.kernel import _ARG_ORDER, _POD_ARG_ORDER
+
     out = []
     for G, pods in sorted(buckets.items()):
         T, N = pods.n_types, cluster.n_nodes
         Tp, Np = _pad_pow2(T), _pad_pow2(N)
-        args = (
-            pad0(cluster.numa_nodes, Np), pad0(cluster.smt, Np),
-            pad0(cluster.active, Np), pad0(cluster.maintenance, Np),
-            pad0(cluster.busy, Np), pad0(cluster.gpuless, Np),
-            pad0(cluster.group_mask, Np), pad0(cluster.hp_free, Np),
-            pad0(cluster.cpu_free, Np), pad0(cluster.gpu_free, Np),
-            pad0(cluster.nic_count, Np), pad0(cluster.nic_free, Np),
-            pad0(cluster.nic_sw, Np), pad0(cluster.gpu_free_sw, Np),
-            pad0(pods.cpu_dem_smt, Tp), pad0(pods.cpu_dem_raw, Tp),
-            pad0(pods.gpu_dem, Tp), pad0(pods.rx, Tp), pad0(pods.tx, Tp),
-            pad0(pods.hp, Tp), pad0(pods.needs_gpu, Tp), pad0(pods.map_pci, Tp),
-            pad0(pods.group_mask, Tp),
+        # the single argument-order contract (kernel.py): node arrays
+        # then pod-type arrays — hand-listing the tuple here is exactly
+        # how an arity change (23 → 25 for the policy score terms) went
+        # stale once
+        args = tuple(
+            pad0(getattr(cluster, name), Np) for name in _ARG_ORDER
+        ) + tuple(
+            pad0(getattr(pods, name), Tp) for name in _POD_ARG_ORDER
         )
         meta = {
             "bucket": {"G": G, "U": int(cluster.U), "K": int(cluster.K)},
