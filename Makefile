@@ -19,7 +19,7 @@ DEV_STEPS ?= 40
 POLICY_SEEDS ?= 3
 POLICY_STEPS ?= 40
 
-.PHONY: test lint sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos policy-chaos trace-demo fleet-demo docker docker-smoke release
+.PHONY: test lint lint-diff knobs-check sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos policy-chaos trace-demo fleet-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -47,6 +47,21 @@ lint:
 		echo "mypy not installed; skipping (pip install mypy)"; \
 	fi
 
+# differential lint for CI: only findings on lines changed since the
+# merge-base fail; the full run above still gates everything via the
+# baseline. SARIF artifact for code-review annotation tooling.
+# Override the base with LINT_DIFF_BASE=REV.
+LINT_DIFF_BASE ?= $(shell git merge-base HEAD origin/main 2>/dev/null \
+	|| git rev-parse HEAD~1 2>/dev/null || echo HEAD)
+lint-diff:
+	python -m nhd_tpu.analysis nhd_tpu tools tests --exclude tests/fixtures \
+		--diff-base $(LINT_DIFF_BASE) --sarif artifacts/lint/nhdlint.sarif
+
+# knob registry <-> OPERATIONS.md tunables table lockstep
+# (nhd_tpu/config/knobs.py is the source of truth; --write regenerates)
+knobs-check:
+	python tools/knobs_sync.py --check
+
 # runtime deadlock sanitizer (nhdsan, nhd_tpu/sanitizer/): the
 # concurrency-heavy suites under instrumented locks — a wait-for-graph
 # cycle fails loud with a witness instead of hanging the run
@@ -69,7 +84,7 @@ sanitize:
 # a solve-phase or first-bind regression fails fast without the full
 # cfg5 run — `make bench` remains the full sweep) + the 3-replica
 # fleet-observability drive (merged journey + validated fleet artifact)
-check: lint test
+check: lint lint-diff knobs-check test
 	$(MAKE) bench-smoke
 	$(MAKE) fleet-demo
 	$(MAKE) device-chaos

@@ -5,23 +5,32 @@ shapes, and the control plane mutates shared state from watch threads.
 The bug classes that hurt most at production scale — silent recompiles,
 host-sync stalls in the hot batch loop, off-lock state mutation,
 nondeterministic placement — are exactly the ones best caught statically.
-Four rule packs, each a visitor over stdlib ``ast`` (no third-party
+Per-file rule packs, each a visitor over stdlib ``ast`` (no third-party
 dependency, so the gate runs everywhere the tests run):
 
   tracing      NHD1xx  JAX tracing / recompile / host-sync hazards
   locks        NHD2xx  lock discipline for classes that own a Lock/RLock
   excepts      NHD3xx  exception hygiene (silently swallowed errors)
   determinism  NHD4xx  unseeded randomness / wall-clock in solver paths
+  fencing      NHD5xx  commit-fencing discipline in the control plane
+  metrics      NHD6xx  observability-surface hygiene
 
-plus one *project* pack that sees every module at once:
+plus *project* packs that see every module at once:
 
   lockgraph    NHD21x  interprocedural lock-order inversions, blocking
                        calls under locks, re-entrant Lock acquisition —
                        with DOT/JSON export of the whole-program lock
                        graph (--lock-graph-dot / --lock-graph-json)
+  contract     NHD7xx  cross-layer solve-signature contract analysis
+                       (_ARG_ORDER vs DELTA_FIELDS vs shardings vs
+                       stride math vs AOT fingerprints), donation-alias
+                       taint tracking into donate_argnums dispatches,
+                       and the NHD_* env-knob registry
+                       (nhd_tpu/config/knobs.py)
 
 Run ``python -m nhd_tpu.analysis nhd_tpu/`` or see docs/STATIC_ANALYSIS.md
-for the rule catalogue, suppression syntax and the baseline workflow.
+for the rule catalogue, suppression syntax, the baseline workflow, and
+the CI modes (``--diff-base REV`` differential lint, ``--sarif``).
 """
 
 from nhd_tpu.analysis.core import (

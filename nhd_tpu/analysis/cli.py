@@ -62,7 +62,101 @@ def _parser() -> argparse.ArgumentParser:
                    help="ignore any baseline file (report all findings)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
+    p.add_argument("--diff-base", metavar="REV", default=None,
+                   help="differential mode: only findings on lines "
+                        "changed since REV (git diff) affect the exit "
+                        "code; off-diff findings are reported as "
+                        "advisory. The baseline still applies first.")
+    p.add_argument("--sarif", metavar="FILE", default=None,
+                   help="also write findings as SARIF 2.1.0 (all "
+                        "post-baseline findings, independent of "
+                        "--diff-base gating)")
     return p
+
+
+def _changed_lines(rev: str) -> Optional[dict]:
+    """{repo-relative path: set of changed line numbers} from
+    ``git diff -U0 REV``, or None if git fails (treated as a usage
+    error by the caller — a bad REV must not read as 'clean')."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--no-color", "--unified=0", rev, "--", "*.py"],
+            capture_output=True, text=True, timeout=60,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    changed: dict = {}
+    path = None
+    for raw in proc.stdout.splitlines():
+        if raw.startswith("+++ b/"):
+            path = raw[6:].strip()
+        elif raw.startswith("+++"):
+            path = None  # /dev/null (deletion) or unusual prefix
+        elif raw.startswith("@@") and path is not None:
+            # @@ -a[,b] +c[,d] @@ — new-file side span is c..c+d-1
+            try:
+                new_span = raw.split("+", 1)[1].split(" ", 1)[0]
+            except IndexError:
+                continue
+            start, _, count = new_span.partition(",")
+            first = int(start)
+            n = int(count) if count else 1
+            if n > 0:
+                changed.setdefault(path, set()).update(
+                    range(first, first + n)
+                )
+    return changed
+
+
+def _write_sarif(findings: List[Finding], out: Path) -> None:
+    """SARIF 2.1.0 — one run, rule metadata from the catalogue, stable
+    partialFingerprints so CI viewers track findings across pushes."""
+    seen_rules = sorted({f.rule for f in findings})
+    doc = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "nhdlint",
+                "informationUri": "docs/STATIC_ANALYSIS.md",
+                "rules": [
+                    {
+                        "id": rule,
+                        "shortDescription": {"text": RULES[rule][1]},
+                        "properties": {"pack": RULES[rule][0]},
+                    }
+                    for rule in seen_rules if rule in RULES
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": "error",
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {
+                                "startLine": f.line,
+                                "startColumn": f.col + 1,
+                            },
+                        },
+                    }],
+                    "partialFingerprints": {
+                        "nhdlintFingerprint/v1": f.fingerprint(),
+                    },
+                }
+                for f in findings
+            ],
+        }],
+    }
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=2) + "\n")
 
 
 def _resolve_packs(arg: str) -> Optional[List[str]]:
@@ -149,6 +243,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
         findings, baselined = subtract_baseline(findings, baseline)
 
+    if args.sarif:
+        _write_sarif(findings, Path(args.sarif))
+        print(f"nhdlint: SARIF -> {args.sarif}", file=sys.stderr)
+
+    advisory: List[Finding] = []
+    if args.diff_base is not None:
+        changed = _changed_lines(args.diff_base)
+        if changed is None:
+            print(f"nhdlint: git diff against {args.diff_base!r} failed",
+                  file=sys.stderr)
+            return 2
+        on_diff = []
+        for f in findings:
+            (on_diff if f.line in changed.get(f.path, ()) else advisory) \
+                .append(f)
+        findings = on_diff
+
     if args.fmt == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
@@ -159,6 +270,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 {"path": p, "line": line} for p, line in unused_ignores
             ],
             "packs": packs,
+            "advisory": [f.to_dict() for f in advisory],
         }, indent=2))
     else:
         for f in findings:
@@ -169,9 +281,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # advisory, not an exit-code failure: a stale directive can
             # mask a future finding on its line, so keep them visible
             print(f"{p}:{line}: warning: unused 'nhdlint: ignore' directive")
+        for f in advisory:
+            # off-diff in --diff-base mode: visible, never exit-affecting
+            print(f"{f.path}:{f.line}:{f.col + 1}: advisory: "
+                  f"{f.rule} {f.message}")
         tail = (f"{len(findings)} finding(s) in {len(reports)} file(s)"
                 f" ({suppressed} suppressed, {baselined} baselined, "
                 f"{len(unused_ignores)} unused ignore(s))")
+        if args.diff_base is not None:
+            tail += f"; {len(advisory)} off-diff advisory"
         print(f"nhdlint: {tail}" if findings else f"nhdlint: clean — {tail}")
 
     return 1 if findings else 0

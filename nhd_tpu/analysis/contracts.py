@@ -1,0 +1,493 @@
+"""Cross-module model of the solve-signature contract (NHD7xx pack).
+
+The solver's 25-array solve signature is a *convention* threaded through
+eight-plus modules: ``kernel._ARG_ORDER``/``_POD_ARG_ORDER`` name the
+arrays, ``encode.DELTA_FIELDS`` mirrors them for the delta layer,
+``_MUTABLE``/``_STATIC`` partition them for donation and out-shardings,
+``parallel/sharding`` and the kernel's mesh solvers span ``in_shardings``
+over them, ``speculate`` strides the flattened pod block by their count,
+and ``aot`` hashes the defining modules into the program fingerprint.
+PRs that extend the signature must touch every one of those sites; the
+one time a site was missed it surfaced only as a runtime parity failure.
+
+This module extracts the *facts* — tuple definitions, ``.index()`` refs,
+stride arithmetic, sharding spans, fingerprint sources, env-knob reads,
+the knob registry — from a parsed project (``ModuleSource`` set) into a
+:class:`ContractModel`. ``rules_contract.py`` judges the facts. Keeping
+extraction separate from judgement means a future consumer layer (the
+ROADMAP's ragged/autotuner work) adds one extractor + one check, not a
+new visitor.
+
+Everything here is stdlib-``ast`` only: the model is built from source
+text, never by importing solver modules (the gate must run without jax).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from nhd_tpu.analysis.core import ModuleSource, _dotted
+
+#: the contract tuple names the model tracks, wherever they are defined
+CONTRACT_TUPLE_NAMES = (
+    "_ARG_ORDER", "_POD_ARG_ORDER", "_MUTABLE", "_STATIC", "DELTA_FIELDS",
+)
+
+#: flattened-pod-block variables whose stride arithmetic is contract-bound
+STRIDE_BASES = ("pod_args",)
+
+
+def module_basename(path: str) -> str:
+    """'kernel' for 'nhd_tpu/solver/kernel.py' — the unit fingerprint
+    sources and tuple definitions are matched on."""
+    name = path.rsplit("/", 1)[-1]
+    return name[:-3] if name.endswith(".py") else name
+
+
+@dataclass(frozen=True)
+class TupleDef:
+    """A module-level literal tuple/list-of-strings contract definition."""
+
+    name: str
+    path: str
+    line: int
+    col: int
+    fields: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class IndexRef:
+    """``<tuple>.index("field")`` — a positional consumer of the contract."""
+
+    path: str
+    line: int
+    col: int
+    tuple_name: str
+    field_name: str
+
+
+@dataclass(frozen=True)
+class StrideSite:
+    """``base[K*b : K*b + K]`` over a flattened pod block."""
+
+    path: str
+    line: int
+    col: int
+    stride: int
+
+
+@dataclass(frozen=True)
+class UnpackSite:
+    """Tuple-unpack of a pod-block slice: arity must match the contract."""
+
+    path: str
+    line: int
+    col: int
+    arity: int
+
+
+@dataclass(frozen=True)
+class ShardingSite:
+    """``in_shardings=(spec,)*A + (spec2,)*B``: the node/pod spans.
+
+    Each span is either a literal int (judgeable) or the contract tuple
+    name whose ``len()`` it takes (symbolic — consistent by construction,
+    recorded so the rule can confirm it derives from the *right* tuple).
+    A span that is neither (an opaque expression) is ``None``/``None``
+    and stays unjudged.
+    """
+
+    path: str
+    line: int
+    col: int
+    node_count: Optional[int]
+    node_sym: Optional[str]
+    pod_count: Optional[int]
+    pod_sym: Optional[str]
+
+
+@dataclass(frozen=True)
+class FingerprintSite:
+    """``for mod in (a, b): h.update(inspect.getsource(mod)...)`` inside a
+    *fingerprint* function — the AOT cache-key source list, resolved to
+    module basenames through the import table."""
+
+    path: str
+    line: int
+    col: int
+    hashed: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class EnvRead:
+    """One ``NHD_*`` environment read (os.environ.get / os.getenv /
+    os.environ[...])."""
+
+    path: str
+    line: int
+    col: int
+    name: str
+
+
+@dataclass(frozen=True)
+class KnobRegistry:
+    """A module-level ``KNOBS = (Knob(...), ...)`` registry."""
+
+    path: str
+    line: int
+    names: Tuple[str, ...]
+
+
+@dataclass
+class ContractModel:
+    """Everything rules_contract.py judges, extracted in one pass."""
+
+    tuple_defs: Dict[str, List[TupleDef]] = field(default_factory=dict)
+    index_refs: List[IndexRef] = field(default_factory=list)
+    stride_sites: List[StrideSite] = field(default_factory=list)
+    unpack_sites: List[UnpackSite] = field(default_factory=list)
+    sharding_sites: List[ShardingSite] = field(default_factory=list)
+    fingerprint_sites: List[FingerprintSite] = field(default_factory=list)
+    env_reads: List[EnvRead] = field(default_factory=list)
+    registries: List[KnobRegistry] = field(default_factory=list)
+    #: basenames of modules defining ``get_tables`` (the combo tables —
+    #: a required fingerprint source alongside the _ARG_ORDER module)
+    table_modules: List[str] = field(default_factory=list)
+
+    def first_def(self, name: str) -> Optional[TupleDef]:
+        defs = self.tuple_defs.get(name)
+        return defs[0] if defs else None
+
+
+def _literal_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('a', 'b') for a Tuple/List of string constants, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: List[str] = []
+    for elt in node.elts:
+        if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+            return None
+        out.append(elt.value)
+    return tuple(out)
+
+
+def _import_table(tree: ast.Module) -> Dict[str, str]:
+    """local name -> imported basename, for resolving fingerprint-source
+    Names. Function-level imports count: aot imports kernel/combos inside
+    program_fingerprint() to dodge an import cycle."""
+    table: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    # `import nhd_tpu.solver.kernel as kernel` -> kernel
+                    table[alias.asname] = alias.name.rsplit(".", 1)[-1]
+                else:
+                    # `import os` / `import a.b` binds the top name
+                    top = alias.name.split(".")[0]
+                    table[top] = top
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                table[alias.asname or alias.name] = alias.name
+    return table
+
+
+def _stride_term(node: ast.AST) -> Optional[int]:
+    """K for a ``K*i`` / ``i*K`` product with one int constant, else None."""
+    if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult)):
+        return None
+    left, right = node.left, node.right
+    if isinstance(left, ast.Constant) and isinstance(left.value, int):
+        return left.value
+    if isinstance(right, ast.Constant) and isinstance(right.value, int):
+        return right.value
+    return None
+
+
+def _is_stride_base(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in STRIDE_BASES
+    if isinstance(node, ast.Attribute):
+        return node.attr in STRIDE_BASES
+    return False
+
+
+def _span_of(node: ast.AST, len_aliases: Dict[str, str]):
+    """(count, sym) for one ``(spec,)*X`` sharding span term: a literal
+    int count, or the contract tuple name X takes ``len()`` of (directly
+    or through a ``n = len(_ARG_ORDER)`` local alias)."""
+    if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult)):
+        return None, None
+    for operand in (node.left, node.right):
+        if isinstance(operand, ast.Constant) and isinstance(operand.value, int):
+            return operand.value, None
+        sym = _len_target(operand, len_aliases)
+        if sym is not None:
+            return None, sym
+    return None, None
+
+
+def _len_target(node: ast.AST, len_aliases: Dict[str, str]) -> Optional[str]:
+    """NAME for ``len(NAME)`` or a local alias of it, else None."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "len"
+        and len(node.args) == 1
+        and isinstance(node.args[0], ast.Name)
+    ):
+        return node.args[0].id
+    if isinstance(node, ast.Name):
+        return len_aliases.get(node.id)
+    return None
+
+
+_ENV_GET_CALLS = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
+_ENV_SUBSCRIPTS = {"os.environ", "environ"}
+
+
+class _ModuleExtractor(ast.NodeVisitor):
+    """One pass over one module, appending facts to the shared model."""
+
+    def __init__(self, model: ContractModel, module: ModuleSource):
+        self.model = model
+        self.path = module.path
+        self.imports = _import_table(module.tree)
+        # NAME for every `x = len(NAME)` assignment in the module —
+        # scoping is flat (module-wide) which is safe: a false alias can
+        # only *record* a sharding span as symbolic, never invent a
+        # literal mismatch
+        self.len_aliases: Dict[str, str] = {}
+        # name -> the `(a,)*X + (b,)*Y` expression assigned to it, so an
+        # in_shardings kwarg passed by local name is still judgeable
+        self.span_assigns: Dict[str, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                target = _len_target(node.value, {})
+                if target is not None:
+                    self.len_aliases[node.targets[0].id] = target
+                if isinstance(node.value, ast.BinOp) \
+                        and isinstance(node.value.op, ast.Add):
+                    self.span_assigns[node.targets[0].id] = node.value
+
+    # -- contract tuple / registry definitions (module level only) ------
+
+    def visit_Module(self, node: ast.Module) -> None:
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name):
+                    self._module_assign(target.id, stmt, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                # `KNOBS: Tuple[Knob, ...] = (...)` — annotated form
+                if isinstance(stmt.target, ast.Name):
+                    self._module_assign(stmt.target.id, stmt, stmt.value)
+        self.generic_visit(node)
+
+    def _module_assign(self, name: str, stmt: ast.stmt,
+                       value: ast.expr) -> None:
+        if name in CONTRACT_TUPLE_NAMES:
+            fields = _literal_str_tuple(value)
+            if fields is not None:
+                self.model.tuple_defs.setdefault(name, []).append(TupleDef(
+                    name, self.path, stmt.lineno, stmt.col_offset, fields
+                ))
+        elif name == "KNOBS" and isinstance(value, (ast.Tuple, ast.List)):
+            knobs = []
+            for elt in value.elts:
+                knob = self._knob_name(elt)
+                if knob is None:
+                    return  # not a Knob(...) registry after all
+                knobs.append(knob)
+            self.model.registries.append(
+                KnobRegistry(self.path, stmt.lineno, tuple(knobs))
+            )
+
+    @staticmethod
+    def _knob_name(node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        callee = _dotted(node.func) or ""
+        if callee.rsplit(".", 1)[-1] != "Knob":
+            return None
+        for kw in node.keywords:
+            if (
+                kw.arg == "name"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)
+            ):
+                return kw.value.value
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+        return None
+
+    # -- functions: fingerprint loops + get_tables definers -------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node.name == "get_tables":
+            base = module_basename(self.path)
+            if base not in self.model.table_modules:
+                self.model.table_modules.append(base)
+        if "fingerprint" in node.name:
+            self._fingerprint_sites(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _fingerprint_sites(self, func: ast.FunctionDef) -> None:
+        for node in ast.walk(func):
+            if not (
+                isinstance(node, ast.For)
+                and isinstance(node.iter, (ast.Tuple, ast.List))
+            ):
+                continue
+            uses_getsource = any(
+                isinstance(inner, ast.Call)
+                and (_dotted(inner.func) or "").endswith("getsource")
+                for body_stmt in node.body
+                for inner in ast.walk(body_stmt)
+            )
+            if not uses_getsource:
+                continue
+            hashed = []
+            for elt in node.iter.elts:
+                if isinstance(elt, ast.Name):
+                    hashed.append(self.imports.get(elt.id, elt.id))
+                else:
+                    dotted = _dotted(elt)
+                    if dotted:
+                        hashed.append(dotted.rsplit(".", 1)[-1])
+            self.model.fingerprint_sites.append(FingerprintSite(
+                self.path, node.lineno, node.col_offset, tuple(hashed)
+            ))
+
+    # -- consumers: .index(), strides, unpacks, shardings, env reads ----
+
+    def _canonical(self, dotted: Optional[str]) -> str:
+        """Resolve the leading component of a dotted path through the
+        module's import aliases (`import os as _os` → `_os.environ.get`
+        matches `os.environ.get`)."""
+        if not dotted:
+            return ""
+        head, sep, rest = dotted.partition(".")
+        return self.imports.get(head, head) + sep + rest
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "index"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in CONTRACT_TUPLE_NAMES
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            self.model.index_refs.append(IndexRef(
+                self.path, node.lineno, node.col_offset,
+                func.value.id, node.args[0].value,
+            ))
+        dotted = self._canonical(_dotted(func))
+        if dotted in _ENV_GET_CALLS and node.args:
+            arg = node.args[0]
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and arg.value.startswith("NHD_")
+            ):
+                self.model.env_reads.append(EnvRead(
+                    self.path, node.lineno, node.col_offset, arg.value
+                ))
+        for kw in node.keywords:
+            if kw.arg == "in_shardings":
+                value = kw.value
+                if isinstance(value, ast.Name):
+                    # `in_shardings = (a,)*X + (b,)*Y` bound to a local
+                    # and passed by name (kernel.get_ranked_solver_mesh)
+                    value = self.span_assigns.get(value.id, value)
+                self._sharding_site(value)
+        self.generic_visit(node)
+
+    def _sharding_site(self, value: ast.AST) -> None:
+        """Record `(spec,)*A + (spec2,)*B` spans; anything else is opaque
+        and stays unrecorded (unjudgeable, never a false positive)."""
+        if not (isinstance(value, ast.BinOp) and isinstance(value.op, ast.Add)):
+            return
+        node_count, node_sym = _span_of(value.left, self.len_aliases)
+        pod_count, pod_sym = _span_of(value.right, self.len_aliases)
+        if (node_count, node_sym) == (None, None) \
+                and (pod_count, pod_sym) == (None, None):
+            return
+        self.model.sharding_sites.append(ShardingSite(
+            self.path, value.lineno, value.col_offset,
+            node_count, node_sym, pod_count, pod_sym,
+        ))
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, ast.Load) and isinstance(node.slice, ast.Slice):
+            self._stride_site(node)
+        if (
+            isinstance(node.ctx, ast.Load)
+            and self._canonical(_dotted(node.value)) in _ENV_SUBSCRIPTS
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+            and node.slice.value.startswith("NHD_")
+        ):
+            self.model.env_reads.append(EnvRead(
+                self.path, node.lineno, node.col_offset, node.slice.value
+            ))
+        self.generic_visit(node)
+
+    def _stride_site(self, node: ast.Subscript) -> None:
+        if not _is_stride_base(node.value):
+            return
+        sl = node.slice
+        assert isinstance(sl, ast.Slice)
+        low_k = _stride_term(sl.lower) if sl.lower is not None else None
+        if low_k is None:
+            return
+        # upper must be `K*b + K2`; both K and K2 are judged by the rule
+        up = sl.upper
+        up_k: Optional[int] = None
+        if isinstance(up, ast.BinOp) and isinstance(up.op, ast.Add):
+            for operand in (up.left, up.right):
+                if isinstance(operand, ast.Constant) \
+                        and isinstance(operand.value, int):
+                    up_k = operand.value
+        self.model.stride_sites.append(StrideSite(
+            self.path, node.lineno, node.col_offset, low_k
+        ))
+        if up_k is not None and up_k != low_k:
+            self.model.stride_sites.append(StrideSite(
+                self.path, node.lineno, node.col_offset, up_k
+            ))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        """Tuple-unpack of a pod-block slice: arity is contract-bound."""
+        if (
+            len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Tuple)
+            and isinstance(node.value, ast.Subscript)
+            and _is_stride_base(node.value.value)
+            and isinstance(node.value.slice, ast.Slice)
+        ):
+            self.model.unpack_sites.append(UnpackSite(
+                self.path, node.lineno, node.col_offset,
+                len(node.targets[0].elts),
+            ))
+        self.generic_visit(node)
+
+
+def build_model(modules: Sequence[ModuleSource]) -> ContractModel:
+    """Extract the contract model from every parsed module."""
+    model = ContractModel()
+    for module in modules:
+        _ModuleExtractor(model, module).visit(module.tree)
+    return model

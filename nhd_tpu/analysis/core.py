@@ -121,12 +121,18 @@ def _pack_metrics(modules):
     return check_project(modules)
 
 
+def _pack_contract(modules):
+    from nhd_tpu.analysis.rules_contract import check_project
+    return check_project(modules)
+
+
 # project packs: check_project(modules: Sequence[ModuleSource]) -> findings.
 # They run over the whole analyzed path set at once (analyze_file hands
 # them a one-module project, so EXPECT fixtures keep working unchanged).
 PROJECT_PACKS: Dict[str, Callable] = {
     "lockgraph": _pack_lockgraph,
     "metrics": _pack_metrics,
+    "contract": _pack_contract,
 }
 
 ALL_PACK_NAMES: Tuple[str, ...] = (*PACKS, *PROJECT_PACKS)
@@ -232,6 +238,33 @@ RULES: Dict[str, Tuple[str, str]] = {
                "unbounded-cardinality label (corr/uid/pod/...) on a "
                "metric family: one time series per pod ever seen — "
                "identities belong in /decisions, not label values"),
+    "NHD701": ("contract",
+               "solve-signature consumer out of step: a field present in "
+               "one layer (_ARG_ORDER/_POD_ARG_ORDER) is missing from "
+               "another (DELTA_FIELDS, _MUTABLE/_STATIC partition, "
+               "in_shardings span, speculate stride/unpack, .index ref) "
+               "— the missing consumer layer is named"),
+    "NHD702": ("contract",
+               "solve-signature order-contract violation: same field set "
+               "but different order, duplicated fields, overlapping "
+               "_MUTABLE/_STATIC partition, or conflicting definitions — "
+               "positional consumers would read the wrong array"),
+    "NHD703": ("contract",
+               "AOT fingerprint-source omission: program_fingerprint "
+               "does not hash a module that defines the compiled program "
+               "(the _ARG_ORDER module / the get_tables combo tables) — "
+               "cached artifacts would survive semantic edits"),
+    "NHD710": ("contract",
+               "donation-alias hazard: a host-mirror-tainted value "
+               "(getattr on cluster state, zero-copy wrappers, aliasing "
+               "pads) reaches a donate_argnums position without an "
+               "owning copy — the donated program may mutate the host "
+               "mirror in place (the PR 9 _pad_own bug, statically)"),
+    "NHD720": ("contract",
+               "unregistered env knob: an NHD_* environment read absent "
+               "from the nhd_tpu/config/knobs.py KNOBS registry — the "
+               "OPERATIONS.md tunables table is generated from the "
+               "registry, so the knob is undocumented"),
 }
 
 

@@ -1,0 +1,703 @@
+"""contract: NHD7xx — cross-layer solve-signature contract analysis.
+
+NHD701  missing-consumer: a field present in one layer of the solve
+        signature is absent (or a positional span disagrees) in another —
+        DELTA_FIELDS vs _ARG_ORDER, the _MUTABLE/_STATIC partition,
+        in_shardings spans, speculate stride math, .index() refs.
+NHD702  order-contract violation: same field *set* but a different order
+        (positional consumers would read the wrong array), duplicated
+        fields, overlapping partition, or conflicting definitions.
+NHD703  fingerprint-source omission: the AOT program fingerprint does
+        not hash a module whose source defines the compiled program
+        (the _ARG_ORDER module and the get_tables combo-table module) —
+        a cached artifact would survive an edit that changes placement
+        semantics.
+NHD710  donation-alias hazard: a value tainted by a host-mirror read
+        (``getattr(cluster, field)`` and what flows from it) reaches a
+        donated argument position of a ``donate_argnums`` dispatch
+        without an owning copy — the compiled program may mutate the
+        host array in place through a zero-copy ``jnp.asarray`` (the
+        PR 9 ``_pad_own`` double-claim bug, caught here statically).
+NHD720  unregistered env knob: an ``NHD_*`` environment read that does
+        not appear in the machine-readable knob registry
+        (``nhd_tpu/config/knobs.py`` ``KNOBS``) — the OPERATIONS.md
+        tunables table is generated from the registry, so an
+        unregistered knob is an undocumented knob.
+
+Scope and judgement model (see docs/STATIC_ANALYSIS.md "NHD7xx"):
+checks fire only when both sides of a contract are visible in the
+analyzed project — analyzing one file alone stays silent unless that
+file carries both the definition and the violating consumer, which is
+exactly how the EXPECT fixtures exercise each rule. ``test_*``/
+``conftest.py`` modules are never part of the contract model.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from nhd_tpu.analysis.core import Finding, ModuleSource, _dotted
+from nhd_tpu.analysis.contracts import (
+    ContractModel,
+    TupleDef,
+    build_model,
+    module_basename,
+)
+
+
+def _is_test_module(path: str) -> bool:
+    name = path.rsplit("/", 1)[-1]
+    return name.startswith("test_") or name == "conftest.py"
+
+
+def check_project(modules: Sequence[ModuleSource]) -> List[Finding]:
+    modules = [m for m in modules if not _is_test_module(m.path)]
+    model = build_model(modules)
+    out: List[Finding] = []
+    out.extend(_check_signature(model))
+    out.extend(_check_fingerprints(model))
+    out.extend(_check_knobs(model))
+    out.extend(_check_donation(modules))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NHD701 / NHD702: the signature itself
+# ---------------------------------------------------------------------------
+
+def _finding(rule: str, site, message: str) -> Finding:
+    return Finding(rule, site.path, site.line, site.col, message)
+
+
+def _resolve_def(
+    model: ContractModel, name: str, out: List[Finding]
+) -> Optional[TupleDef]:
+    """The project's definition of one contract tuple; conflicting
+    re-definitions are themselves an NHD702 (every consumer would pick
+    whichever import it happens to see)."""
+    defs = model.tuple_defs.get(name, [])
+    if not defs:
+        return None
+    first = defs[0]
+    for other in defs[1:]:
+        if other.fields != first.fields:
+            out.append(_finding(
+                "NHD702", other,
+                f"conflicting definition of {name}: differs from "
+                f"{first.path}:{first.line}",
+            ))
+    return first
+
+
+def _check_signature(model: ContractModel) -> List[Finding]:
+    out: List[Finding] = []
+    arg = _resolve_def(model, "_ARG_ORDER", out)
+    pod = _resolve_def(model, "_POD_ARG_ORDER", out)
+    mutable = _resolve_def(model, "_MUTABLE", out)
+    static = _resolve_def(model, "_STATIC", out)
+    delta = _resolve_def(model, "DELTA_FIELDS", out)
+
+    # duplicated fields inside any one tuple break every positional use
+    for tdef in (arg, pod, mutable, static, delta):
+        if tdef is None:
+            continue
+        seen: Set[str] = set()
+        for f in tdef.fields:
+            if f in seen:
+                out.append(_finding(
+                    "NHD702", tdef,
+                    f"{tdef.name} lists '{f}' more than once",
+                ))
+            seen.add(f)
+
+    # encode's delta layer must mirror the kernel signature exactly:
+    # same set (NHD701, the missing consumer is named) and same order
+    # (NHD702 — ClusterDelta scatters rows by position)
+    if arg is not None and delta is not None:
+        for f in arg.fields:
+            if f not in delta.fields:
+                out.append(_finding(
+                    "NHD701", delta,
+                    f"'{f}' is in {arg.path.rsplit('/', 1)[-1]} _ARG_ORDER "
+                    f"but missing from DELTA_FIELDS — the delta layer "
+                    f"(encode.ClusterDelta) would never upload it",
+                ))
+        for f in delta.fields:
+            if f not in arg.fields:
+                out.append(_finding(
+                    "NHD701", delta,
+                    f"DELTA_FIELDS lists '{f}' which is not in _ARG_ORDER "
+                    f"— no solver consumer exists for it",
+                ))
+        if set(arg.fields) == set(delta.fields) and arg.fields != delta.fields:
+            i = next(
+                i for i, (a, d) in enumerate(zip(arg.fields, delta.fields))
+                if a != d
+            )
+            out.append(_finding(
+                "NHD702", delta,
+                f"DELTA_FIELDS order diverges from _ARG_ORDER at position "
+                f"{i} ('{delta.fields[i]}' vs '{arg.fields[i]}') — "
+                f"positional consumers would read the wrong array",
+            ))
+
+    # the donation/out-shardings partition must tile _ARG_ORDER exactly
+    if arg is not None and mutable is not None and static is not None:
+        part = set(mutable.fields) | set(static.fields)
+        for f in arg.fields:
+            if f not in part:
+                out.append(_finding(
+                    "NHD701", arg,
+                    f"'{f}' is in _ARG_ORDER but neither _MUTABLE nor "
+                    f"_STATIC — the megaround out_shardings/donation "
+                    f"partition would drop it",
+                ))
+        for tdef in (mutable, static):
+            for f in tdef.fields:
+                if f not in arg.fields:
+                    out.append(_finding(
+                        "NHD701", tdef,
+                        f"{tdef.name} lists '{f}' which is not in "
+                        f"_ARG_ORDER",
+                    ))
+        overlap = set(mutable.fields) & set(static.fields)
+        for f in sorted(overlap):
+            out.append(_finding(
+                "NHD702", static,
+                f"'{f}' is in both _MUTABLE and _STATIC — the partition "
+                f"must be disjoint",
+            ))
+
+    # positional .index() consumers
+    for ref in model.index_refs:
+        tdef = model.first_def(ref.tuple_name)
+        if tdef is not None and ref.field_name not in tdef.fields:
+            out.append(_finding(
+                "NHD701", ref,
+                f"{ref.tuple_name}.index('{ref.field_name}'): no such "
+                f"field in {tdef.path}:{tdef.line} — this raises "
+                f"ValueError at first call",
+            ))
+
+    # in_shardings spans: (node_spec,)*len(_ARG_ORDER) +
+    # (repl,)*len(_POD_ARG_ORDER); literal counts must match, symbolic
+    # spans must derive from the RIGHT tuple
+    for site in model.sharding_sites:
+        for count, sym, tdef, want in (
+            (site.node_count, site.node_sym, arg, "_ARG_ORDER"),
+            (site.pod_count, site.pod_sym, pod, "_POD_ARG_ORDER"),
+        ):
+            if tdef is None:
+                continue
+            if count is not None and count != len(tdef.fields):
+                out.append(_finding(
+                    "NHD701", site,
+                    f"in_shardings {want.strip('_').lower()} span is a "
+                    f"literal {count} but len({want}) == "
+                    f"{len(tdef.fields)} — the mesh sharding layer "
+                    f"(parallel/sharding) is missing a signature array",
+                ))
+            elif sym is not None and sym != want \
+                    and sym in model.tuple_defs:
+                out.append(_finding(
+                    "NHD701", site,
+                    f"in_shardings span derives from len({sym}); this "
+                    f"position spans {want}",
+                ))
+
+    # speculate's flattened pod-block stride math
+    if pod is not None:
+        for stride in model.stride_sites:
+            if stride.stride != len(pod.fields):
+                out.append(_finding(
+                    "NHD701", stride,
+                    f"pod_args stride {stride.stride} != "
+                    f"len(_POD_ARG_ORDER) == {len(pod.fields)} — the "
+                    f"speculate stride layer would misalign every pod "
+                    f"block after the first",
+                ))
+        for unpack in model.unpack_sites:
+            if unpack.arity != len(pod.fields):
+                out.append(_finding(
+                    "NHD701", unpack,
+                    f"pod_args slice unpacks {unpack.arity} names but "
+                    f"len(_POD_ARG_ORDER) == {len(pod.fields)} — the "
+                    f"speculate unpack layer is missing a signature array",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NHD703: fingerprint sources
+# ---------------------------------------------------------------------------
+
+def _check_fingerprints(model: ContractModel) -> List[Finding]:
+    out: List[Finding] = []
+    if not model.fingerprint_sites:
+        return out
+    required: Dict[str, str] = {}
+    for tdef in model.tuple_defs.get("_ARG_ORDER", []):
+        required[module_basename(tdef.path)] = "defines _ARG_ORDER"
+    for base in model.table_modules:
+        required.setdefault(base, "defines get_tables")
+    for site in model.fingerprint_sites:
+        hashed = set(site.hashed)
+        for base, why in sorted(required.items()):
+            if base not in hashed:
+                out.append(_finding(
+                    "NHD703", site,
+                    f"program fingerprint does not hash module '{base}' "
+                    f"({why}) — a cached AOT artifact would survive an "
+                    f"edit that changes placement semantics",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NHD720: env-knob registry
+# ---------------------------------------------------------------------------
+
+def _check_knobs(model: ContractModel) -> List[Finding]:
+    out: List[Finding] = []
+    if not model.registries:
+        return out  # no registry in this project: out of scope
+    registered: Set[str] = set()
+    for reg in model.registries:
+        registered.update(reg.names)
+    reg_path = model.registries[0].path
+    for read in model.env_reads:
+        if read.name not in registered:
+            out.append(_finding(
+                "NHD720", read,
+                f"env knob '{read.name}' is read here but not registered "
+                f"in {reg_path} KNOBS — the OPERATIONS.md tunables table "
+                f"is generated from the registry, so this knob is "
+                f"undocumented",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NHD710: donation-alias dataflow
+# ---------------------------------------------------------------------------
+#
+# Model (documented in STATIC_ANALYSIS.md):
+#
+# * taint SEEDS are ``getattr(obj, name)`` results — the idiom every
+#   layer uses to walk the signature over a host-mirror ClusterArrays.
+# * taint PROPAGATES through: plain assignment, tuple/list/dict/set
+#   displays and comprehensions, subscripts/slices (numpy views),
+#   conditional expressions, starred args, zero-copy library wrappers
+#   (``jnp.asarray`` / ``np.asarray`` / ``jax.device_put``), user
+#   functions classified ALIASING (some return is a bare parameter) or
+#   TRANSPARENT (returns a zero-copy wrapper of a parameter), and
+#   instance attributes any method of the class assigns a tainted value
+#   into (class-wide fixed point).
+# * taint is CUT by any other call — ``a.copy()``, ``np.array``,
+#   ``np.ascontiguousarray``, ``np.concatenate`` and every function not
+#   classified aliasing/transparent produce owned values. A wrapper
+#   whose returns are all call results is deliberately judged an
+#   ownership boundary (``_pad_own``-style guards): the analysis is
+#   one return level deep by design.
+# * a DONATING callable is a local bound from a factory whose body
+#   builds ``donate_argnums`` into ``jax.jit`` (directly or via a
+#   kwargs dict), or from ``jax.jit(f, donate_argnums=...)`` itself.
+#   Passing a tainted value in a donated position flags the call.
+
+_ZERO_COPY = {
+    "jnp.asarray", "jax.numpy.asarray", "numpy.asarray", "np.asarray",
+    "jax.device_put",
+}
+
+
+def _donated_positions(func: ast.AST) -> Optional[FrozenSet[int]]:
+    """Donated argument positions for a jit-factory function body, or
+    None when the function never donates."""
+    positions: Set[int] = set()
+    returns_jit = False
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func) or ""
+            if dotted.rsplit(".", 1)[-1] in ("jit", "pjit"):
+                returns_jit = True
+                for kw in node.keywords:
+                    if kw.arg == "donate_argnums":
+                        positions.update(_int_elts(kw.value))
+        if isinstance(node, ast.Dict):
+            for key, value in zip(node.keys, node.values):
+                if (
+                    isinstance(key, ast.Constant)
+                    and key.value == "donate_argnums"
+                ):
+                    positions.update(_int_elts(value))
+    if positions and returns_jit:
+        return frozenset(positions)
+    return None
+
+
+def _int_elts(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+    return out
+
+
+def _classify_functions(modules: Sequence[ModuleSource]) -> Dict[str, str]:
+    """name -> 'aliasing' | 'transparent' for every function in the
+    project whose returns can pass a parameter through. Names are
+    unqualified: the callable travels between modules by from-import."""
+    classes: Dict[str, str] = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = {a.arg for a in node.args.args
+                      + node.args.posonlyargs + node.args.kwonlyargs}
+            params.discard("self")
+            kind = _return_kind(node, params)
+            if kind is not None:
+                # aliasing dominates transparent if both appear
+                if classes.get(node.name) != "aliasing":
+                    classes[node.name] = kind
+    return classes
+
+
+def _own_walk(func: ast.AST):
+    """ast.walk that does not descend into nested defs/classes — their
+    bodies are judged as functions of their own."""
+    stack: List[ast.AST] = [func]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            stack.append(child)
+
+
+def _return_kind(
+    func: ast.AST, params: Set[str]
+) -> Optional[str]:
+    kind: Optional[str] = None
+    for node in _own_walk(func):
+        if not (isinstance(node, ast.Return) and node.value is not None):
+            continue
+        if _expr_aliases_param(node.value, params):
+            return "aliasing"
+        if _is_zero_copy_of_param(node.value, params):
+            kind = "transparent"
+    return kind
+
+
+def _expr_aliases_param(node: ast.AST, params: Set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in params
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(_expr_aliases_param(e, params) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return any(
+            v is not None and _expr_aliases_param(v, params)
+            for v in node.values
+        )
+    if isinstance(node, ast.IfExp):
+        return (
+            _expr_aliases_param(node.body, params)
+            or _expr_aliases_param(node.orelse, params)
+        )
+    if isinstance(node, ast.Subscript):
+        # a slice of a parameter is a numpy view of it
+        return _expr_aliases_param(node.value, params)
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        return _expr_aliases_param(node.elt, params)
+    if isinstance(node, ast.Call) and _is_zero_copy_call(node):
+        return bool(node.args) and _expr_aliases_param(node.args[0], params)
+    return False
+
+
+def _is_zero_copy_call(node: ast.Call) -> bool:
+    return (_dotted(node.func) or "") in _ZERO_COPY
+
+
+def _is_zero_copy_of_param(node: ast.AST, params: Set[str]) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and _is_zero_copy_call(node)
+        and bool(node.args)
+        and _expr_aliases_param(node.args[0], params)
+    )
+
+
+def _local_alias_table(tree: ast.Module) -> Dict[str, str]:
+    """from-import aliases: local name -> original function name."""
+    table: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.asname:
+                    table[alias.asname] = alias.name
+    return table
+
+
+class _Taint:
+    """Per-function taint evaluation against shared project facts."""
+
+    def __init__(
+        self,
+        fn_class: Dict[str, str],
+        aliases: Dict[str, str],
+        attr_taint: Set[str],
+    ):
+        self.fn_class = fn_class
+        self.aliases = aliases
+        self.attr_taint = attr_taint
+        self.locals: Set[str] = set()
+
+    def _callee_kind(self, call: ast.Call) -> Optional[str]:
+        dotted = _dotted(call.func) or ""
+        if dotted in _ZERO_COPY:
+            return "transparent"
+        name = dotted.rsplit(".", 1)[-1]
+        name = self.aliases.get(name, name)
+        return self.fn_class.get(name)
+
+    def tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "getattr":
+                return True
+            kind = self._callee_kind(node)
+            if kind == "transparent":
+                return bool(node.args) and self.tainted(node.args[0])
+            if kind == "aliasing":
+                return any(self.tainted(a) for a in node.args)
+            return False  # any other call produces an owned value
+        if isinstance(node, ast.Name):
+            return node.id in self.locals
+        if isinstance(node, ast.Attribute):
+            return (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in self.attr_taint
+            )
+        if isinstance(node, ast.Subscript):
+            return self.tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(
+                v is not None and self.tainted(v) for v in node.values
+            )
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            return self.tainted(node.elt) or any(
+                self.tainted(gen.iter) for gen in node.generators
+            )
+        if isinstance(node, ast.DictComp):
+            return self.tainted(node.value) or any(
+                self.tainted(gen.iter) for gen in node.generators
+            )
+        if isinstance(node, ast.IfExp):
+            return self.tainted(node.body) or self.tainted(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.tainted(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.tainted(node.value)
+        return False
+
+
+def _function_nodes(tree: ast.Module):
+    """(func, owning-class-name-or-None) for every def in the module."""
+    out = []
+
+    def walk(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, cls))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(tree, None)
+    return out
+
+
+def _check_donation(modules: Sequence[ModuleSource]) -> List[Finding]:
+    fn_class = _classify_functions(modules)
+    # donate factories, by unqualified name, project-wide
+    factories: Dict[str, FrozenSet[int]] = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pos = _donated_positions(node)
+                if pos is not None:
+                    factories[node.name] = pos
+
+    out: List[Finding] = []
+    for module in modules:
+        aliases = _local_alias_table(module.tree)
+        funcs = _function_nodes(module.tree)
+        # class-wide attribute taint, to a fixed point: self.X = tainted
+        # in any method taints reads of self.X in every method
+        attr_taint: Dict[Optional[str], Set[str]] = {}
+        for _ in range(4):
+            changed = False
+            for func, cls in funcs:
+                taints = attr_taint.setdefault(cls, set())
+                eng = _run_function(
+                    func, fn_class, aliases, taints, factories, None
+                )
+                for attr in eng:
+                    if attr not in taints:
+                        taints.add(attr)
+                        changed = True
+            if not changed:
+                break
+        for func, cls in funcs:
+            _run_function(
+                func, fn_class, aliases, attr_taint.get(cls, set()),
+                factories, out, path=module.path,
+            )
+    return out
+
+
+def _taint_targets(t: ast.AST) -> List[str]:
+    """Names a tainted assignment taints: plain locals, every name of a
+    tuple target, the *base* of a subscript store (``d[k] = tainted``
+    taints ``d``; ``self._dev[k] = tainted`` taints the attr), and
+    ``self.X`` attribute stores (returned as ``self.X``)."""
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        return [n for e in t.elts for n in _taint_targets(e)]
+    if isinstance(t, ast.Starred):
+        return _taint_targets(t.value)
+    if isinstance(t, ast.Subscript):
+        return _taint_targets(t.value)
+    if (
+        isinstance(t, ast.Attribute)
+        and isinstance(t.value, ast.Name)
+        and t.value.id == "self"
+    ):
+        return [f"self.{t.attr}"]
+    return []
+
+
+def _run_function(
+    func: ast.AST,
+    fn_class: Dict[str, str],
+    aliases: Dict[str, str],
+    attr_taint: Set[str],
+    factories: Dict[str, FrozenSet[int]],
+    findings: Optional[List[Finding]],
+    path: str = "",
+) -> Set[str]:
+    """One pass over a function body: propagate local taint to a fixed
+    point, track donating locals, then (when *findings* is given) flag
+    tainted values in donated positions. Returns the attr names this
+    function writes tainted values into (for the class fixed point)."""
+    eng = _Taint(fn_class, aliases, attr_taint)
+    stmts = [
+        n for n in _own_walk(func)
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+    ]
+    stmts.sort(key=lambda n: (n.lineno, n.col_offset))
+    donating: Dict[str, FrozenSet[int]] = {}
+    attr_writes: Set[str] = set()
+    for _ in range(8):
+        changed = False
+        for stmt in stmts:
+            value = stmt.value
+            if value is None:
+                continue
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            # donating-callable binding?
+            if isinstance(value, ast.Call):
+                pos = _factory_positions(value, aliases, factories)
+                if pos is not None:
+                    for t in targets:
+                        if isinstance(t, ast.Name) \
+                                and donating.get(t.id) != pos:
+                            donating[t.id] = pos
+                            changed = True
+            is_tainted = eng.tainted(value)
+            if not is_tainted:
+                continue
+            for t in targets:
+                for name in _taint_targets(t):
+                    if name.startswith("self."):
+                        attr = name[5:]
+                        if attr not in attr_writes:
+                            attr_writes.add(attr)
+                            changed = True
+                    elif name not in eng.locals:
+                        eng.locals.add(name)
+                        changed = True
+        if not changed:
+            break
+    if findings is not None:
+        for node in _own_walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            pos = None
+            if isinstance(node.func, ast.Name) and node.func.id in donating:
+                pos = donating[node.func.id]
+            else:
+                pos = _factory_positions(node.func, aliases, factories) \
+                    if isinstance(node.func, ast.Call) else None
+            if not pos:
+                continue
+            for p in sorted(pos):
+                arg: Optional[ast.AST] = None
+                if any(isinstance(a, ast.Starred) for a in node.args):
+                    starred = [a for a in node.args
+                               if isinstance(a, ast.Starred)]
+                    arg = starred[0]
+                elif p < len(node.args):
+                    arg = node.args[p]
+                if arg is not None and eng.tainted(arg):
+                    findings.append(Finding(
+                        "NHD710", path, node.lineno, node.col_offset,
+                        f"donated argument {p} may alias a live host "
+                        f"array: the value reaches this dispatch from a "
+                        f"getattr() host-mirror read without an owning "
+                        f"copy, and a zero-copy asarray would let the "
+                        f"donated program mutate the host mirror in "
+                        f"place — copy first (np.ascontiguousarray / "
+                        f".copy())",
+                    ))
+                    break  # one finding per dispatch site
+    return attr_writes
+
+
+def _factory_positions(
+    call: ast.Call,
+    aliases: Dict[str, str],
+    factories: Dict[str, FrozenSet[int]],
+) -> Optional[FrozenSet[int]]:
+    """Donated positions when *call* builds a donating callable: either
+    a call to a known donate factory, or jax.jit(f, donate_argnums=...)
+    inline."""
+    dotted = _dotted(call.func) or ""
+    name = dotted.rsplit(".", 1)[-1]
+    name = aliases.get(name, name)
+    if name in factories:
+        return factories[name]
+    if name in ("jit", "pjit"):
+        pos: Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                pos.update(_int_elts(kw.value))
+        if pos:
+            return frozenset(pos)
+    return None

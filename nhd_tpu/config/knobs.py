@@ -1,0 +1,408 @@
+"""Machine-readable registry of every ``NHD_*`` environment knob.
+
+One :class:`Knob` per environment variable the codebase reads. This is
+the single source of truth the operational surface hangs off:
+
+* nhdlint's NHD720 (``nhd_tpu/analysis/rules_contract.py``) fails any
+  ``NHD_*`` environment read that is not registered here — a knob
+  cannot ship undocumented.
+* ``tools/knobs_sync.py`` regenerates the "Tunables (environment)"
+  table in docs/OPERATIONS.md from :data:`KNOBS` (``--write``) and
+  validates it in ``make check`` (``--check``) — the table cannot
+  drift from the registry.
+
+Keep entries grouped by subsystem (the generated table preserves
+registry order) and the ``doc`` column self-contained: it is the only
+operator-facing description of the knob.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import FrozenSet, List, Tuple
+
+#: registry scopes: ``runtime`` knobs steer the scheduler/solver,
+#: ``bench`` knobs only affect bench.py legs, ``test`` knobs only the
+#: test harness. All three render into the OPERATIONS.md table.
+SCOPES: Tuple[str, ...] = ("runtime", "bench", "test")
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One environment tunable: its default (rendered verbatim in the
+    table) and its operator-facing meaning."""
+
+    name: str
+    default: str
+    doc: str
+    scope: str = "runtime"
+
+
+KNOBS: Tuple[Knob, ...] = (
+    # -- core data model ---------------------------------------------------
+    Knob("NHD_NIC_BW_AVAIL_PERCENT", "0.9",
+         "schedulable fraction of NIC line rate"),
+    Knob("NHD_NIC_SPEED_THRESH_MBPS", "11000",
+         "NICs below this are not schedulable"),
+    Knob("NHD_NIC_SHARING", "0",
+         "1 → pods may share a NIC (headroom accounting)"),
+    Knob("NHD_MIN_BUSY_SECS", "30",
+         "GPU-pod per-node placement back-off window"),
+    # -- solver ------------------------------------------------------------
+    Knob("NHD_TPU_MAX_LATTICE", "65536",
+         "combo-lattice budget; larger pods go serial"),
+    Knob("NHD_AOT_DIR", "`artifacts/aot`",
+         "AOT StableHLO program cache directory (`--prewarm`; "
+         "docs/PERFORMANCE.md)"),
+    Knob("NHD_AOT_SAVE", "0",
+         "1 → export newly traced solver programs to the cache (implied "
+         "by `--prewarm`)"),
+    Knob("NHD_AOT", "1",
+         "0 → disable the AOT cache layer entirely (always trace live)"),
+    Knob("NHD_STREAM_NODES", "4096",
+         "above this node count the scheduler solves through the "
+         "streaming tiler (bounded per-solve memory; gpuless preference "
+         "becomes per-tile, see docs/PARITY.md)"),
+    Knob("NHD_MESH", "`auto`",
+         "multi-chip SPMD posture (also `nhd-tpu --mesh`): `auto` shards "
+         "the fused solve+rank megaround over every local device when "
+         "more than one exists, an integer N builds an explicit N-device "
+         "`nodes` mesh (refused if fewer devices are local), `off` "
+         "forces single-device solves. One resolution serves the batch "
+         "scheduler AND the streaming tiler's persistent contexts; "
+         "sharded programs export/prewarm through the AOT cache under "
+         "mesh-qualified keys (docs/PERFORMANCE.md \"SPMD megaround\")"),
+    Knob("NHD_TPU_NATIVE", "1", "0 → disable the C assignment core"),
+    Knob("NHD_TPU_RANK_CAP", "512 accel / 1024 cpu",
+         "ceiling on the on-device top-R rank width; lower cuts "
+         "device→host bytes per round, higher avoids whole extra rounds "
+         "when the capacity-repeat select runs out of ranked candidates "
+         "(512 keeps cfg4 at the uncapped 3 rounds; 128 cost 7)"),
+    Knob("NHD_TPU_CPU_SMALL", "1024",
+         "pending-pod count at or below which a round's solves run on "
+         "the host CPU backend (avoids the accelerator relay turnaround "
+         "for small batches / tail rounds)"),
+    Knob("NHD_TPU_CPU_SMALL_NODES", "1536",
+         "node-count ceiling for the CPU routing above (host solve cost "
+         "scales with nodes × combo lattice)"),
+    Knob("NHD_TPU_DEVICE_STATE", "auto",
+         "force the incremental device-resident cluster-state path on "
+         "(`1`) or off (`0`); unset = auto, on exactly when the backend "
+         "is an accelerator (the chaos device-plane profiles require "
+         "`1`)"),
+    Knob("NHD_TPU_SPECULATE", "`auto`",
+         "speculative on-device multi-round (solver/speculate.py): the "
+         "whole greedy claim loop runs in ONE device dispatch, "
+         "host-verified natively. `auto` = on for accelerator backends "
+         "only; `0`/`1` force. Packing can deviate from classic rounds "
+         "by greedy noise on saturated heterogeneous clusters "
+         "(conservation unaffected)"),
+    Knob("NHD_TPU_SPEC_ITERS", "16",
+         "speculative loop depth = max pods placed per node per "
+         "dispatch; leftovers fall through to classic rounds"),
+    Knob("NHD_TPU_GC_PIN", "on",
+         "0 → never touch gc during gang-scale schedules. By default a "
+         "gang-scale sweep gc.freeze-pins the pre-existing heap AND "
+         "disables automatic collection for its duration (young-gen "
+         "re-scans of the sweep's own result objects measured ~50% of "
+         "the federation materialize phase); a sweep's garbage is "
+         "bounded and reclaimed at the next natural collection. Set 0 "
+         "if the embedding process manages its own gc arrangement"),
+    Knob("NHD_DELTA_STATE", "1",
+         "0 → disable the incremental device-resident cluster state: "
+         "every batch re-encodes + re-uploads from scratch instead of "
+         "folding watch/claim events in as row deltas "
+         "(docs/PERFORMANCE.md \"Incremental device-resident state\")"),
+    Knob("NHD_DEVICE_DELTA", "1",
+         "0 → dirty rows re-upload the resident device arrays WHOLESALE "
+         "(async) instead of as donated row scatters — the right call "
+         "on a relay that charges per flush and nothing per byte "
+         "(docs/TPU_STATUS.md)"),
+    # -- solver guard ------------------------------------------------------
+    Knob("NHD_GUARD", "1",
+         "solver data-plane guard (docs/RESILIENCE.md \"Layer 8\"): 0 "
+         "disables the detect→degrade→repair ladder entirely — "
+         "device-plane faults surface raw and resident-state corruption "
+         "is never audited (the chaos negative-control posture; never "
+         "run production with 0)"),
+    Knob("NHD_GUARD_RETRIES", "2",
+         "transient device-plane faults absorbed per rung per round "
+         "before the guard drops a rung (mesh → single-device → host); "
+         "the whole ladder's budget is `3 × NHD_GUARD_RETRIES` "
+         "re-dispatches per round, then the fault surfaces"),
+    Knob("NHD_GUARD_PROBE_ROUNDS", "8",
+         "consecutive clean solver rounds at a degraded floor before "
+         "the guard re-promotes ONE rung — a flappy device earns its "
+         "way back one probe window at a time"),
+    Knob("NHD_GUARD_AUDIT_INTERVAL", "64",
+         "batches between periodic resident-state audits (bit-exact "
+         "device-row spot checks against the host mirror, run at batch "
+         "start); any fault also schedules an on-suspicion audit for "
+         "the next batch; 0 disables the periodic cadence (suspicion "
+         "audits still run)"),
+    Knob("NHD_GUARD_AUDIT_ROWS", "16",
+         "device rows bit-exact-checked per audit pass, sampled as a "
+         "deterministic rotating window (bounded budgets still reach "
+         "every row over successive audits); 0 = every row every audit "
+         "(`make device-chaos` posture — the one under which faulted "
+         "binds are provably bit-identical to fault-free ones)"),
+    Knob("NHD_GUARD_SHAPE_FAULTS", "3",
+         "device-plane faults attributed to one shape key before it is "
+         "quarantined: its AOT artifact retires to `quarantine/`, its "
+         "installed program is dropped, and its dispatches re-trace "
+         "live"),
+    # -- streaming tiler ---------------------------------------------------
+    Knob("NHD_STREAM_TILE_NODES", "16384 accel / 4096 cpu",
+         "streaming tiler: nodes per tile — smaller bounds per-solve "
+         "memory and shortens each tile's turn (latency), larger "
+         "amortizes solve overhead (throughput). The backend-dependent "
+         "default follows the r5 measurements: on an accelerator every "
+         "tile costs a relay flush plus a host tail, so tiles size up "
+         "to the device-memory budget (one 16k-node tile beat three "
+         "4096-node tiles 2.4 s vs 2.9 s wall, p99 1.2 s vs 2.3 s on "
+         "the 100k×10k federation); on CPU the host pays the solve "
+         "compute directly and the giant tile inverts (12.3 s vs "
+         "~6-7 s at 4096-node tiles), so smaller pipelined tiles win "
+         "(docs/TPU_STATUS.md)"),
+    Knob("NHD_STREAM_CHUNK_PODS", "16384",
+         "streaming tiler: pods per offered chunk — larger amortizes "
+         "encode cost per offer, smaller lowers the latency of the "
+         "first binds"),
+    Knob("NHD_STREAM_PLACEMENT", "`first-fit`",
+         "`first-fit`: chunks enter at tile 0 and spill forward "
+         "(placement identical to the serial sweep). `routed`: pods "
+         "pre-partition across tiles by estimated residual capacity "
+         "and tiles run concurrently (federation posture; spill "
+         "cascades, conservation unchanged)"),
+    Knob("NHD_STREAM_WORKERS", "4 accel / cores÷2 cpu",
+         "streaming tiler: worker threads serving tile pipelines (each "
+         "tile is always served by at most one worker, so per-tile "
+         "claim order is deterministic). Accelerators overlap relay "
+         "waits with 4; on CPU the host spans are now thin enough (r8 "
+         "fused solve, r9 memoized materialization) that extra workers "
+         "buy GIL contention — one worker per two cores measured "
+         "fastest (cfg5 r9: 1 worker 3.75 s vs 2 workers 4.37 s on 2 "
+         "cores)"),
+    # -- scheduler loop ----------------------------------------------------
+    Knob("NHD_PIPELINE", "`auto`",
+         "universal round pipelining (docs/PERFORMANCE.md \"Host round "
+         "loop\"): every round dispatches round r+1's solves before "
+         "running its own host phases, so select/materialize/sync "
+         "execute under the in-flight device compute. `auto` = on "
+         "exactly when the backend is an accelerator (on a host-only "
+         "backend the early dispatch steals cores from the host phases "
+         "it should hide; measured −1.5% sustained churn on CPU CI); "
+         "`1` forces on (the chaos matrices run this way); `0` = "
+         "strict dispatch-at-round-start ordering (the bit-exactness "
+         "control the parity suite pins against)"),
+    Knob("NHD_COMMIT_WORKERS", "1",
+         ">1 runs per-pod annotate→bind commit sequences on a thread "
+         "pool (API round trips dominate gang bind latency); 1 = the "
+         "reference's strictly serial commits"),
+    Knob("NHD_ASYNC_COMMIT", "backend default",
+         "overlapped fenced commit (scheduler/commitpipe.py): batch b's "
+         "API-bound bind commits drain on a bounded in-order pipeline "
+         "while the loop admits+solves batch b+1; fencing epoch read "
+         "at drain, per-node order preserved (strict FIFO), transient "
+         "failures still unwind+requeue, watchdog heartbeat per "
+         "drained commit. Default on for the kube backend, off on the "
+         "fake backend (tests/chaos drive commits synchronously); "
+         "`1`/`0` force. An explicit `NHD_COMMIT_WORKERS`>1 takes "
+         "precedence — the pipeline overlaps batches but serializes "
+         "within one, and must not silently disable intra-batch commit "
+         "parallelism"),
+    Knob("NHD_COMMIT_DEPTH", "256",
+         "commit-pipeline depth: max commits in flight (queued + "
+         "running) before submission backpressures the scheduler loop "
+         "— bounds the window a down API server can absorb"),
+    Knob("NHD_BIND_REQUEUE_MAX", "8",
+         "consecutive transient-commit requeues per pod before it "
+         "takes the terminal-failure path (the periodic reconcile "
+         "still retries later)"),
+    Knob("NHD_SPILLOVER_MAX_AGE_SEC", "120",
+         "cross-shard spillover orphan bound: a spill record older "
+         "than this is force-exhausted by its home-shard owner — "
+         "explicit unschedulable verdict + fresh cycle — even when "
+         "shards sit orphaned mid-rebalance"),
+    # -- control plane / k8s ----------------------------------------------
+    Knob("NHD_K8S_TOKEN_FILE",
+         "`/var/run/secrets/kubernetes.io/serviceaccount/token`",
+         "path of the ServiceAccount bearer-token file the REST client "
+         "authenticates with — point it elsewhere for out-of-cluster "
+         "runs against a proxied API server"),
+    Knob("NHD_WATCH_READ_TIMEOUT", "60",
+         "finite socket timeout (seconds) for watch streams — a "
+         "silently dead socket ends the stream for reconnect instead "
+         "of blocking the watch thread forever (docs/RESILIENCE.md)"),
+    Knob("NHD_RESYNC_SEC", "300",
+         "full-relist resync cadence; diffs live cluster state against "
+         "watch-derived state and emits synthetic events for anything "
+         "missed (0 disables)"),
+    Knob("NHD_LEASE_TTL", "15",
+         "leader-lease duration (seconds): the worst-case leaderless "
+         "window when a leader vanishes without releasing "
+         "(docs/RESILIENCE.md \"HA & fencing\")"),
+    Knob("NHD_LEASE_RENEW_SEC", "4",
+         "lease renew cadence; several renewals fit one TTL so a "
+         "single flaky renewal never costs leadership"),
+    Knob("NHD_LEASE_NS", "`default`",
+         "namespace the election Lease object lives in (set to the "
+         "Deployment's own namespace)"),
+    Knob("NHD_FENCE_CACHE_SEC", "1.0",
+         "seconds a fetched shard-fencing epoch is served from cache "
+         "before the Lease is re-read — bounds fencing staleness "
+         "against API reads per commit (an epoch can only advance "
+         "after a lease loss, which takes ≥ TTL)"),
+    Knob("NHD_WATCHDOG_STALL_SEC", "120",
+         "scheduling-loop heartbeat budget before the stall watchdog "
+         "releases the lease and crash-exits. The heartbeat advances "
+         "at every loop turn and at intra-turn progress points (batch "
+         "admission, solve completion, each commit, replay phases), so "
+         "size it for the longest single solve or API call, not a "
+         "whole batch"),
+    Knob("NHD_WATCHDOG_POLL_SEC", "5", "stall-watchdog check cadence"),
+    Knob("NHD_SHARDS", "1",
+         "shard the node-group set across S federated leases "
+         "(`--shards`); 1 = no federation. Each replica "
+         "rendezvous-leases a subset and fences every commit with the "
+         "owning shard's epoch (docs/RESILIENCE.md \"Federation\")"),
+    Knob("NHD_SHARD_PATIENCE_TICKS", "2",
+         "ticks a non-preferred replica waits on an unheld shard lease "
+         "before taking it anyway (the preferred owner is wedged or "
+         "partitioned); bounds per-shard leadership gaps at TTL + "
+         "patience renew intervals"),
+    # -- observability -----------------------------------------------------
+    Knob("NHD_TRACE_CAPACITY", "16384",
+         "flight-recorder span ring size (`--trace-out`)"),
+    Knob("NHD_TRACE_EXPLAIN_MAX", "16",
+         "batches at/below this size attach solver/explain.py reasons "
+         "to unschedulable decisions when tracing is on"),
+    Knob("NHD_TRACE_EXPLAIN_MAX_NODES", "512",
+         "node-count ceiling for the same explain attachment (the walk "
+         "is serial per node)"),
+    Knob("NHD_LOG_JSON", "0",
+         "1 → one-line JSON log records stamped with the correlation "
+         "ID"),
+    Knob("NHD_TPU_LOG_LEVEL", "`WARNING`",
+         "package-wide log level for the `nhd_tpu.*` loggers (any "
+         "stdlib logging level name)"),
+    Knob("NHD_SLO_BIND_SEC", "30",
+         "time-to-bind SLO target, measured creation→bound on the "
+         "cluster's clock (survives spills, handoffs and restarts; "
+         "docs/OBSERVABILITY.md \"SLO engine\")"),
+    Knob("NHD_SLO_GOOD_FRACTION", "0.99",
+         "fraction of binds that must meet the target; the error "
+         "budget the `nhd_slo_bind_burn_rate` windows burn against"),
+    Knob("NHD_FLEET_DIR", "`artifacts/fleet`",
+         "where ChaosSim's violation-triggered fleet artifacts land"),
+    # -- policy engine -----------------------------------------------------
+    Knob("NHD_POLICY", "0",
+         "scheduling-policy engine master switch "
+         "(docs/SCHEDULING_POLICIES.md): heterogeneity-aware scoring + "
+         "priority tiers + bounded preemption. `0` is the pinned "
+         "pre-policy behavior — placements bit-exact with the engine "
+         "absent"),
+    Knob("NHD_POLICY_TPUT", "unset",
+         "per-(workload kind, node class) throughput matrix — inline "
+         "JSON or `@/path/file.json`; unset/malformed degrades to "
+         "uniform (placement-neutral) scoring"),
+    Knob("NHD_POLICY_PREEMPT", "1",
+         "0 → scoring-only posture: tiers and the throughput matrix "
+         "stay live, eviction is disabled"),
+    Knob("NHD_POLICY_PREEMPT_ROUND_BUDGET", "4",
+         "max evictions one scheduling batch may execute"),
+    Knob("NHD_POLICY_PREEMPT_TENANT_BUDGET", "2",
+         "max evictions one batch may charge a single tenant "
+         "(namespace)"),
+    Knob("NHD_POLICY_PREEMPT_ATTEMPTS", "2",
+         "preemption attempts per pod before it takes the plain "
+         "unschedulable verdict (the livelock bound)"),
+    # -- bench -------------------------------------------------------------
+    Knob("NHD_SPMD_PODS", "4096",
+         "pods in the cfg6 SPMD bench leg (`spmd-smoke` uses 512); "
+         "raise for the full-scale tunnel run", scope="bench"),
+    Knob("NHD_SPMD_NODES", "1024",
+         "nodes in the cfg6 SPMD bench leg (`spmd-smoke` uses 256)",
+         scope="bench"),
+    Knob("NHD_SPMD_DEVICES", "8",
+         "virtual device count for the SPMD bench leg's child mesh",
+         scope="bench"),
+    Knob("NHD_BENCH_PLATFORM", "auto",
+         "force the JAX platform bench.py legs run on (`cpu`, `tpu`, "
+         "...); unset = the backend JAX auto-selects", scope="bench"),
+    Knob("NHD_BENCH_SMOKE", "unset",
+         "1 → bench.py smoke posture: tiny shapes, every leg still "
+         "exercised (`make bench-smoke`)", scope="bench"),
+    Knob("NHD_BENCH_PROFILE", "unset",
+         "directory to wrap the churn leg in `jax.profiler.trace` "
+         "(view with TensorBoard/xprof); unset = no profiling",
+         scope="bench"),
+    Knob("NHD_BENCH_SKIP_SPMD", "unset",
+         "1 → skip bench.py's SPMD leg (no multi-device mesh "
+         "available)", scope="bench"),
+    Knob("NHD_BENCH_SKIP_FED", "unset",
+         "1 → skip bench.py's federation leg", scope="bench"),
+    Knob("NHD_BENCH_SKIP_CHURN", "unset",
+         "1 → skip bench.py's sustained-churn leg", scope="bench"),
+    Knob("NHD_BENCH_ARTIFACT_DIR", "`artifacts/bench`",
+         "where bench.py writes its schema-versioned perf artifact per "
+         "run", scope="bench"),
+    Knob("NHD_BENCH_NO_ARTIFACT", "unset",
+         "1 → bench.py skips the artifact write (stdout contract "
+         "unchanged either way)", scope="bench"),
+    # -- test harness ------------------------------------------------------
+    Knob("NHD_SAN", "unset",
+         "1 → tests/conftest.py installs the concurrency sanitizer "
+         "(nhd_tpu/sanitizer) for the whole pytest session: every "
+         "Lock/RLock/Condition created afterwards is wrapped and "
+         "blocking entry points are witnessed", scope="test"),
+    Knob("NHD_SAN_REPORT", "`/tmp/nhd_san_report.json`",
+         "where the sanitizer session fixture writes its JSON witness "
+         "report", scope="test"),
+)
+
+
+def validate() -> List[str]:
+    """Registry self-checks; a non-empty return fails knobs_sync and
+    the unit tests."""
+    errors: List[str] = []
+    seen = set()
+    for knob in KNOBS:
+        if not knob.name.startswith("NHD_") or not knob.name.isupper():
+            errors.append(f"{knob.name}: knob names must be NHD_UPPER_CASE")
+        if knob.name in seen:
+            errors.append(f"{knob.name}: duplicate registry entry")
+        seen.add(knob.name)
+        if knob.scope not in SCOPES:
+            errors.append(f"{knob.name}: unknown scope {knob.scope!r}")
+        if not knob.doc.strip():
+            errors.append(f"{knob.name}: empty doc")
+        if "\n" in knob.doc or "|" in knob.doc:
+            errors.append(
+                f"{knob.name}: doc must be one markdown table cell "
+                f"(no newlines or '|')"
+            )
+    return errors
+
+
+def registered_names() -> FrozenSet[str]:
+    return frozenset(k.name for k in KNOBS)
+
+
+#: markers knobs_sync.py replaces between in docs/OPERATIONS.md
+TABLE_BEGIN = "<!-- knobs:begin -->"
+TABLE_END = "<!-- knobs:end -->"
+
+
+def operations_table() -> str:
+    """The full markdown tunables table, one row per knob, in registry
+    (subsystem-grouped) order."""
+    lines = [
+        TABLE_BEGIN,
+        "| Variable | Default | Meaning |",
+        "|---|---|---|",
+    ]
+    for knob in KNOBS:
+        lines.append(f"| `{knob.name}` | {knob.default} | {knob.doc} |")
+    lines.append(TABLE_END)
+    return "\n".join(lines) + "\n"

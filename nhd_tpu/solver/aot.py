@@ -360,6 +360,9 @@ class AotCache:
             try:
                 progress()
             except Exception:  # nhdlint: ignore[NHD302]
+                # justified broad catch: progress is an arbitrary
+                # caller-supplied callback; prewarm must finish whatever
+                # it raises
                 pass
 
         for fname in sorted(os.listdir(directory)):

@@ -692,9 +692,10 @@ class BatchScheduler:
             counts_arr.copy_to_host_async()
             need_arr.copy_to_host_async()
             it_arr.copy_to_host_async()
-        except Exception:  # nhdlint: ignore[NHD302]
+        except (AttributeError, NotImplementedError, RuntimeError):
             pass  # best-effort prefetch hint; backend without async host
             #      copies just pays the full flush at the sync pull
+            #      (AttributeError covers host-backend numpy results)
         return SpecDispatch(
             bucket_keys, bucket_pods, claims_arr, counts_arr,
             need_arr, it_arr, certifiable,
@@ -1377,8 +1378,8 @@ class BatchScheduler:
                 attributes) for the guard's quarantine ledger."""
                 try:
                     exc._nhd_shape_key = _shape_key(G, pods, host)
-                except Exception:  # nhdlint: ignore[NHD302]
-                    pass
+                except (AttributeError, TypeError):
+                    pass  # slotted / C-extension exception types
 
             def _dispatch_solves(use_cpu: bool = False):
                 launched = []
@@ -1556,7 +1557,8 @@ class BatchScheduler:
                     for G, pods, out in launched:
                         try:
                             out.copy_to_host_async()  # batch bucket pulls
-                        except Exception:  # nhdlint: ignore[NHD302]
+                        except (AttributeError, NotImplementedError,
+                                RuntimeError):
                             pass  # prefetch hint only; sync pull works
                     for G, pods, out in launched:
                         # pull results to host in ONE transfer — the rank

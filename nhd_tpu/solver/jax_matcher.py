@@ -95,8 +95,10 @@ class JaxMatcher:
             return results
 
         # one-shot snapshot evaluation (the reference-parity surface):
-        # no rounds, no events — a delta would have nothing to reuse
-        cluster = encode_cluster(nodes, now=now)  # nhdlint: ignore[NHD108]
+        # no rounds, no events — a delta would have nothing to reuse.
+        # Sanctioned NHD108 chokepoint (analysis/rules_tracing.py
+        # _ENCODE_SANCTIONED "jax_matcher:find_nodes").
+        cluster = encode_cluster(nodes, now=now)
         if not respect_busy:
             cluster.busy[:] = False
 

@@ -1,0 +1,33 @@
+"""Out-of-scope shapes the contract pack must stay silent on:
+
+* env reads with no knob registry in the analyzed project (NHD720 is
+  judgeable only when both sides of the contract are visible);
+* non-NHD env reads next to a registry-shaped tuple;
+* stride math on a base that is not the speculate pod_args block;
+* .index() into a non-contract tuple;
+* a span expression passed to a kwarg that is not in_shardings.
+"""
+
+import os
+
+FLAG = os.environ.get("NHD_SOME_FLAG", "0")  # no registry: out of scope
+HOME = os.environ.get("HOME", "/root")
+
+OTHER_ORDER = ("a", "b")
+I = OTHER_ORDER.index("zzz")  # not a contract tuple
+
+spec = object()
+
+
+def jit(fn, **kw):
+    return fn
+
+
+def misc(fn):
+    # out_shardings is not the solve-signature input span
+    return jit(fn, out_shardings=(spec,) * 4 + (spec,) * 2)
+
+
+def windows(samples, b):
+    # not pod_args: stride math on unrelated buffers is fine
+    return samples[3 * b : 3 * b + 3]

@@ -1,0 +1,15 @@
+"""Drift-injection project, AOT layer: the program fingerprint hashes
+every module whose source defines placement semantics."""
+
+import hashlib
+import inspect
+
+import combos_like
+import kernel_like
+
+
+def program_fingerprint():
+    h = hashlib.sha256()
+    for mod in (kernel_like, combos_like):
+        h.update(inspect.getsource(mod).encode())
+    return h.hexdigest()

@@ -1,0 +1,6 @@
+"""Drift-injection project, combo-table layer: defines get_tables, so
+the AOT fingerprint must hash this module's source."""
+
+
+def get_tables(u, k):
+    return [(u, k)]

@@ -1,0 +1,9 @@
+"""Drift-injection project, delta layer: DELTA_FIELDS mirrors
+kernel_like._ARG_ORDER exactly (same set, same order)."""
+
+DELTA_FIELDS = (
+    "cpu",
+    "mem",
+    "nic",
+    "busy",
+)

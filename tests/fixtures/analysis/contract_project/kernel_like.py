@@ -1,0 +1,31 @@
+"""Drift-injection project, kernel layer: the signature tuples, the
+donation partition and the mesh sharding spans. Consistent as shipped;
+tests mutate copies of these modules to prove each drift is caught."""
+
+node_spec = object()
+repl_spec = object()
+
+
+def jit(fn, **kw):
+    return fn
+
+
+_ARG_ORDER = (
+    "cpu",
+    "mem",
+    "nic",
+    "busy",
+)
+_POD_ARG_ORDER = ("p_cpu", "p_mem", "p_nic")
+_MUTABLE = ("cpu", "busy")
+_STATIC = ("mem", "nic")
+
+
+def solve(args):
+    return args
+
+
+def get_solver():
+    in_shardings = (node_spec,) * len(_ARG_ORDER) \
+        + (repl_spec,) * len(_POD_ARG_ORDER)
+    return jit(solve, in_shardings=in_shardings)

@@ -1,0 +1,13 @@
+"""Drift-injection project, speculate layer: flattened pod-block stride
+math and the positional unpack, both spanning _POD_ARG_ORDER."""
+
+from kernel_like import _POD_ARG_ORDER
+
+
+def pod_block(pod_args, b):
+    return pod_args[3 * b : 3 * b + 3]
+
+
+def unpack_block(pod_args, b):
+    p_cpu, p_mem, p_nic = pod_args[3 * b : 3 * b + 3]
+    return p_cpu, p_mem, p_nic

@@ -1,0 +1,16 @@
+"""Registered env knobs (NHD720 negative): every NHD_* read appears in
+the registry; non-NHD reads are out of the rule's scope entirely."""
+
+import os
+
+from nhd_tpu.config.knobs import Knob
+
+KNOBS = (
+    Knob("NHD_DOCUMENTED", "1", "present in the registry"),
+    Knob("NHD_ALSO_DOCUMENTED", "0", "also present"),
+)
+
+A = os.environ.get("NHD_DOCUMENTED", "1")
+B = os.environ["NHD_ALSO_DOCUMENTED"]
+HOME = os.environ.get("HOME", "/root")
+PATH = os.environ["PATH"]

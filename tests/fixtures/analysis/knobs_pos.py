@@ -1,0 +1,15 @@
+"""Unregistered env knobs (NHD720): a knob registry exists in this
+project, so every NHD_* read must appear in it."""
+
+import os
+
+from nhd_tpu.config.knobs import Knob
+
+KNOBS = (
+    Knob("NHD_DOCUMENTED", "1", "present in the registry"),
+)
+
+GOOD = os.environ.get("NHD_DOCUMENTED", "1")
+BAD = os.environ.get("NHD_SECRET_TOGGLE", "0")  # EXPECT[NHD720]
+WORSE = os.environ["NHD_RAW_SUBSCRIPT"]  # EXPECT[NHD720]
+ALSO = os.getenv("NHD_VIA_GETENV")  # EXPECT[NHD720]

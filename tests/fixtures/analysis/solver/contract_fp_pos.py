@@ -1,0 +1,23 @@
+"""AOT fingerprint-source omission (NHD703): this module defines both
+_ARG_ORDER and get_tables — placement semantics — but the program
+fingerprint hashes only the helper module, so editing this file would
+not invalidate cached artifacts."""
+
+import hashlib
+import inspect
+
+import combos_like as combos
+
+_ARG_ORDER = ("cpu", "mem")
+_POD_ARG_ORDER = ("p_cpu",)
+
+
+def get_tables(u, k):
+    return [(u, k)]
+
+
+def _program_fingerprint():
+    h = hashlib.sha256()
+    for mod in (combos,):  # EXPECT[NHD703]
+        h.update(inspect.getsource(mod).encode())
+    return h.hexdigest()

@@ -1,0 +1,43 @@
+"""Consistent solve-signature contract: the same consumer layers as
+contract_pos.py, all in step — must produce zero NHD7xx findings."""
+
+node_spec = object()
+repl_spec = object()
+
+
+def jit(fn, **kw):
+    return fn
+
+
+_ARG_ORDER = (
+    "cpu",
+    "mem",
+    "nic",
+)
+_POD_ARG_ORDER = ("p_cpu", "p_mem")
+_MUTABLE = ("cpu", "nic")
+_STATIC = ("mem",)
+DELTA_FIELDS = ("cpu", "mem", "nic")
+
+CPU_I = _ARG_ORDER.index("cpu")
+
+
+def solve(args):
+    return args
+
+
+# symbolic spans derived from the right tuples are always in step
+SOLVER = jit(
+    solve,
+    in_shardings=(node_spec,) * len(_ARG_ORDER)
+    + (repl_spec,) * len(_POD_ARG_ORDER),
+)
+
+
+def unpack_blocks(pod_args, b):
+    return pod_args[2 * b : 2 * b + 2]
+
+
+def unpack_names(pod_args, b):
+    p_cpu, p_mem = pod_args[2 * b : 2 * b + 2]
+    return p_cpu, p_mem

@@ -1,0 +1,50 @@
+"""Deliberate solve-signature drift — every NHD701/NHD702 shape.
+
+Single-module contract project: this file defines the contract tuples
+AND the out-of-step consumers, so analyze_file's one-module project
+exercises the cross-layer checks.
+"""
+
+node_spec = object()
+repl_spec = object()
+
+
+def jit(fn, **kw):
+    return fn
+
+
+_ARG_ORDER = (  # EXPECT[NHD701]
+    # 'nic' is in neither _MUTABLE nor _STATIC: the partition drops it
+    "cpu",
+    "mem",
+    "nic",
+)
+_POD_ARG_ORDER = ("p_cpu", "p_mem")
+_MUTABLE = ("cpu", "ghost")  # EXPECT[NHD701]
+_STATIC = ("mem", "cpu")  # EXPECT[NHD702]
+DELTA_FIELDS = ("cpu", "mem")  # EXPECT[NHD701]
+
+CPU_I = _ARG_ORDER.index("gpu")  # EXPECT[NHD701]
+
+
+def solve(args):
+    return args
+
+
+# node span literal 4 != len(_ARG_ORDER) == 3
+SOLVER = jit(
+    solve,
+    in_shardings=(node_spec,) * 4 + (repl_spec,) * 2,  # EXPECT[NHD701]
+)
+
+
+def unpack_blocks(pod_args, b):
+    # stride 3 != len(_POD_ARG_ORDER) == 2: every block after the first
+    # is misaligned
+    chunk = pod_args[3 * b : 3 * b + 3]  # EXPECT[NHD701]
+    return chunk
+
+
+def unpack_names(pod_args, b):
+    p_cpu, p_mem, p_ghost = pod_args[2 * b : 2 * b + 2]  # EXPECT[NHD701]
+    return p_cpu, p_mem, p_ghost

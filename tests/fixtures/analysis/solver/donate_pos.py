@@ -1,0 +1,41 @@
+"""The PR 9 `_pad_own` donated-alias double-claim bug, pinned (NHD710).
+
+`_pad_rows_to` passes its argument through unpadded (`return a`), so a
+host-mirror array read with `getattr()` reaches the donated position of
+the row-scatter dispatch as a zero-copy `jnp.asarray` view — the donated
+program then mutates the live host mirror in place.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pad_rows_to(a, size):
+    if a.shape[0] == size:
+        return a  # aliasing passthrough — the historical bug
+    out = np.zeros((size,) + a.shape[1:], a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+def _row_scatter(dst, idx, rows):
+    return dst.at[idx].set(rows)
+
+
+def _get_row_scatter(donate):
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(_row_scatter, **kwargs)
+
+
+class DeviceState:
+    def __init__(self, cluster, names, size):
+        self._dev = {}
+        for name in names:
+            self._dev[name] = jnp.asarray(
+                _pad_rows_to(getattr(cluster, name), size)
+            )
+
+    def scatter_rows(self, name, idx, rows):
+        fn = _get_row_scatter(True)
+        self._dev[name] = fn(self._dev[name], idx, rows)  # EXPECT[NHD710]

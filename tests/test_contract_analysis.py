@@ -1,0 +1,305 @@
+"""nhdlint contract pack (NHD7xx): drift injection, donation taint,
+knob registry, differential mode and SARIF output.
+
+Complements tests/test_static_analysis.py (which owns the per-fixture
+EXPECT comparisons and the tier-1 gate): the tests here exercise the
+*project-level* behaviors — mutate one consumer layer of a consistent
+multi-module fixture project and assert the finding names the specific
+layer that fell out of step, exactly the acceptance shape of ISSUE 16.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import subprocess
+import sys
+from pathlib import Path
+from typing import Dict, List
+
+import pytest
+
+from nhd_tpu.analysis.core import ModuleSource
+from nhd_tpu.analysis.rules_contract import check_project
+from nhd_tpu.analysis.cli import main as cli_main
+
+REPO = Path(__file__).resolve().parent.parent
+PROJECT = Path(__file__).resolve().parent / "fixtures" / "analysis" \
+    / "contract_project"
+
+
+def _load_project(overrides: Dict[str, str] | None = None) -> List[ModuleSource]:
+    """The drift fixture project, optionally with per-file text
+    replacements applied (old -> new, must hit exactly once)."""
+    overrides = overrides or {}
+    modules = []
+    for path in sorted(PROJECT.glob("*.py")):
+        src = path.read_text()
+        if path.name in overrides:
+            old, new = overrides[path.name]
+            assert src.count(old) == 1, f"ambiguous mutation in {path.name}"
+            src = src.replace(old, new)
+        modules.append(ModuleSource(path.as_posix(), src, ast.parse(src)))
+    return modules
+
+
+def _messages(findings, rule):
+    return [f.message for f in findings if f.rule == rule]
+
+
+def test_project_is_consistent_as_shipped():
+    assert check_project(_load_project()) == []
+
+
+# ---------------------------------------------------------------------------
+# drift injection: remove one array from one consumer layer, assert the
+# finding names that specific layer
+# ---------------------------------------------------------------------------
+
+def test_drift_delta_fields_names_the_delta_layer():
+    findings = check_project(_load_project({
+        "encode_like.py": ('    "nic",\n', ""),
+    }))
+    msgs = _messages(findings, "NHD701")
+    assert any(
+        "'nic'" in m and "missing from DELTA_FIELDS" in m
+        and "delta layer" in m
+        for m in msgs
+    ), msgs
+
+
+def test_drift_delta_order_is_nhd702():
+    findings = check_project(_load_project({
+        "encode_like.py": ('"cpu",\n    "mem"', '"mem",\n    "cpu"'),
+    }))
+    msgs = _messages(findings, "NHD702")
+    assert any("order diverges from _ARG_ORDER" in m for m in msgs), msgs
+
+
+def test_drift_mesh_sharding_names_the_sharding_layer():
+    findings = check_project(_load_project({
+        "kernel_like.py": ("(node_spec,) * len(_ARG_ORDER)",
+                           "(node_spec,) * 3"),
+    }))
+    msgs = _messages(findings, "NHD701")
+    assert any(
+        "in_shardings" in m and "mesh sharding layer" in m for m in msgs
+    ), msgs
+
+
+def test_drift_speculate_stride_names_the_stride_layer():
+    findings = check_project(_load_project({
+        "speculate_like.py": ("def pod_block(pod_args, b):\n"
+                              "    return pod_args[3 * b : 3 * b + 3]",
+                              "def pod_block(pod_args, b):\n"
+                              "    return pod_args[4 * b : 4 * b + 4]"),
+    }))
+    msgs = _messages(findings, "NHD701")
+    assert any(
+        "stride" in m and "speculate stride layer" in m for m in msgs
+    ), msgs
+
+
+def test_drift_unpack_arity():
+    findings = check_project(_load_project({
+        "speculate_like.py": ("p_cpu, p_mem, p_nic = ",
+                              "p_cpu, p_mem = "),
+    }))
+    msgs = _messages(findings, "NHD701")
+    assert any("unpacks 2 names" in m for m in msgs), msgs
+
+
+def test_drift_fingerprint_source_names_the_module():
+    findings = check_project(_load_project({
+        "aot_like.py": ("for mod in (kernel_like, combos_like):",
+                        "for mod in (kernel_like,):"),
+    }))
+    msgs = _messages(findings, "NHD703")
+    assert any(
+        "'combos_like'" in m and "defines get_tables" in m for m in msgs
+    ), msgs
+
+
+def test_drift_partition_drop():
+    findings = check_project(_load_project({
+        "kernel_like.py": ('_MUTABLE = ("cpu", "busy")',
+                           '_MUTABLE = ("cpu",)'),
+    }))
+    msgs = _messages(findings, "NHD701")
+    assert any(
+        "'busy'" in m and "neither _MUTABLE nor _STATIC" in m for m in msgs
+    ), msgs
+
+
+def test_conflicting_redefinition_is_nhd702():
+    findings = check_project(_load_project({
+        "encode_like.py": (
+            '"busy",\n)',
+            '"busy",\n)\n\nDELTA_FIELDS = ("cpu", "mem")',
+        ),
+    }))
+    msgs = _messages(findings, "NHD702")
+    assert any("conflicting definition of DELTA_FIELDS" in m for m in msgs), \
+        msgs
+
+
+def test_test_modules_are_outside_the_contract_model(tmp_path):
+    """A test_*.py or conftest.py module never contributes definitions
+    or consumers — its scratch tuples must not poison the project."""
+    src = 'DELTA_FIELDS = ("bogus",)\n'
+    modules = _load_project() + [
+        ModuleSource((tmp_path / "test_scratch.py").as_posix(), src,
+                     ast.parse(src)),
+        ModuleSource((tmp_path / "conftest.py").as_posix(), src,
+                     ast.parse(src)),
+    ]
+    assert check_project(modules) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline rotation for the contract pack
+# ---------------------------------------------------------------------------
+
+def test_contract_findings_rotate_through_the_baseline(tmp_path, capsys):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    for path in PROJECT.glob("*.py"):
+        text = path.read_text()
+        if path.name == "encode_like.py":
+            text = text.replace('    "nic",\n', "")  # inject drift
+        (proj / path.name).write_text(text)
+    baseline = tmp_path / "bl.json"
+
+    # drift present, no baseline: fails
+    assert cli_main([str(proj), "--baseline", str(baseline)]) == 1
+    # grandfather it
+    assert cli_main([str(proj), "--baseline", str(baseline),
+                     "--write-baseline"]) == 0
+    # same drift is now baselined, exit clean
+    assert cli_main([str(proj), "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+    # fixing the drift leaves a stale baseline entry, still exit 0
+    (proj / "encode_like.py").write_text(
+        (PROJECT / "encode_like.py").read_text()
+    )
+    assert cli_main([str(proj), "--baseline", str(baseline)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# --diff-base differential mode + --sarif
+# ---------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, check=True, capture_output=True,
+    )
+
+
+@pytest.fixture()
+def diff_repo(tmp_path, monkeypatch):
+    """A throwaway git repo holding one committed clean module."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "mod.py").write_text(
+        "import os\n"
+        'KNOBS = ()\n'
+        'A = os.environ.get("NHD_OLD_UNREGISTERED", "0")\n'
+    )
+    _git(repo, "add", "mod.py")
+    _git(repo, "commit", "-qm", "seed")
+    monkeypatch.chdir(repo)
+    return repo
+
+
+def test_diff_base_gates_only_changed_lines(diff_repo, capsys):
+    # grow the file: the NEW unregistered read is on a changed line, the
+    # pre-existing one is not
+    (diff_repo / "mod.py").write_text(
+        "import os\n"
+        'KNOBS = ()\n'
+        'A = os.environ.get("NHD_OLD_UNREGISTERED", "0")\n'
+        'B = os.environ.get("NHD_NEW_UNREGISTERED", "0")\n'
+    )
+    rc = cli_main(["mod.py", "--packs", "contract", "--no-baseline",
+                   "--diff-base", "HEAD"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "NHD_NEW_UNREGISTERED" in out
+    assert "advisory: NHD720" in out  # the old one: visible, not gating
+
+
+def test_diff_base_passes_with_only_preexisting_findings(diff_repo, capsys):
+    rc = cli_main(["mod.py", "--packs", "contract", "--no-baseline",
+                   "--diff-base", "HEAD"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 off-diff advisory" in out
+
+
+def test_diff_base_bad_rev_is_a_usage_error(diff_repo):
+    assert cli_main(["mod.py", "--packs", "contract", "--no-baseline",
+                     "--diff-base", "no-such-rev"]) == 2
+
+
+def test_sarif_output(tmp_path, capsys):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mod.py").write_text(
+        "import os\n"
+        "KNOBS = ()\n"
+        'A = os.environ.get("NHD_UNREGISTERED", "0")\n'
+    )
+    sarif = tmp_path / "out" / "lint.sarif"
+    rc = cli_main([str(proj), "--packs", "contract", "--no-baseline",
+                   "--sarif", str(sarif)])
+    capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(sarif.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "nhdlint"
+    [rule] = [r for r in run["tool"]["driver"]["rules"]
+              if r["id"] == "NHD720"]
+    assert rule["properties"]["pack"] == "contract"
+    [result] = run["results"]
+    assert result["ruleId"] == "NHD720"
+    assert result["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 3
+    assert result["partialFingerprints"]["nhdlintFingerprint/v1"]
+
+
+# ---------------------------------------------------------------------------
+# knob registry <-> OPERATIONS.md lockstep
+# ---------------------------------------------------------------------------
+
+def test_knobs_registry_validates():
+    from nhd_tpu.config import knobs
+
+    assert knobs.validate() == []
+    assert len(knobs.registered_names()) == len(knobs.KNOBS)
+
+
+def test_operations_table_is_in_sync_with_registry():
+    """What `make check` runs; failing here means someone edited the
+    table by hand or registered a knob without --write."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "knobs_sync.py"), "--check"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_live_tree_is_contract_clean():
+    """The acceptance gate: nhd_tpu/ + tools/ carry zero NHD7xx
+    findings (no baseline, no suppressions needed)."""
+    from nhd_tpu.analysis import analyze_paths
+
+    reports = analyze_paths(
+        [str(REPO / "nhd_tpu"), str(REPO / "tools")], ["contract"]
+    )
+    findings = [f for r in reports for f in r.findings]
+    assert findings == [], [
+        (f.rule, f.path, f.line, f.message) for f in findings
+    ]

@@ -82,6 +82,15 @@ def found_of(path: Path, packs=None) -> set:
     ("lockgraph_neg.py", ["lockgraph"]),
     ("metrics_pos.py", ["metrics"]),
     ("metrics_neg.py", ["metrics"]),
+    ("solver/contract_pos.py", ["contract"]),
+    ("solver/contract_neg.py", ["contract"]),
+    ("contract_out_of_scope.py", ["contract"]),
+    ("solver/contract_fp_pos.py", ["contract"]),
+    ("solver/contract_fp_neg.py", ["contract"]),
+    ("solver/donate_pos.py", ["contract"]),
+    ("solver/donate_neg.py", ["contract"]),
+    ("knobs_pos.py", ["contract"]),
+    ("knobs_neg.py", ["contract"]),
 ])
 def test_fixture_exact_findings(name, packs):
     path = FIXTURES / name
@@ -90,7 +99,9 @@ def test_fixture_exact_findings(name, packs):
 
 _POS_FIXTURES = ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
                  "solver/det_pos.py", "scheduler/fence_pos.py",
-                 "lockgraph_pos.py", "metrics_pos.py")
+                 "lockgraph_pos.py", "metrics_pos.py",
+                 "solver/contract_pos.py", "solver/contract_fp_pos.py",
+                 "solver/donate_pos.py", "knobs_pos.py")
 
 
 def test_fixtures_have_positive_coverage_for_every_pack():

@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""Keep docs/OPERATIONS.md's tunables table in lockstep with the knob
+registry (nhd_tpu/config/knobs.py).
+
+    python tools/knobs_sync.py --check    # CI: exit 1 on any drift
+    python tools/knobs_sync.py --write    # regenerate the table in place
+
+Beyond the table itself, --check cross-references the registry against
+every ``NHD_*`` environment read in the repo (via the nhdlint contract
+extractor): an unregistered read or a registry entry nothing reads is
+drift too. nhdlint's NHD720 enforces the read→registry direction on the
+analyzed set; this tool closes the loop repo-wide (bench.py included)
+and adds the registry→read direction.
+
+Stdlib-only, like the rest of the lint toolchain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import sys
+from pathlib import Path
+from typing import List, Set, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from nhd_tpu.analysis.contracts import build_model  # noqa: E402
+from nhd_tpu.analysis.core import ModuleSource  # noqa: E402
+from nhd_tpu.config import knobs  # noqa: E402
+
+OPERATIONS = REPO / "docs" / "OPERATIONS.md"
+
+#: where env reads are collected from for the cross-reference.
+SCAN_ROOTS = ("nhd_tpu", "tools", "tests", "bench.py")
+
+#: registry entries allowed to have no in-repo read (none today; add a
+#: name here with a comment if a knob is consumed by an external agent).
+READLESS_OK: Set[str] = set()
+
+
+def _scan_env_reads() -> Set[str]:
+    modules: List[ModuleSource] = []
+    fixtures = REPO / "tests" / "fixtures"
+    for root in SCAN_ROOTS:
+        p = REPO / root
+        files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in files:
+            if fixtures in f.parents:
+                continue  # deliberate-violation lint fixtures
+            try:
+                src = f.read_text(encoding="utf-8")
+                tree = ast.parse(src)
+            except (OSError, SyntaxError):
+                continue
+            modules.append(ModuleSource(f.as_posix(), src, tree))
+    model = build_model(modules)
+    return {r.name for r in model.env_reads if r.name.startswith("NHD_")}
+
+
+def _split_doc(text: str) -> Tuple[str, str, str]:
+    """(head, generated-region, tail) around the knob markers."""
+    begin = text.find(knobs.TABLE_BEGIN)
+    end = text.find(knobs.TABLE_END)
+    if begin < 0 or end < 0 or end < begin:
+        raise SystemExit(
+            f"knobs_sync: markers not found in {OPERATIONS}; expected a "
+            f"region delimited by the knobs:begin/knobs:end comments"
+        )
+    end += len(knobs.TABLE_END)
+    # the generated block owns one trailing newline
+    if text[end:end + 1] == "\n":
+        end += 1
+    return text[:begin], text[begin:end], text[end:]
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--check", action="store_true",
+                      help="exit 1 if the table or registry has drifted")
+    mode.add_argument("--write", action="store_true",
+                      help="regenerate the OPERATIONS.md table in place")
+    args = ap.parse_args(argv)
+
+    problems: List[str] = list(knobs.validate())
+
+    reads = _scan_env_reads()
+    registered = knobs.registered_names()
+    for name in sorted(reads - registered):
+        problems.append(
+            f"{name}: read in the repo but missing from "
+            f"nhd_tpu/config/knobs.py (register it with a doc line)"
+        )
+    for name in sorted(registered - reads - READLESS_OK):
+        problems.append(
+            f"{name}: registered in knobs.py but nothing in the repo "
+            f"reads it (stale entry — delete it or add to READLESS_OK)"
+        )
+
+    text = OPERATIONS.read_text(encoding="utf-8")
+    head, current, tail = _split_doc(text)
+    regenerated = knobs.operations_table()
+
+    if args.write:
+        if problems:
+            print("knobs_sync: refusing to write with registry problems:",
+                  file=sys.stderr)
+            for p in problems:
+                print(f"  - {p}", file=sys.stderr)
+            return 1
+        if current != regenerated:
+            OPERATIONS.write_text(head + regenerated + tail,
+                                  encoding="utf-8")
+            print(f"knobs_sync: rewrote table in {OPERATIONS} "
+                  f"({len(knobs.KNOBS)} knobs)")
+        else:
+            print("knobs_sync: table already up to date")
+        return 0
+
+    if current != regenerated:
+        problems.append(
+            f"{OPERATIONS}: tunables table out of date with knobs.py — "
+            f"run `python tools/knobs_sync.py --write`"
+        )
+    if problems:
+        print("knobs_sync: FAIL", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(f"knobs_sync: OK ({len(knobs.KNOBS)} knobs, "
+          f"{len(reads)} distinct reads)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
