@@ -19,7 +19,7 @@ DEV_STEPS ?= 40
 POLICY_SEEDS ?= 3
 POLICY_STEPS ?= 40
 
-.PHONY: test lint lint-diff knobs-check sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos policy-chaos trace-demo fleet-demo docker docker-smoke release
+.PHONY: test lint lint-diff knobs-check sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos policy-chaos trace-demo replay-demo fleet-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -87,6 +87,7 @@ sanitize:
 check: lint lint-diff knobs-check test
 	$(MAKE) bench-smoke
 	$(MAKE) fleet-demo
+	$(MAKE) replay-demo
 	$(MAKE) device-chaos
 	$(MAKE) policy-chaos
 
@@ -207,6 +208,14 @@ policy-chaos:
 # trace, validate its schema + per-pod span pipeline (docs/OBSERVABILITY.md)
 trace-demo:
 	python tools/trace_demo.py
+
+# record/replay demo + gate: record a seeded churn storm into a journal,
+# replay it through the real scheduler (must not diverge, twice,
+# bit-identically), then perturb (dropped node, flipped knob) — both
+# must surface as NAMED divergences (docs/OBSERVABILITY.md
+# "Record/replay journal")
+replay-demo:
+	python tools/trace_replay.py --demo
 
 # fleet-observability demo: 3 replicas x 3 shards on the fake cluster ->
 # one merged cross-replica pod journey (single corr ID, spans from >= 2

@@ -925,6 +925,46 @@ def bench_restart_replay(n_nodes: int = 128, n_pods: int = 512) -> None:
          f"ms/pod; crash-only restart downtime)")
 
 
+def bench_replay() -> dict:
+    """cfg-replay: the record/replay determinism gate (ISSUE 18) — replay
+    the committed golden churn journal through the real scheduler stack
+    (sim/replay.py) and report decision throughput plus the divergence
+    count. bench_diff hard-gates divergences at zero: any scheduler
+    change that alters decisions for recorded traffic must show up as a
+    bench failure, not a silent behavior drift."""
+    from nhd_tpu.sim.replay import replay_journal
+
+    journal = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "fixtures", "journal", "golden_churn.journal.jsonl",
+    )
+    t0 = time.perf_counter()
+    result = replay_journal([journal])
+    wall = time.perf_counter() - t0
+    placed = sum(
+        1 for d in result.replayed if d.get("outcome") == "scheduled"
+    )
+    _log(
+        f"bench[cfg-replay]: {len(result.replayed)} decisions replayed vs "
+        f"{len(result.recorded)} recorded in {wall:.2f}s, "
+        f"{len(result.divergences)} divergence(s), "
+        f"{len(result.knob_drift)} knob drift(s)"
+    )
+    return {
+        "wall": wall, "placed": placed, "speedup": 1.0, "rounds": 1,
+        "phases": {}, "p99_bind_ms": None,
+        "replay": {
+            "journal": "tests/fixtures/journal/golden_churn.journal.jsonl",
+            "recorded": len(result.recorded),
+            "replayed": len(result.replayed),
+            "divergences": len(result.divergences),
+            "knob_drift": len(result.knob_drift),
+            "decisions_per_sec": round(len(result.replayed) / wall, 1)
+            if wall > 0 else 0.0,
+        },
+    }
+
+
 def bench_bind_latency(n_pods: int = 200) -> None:
     """Event-driven single-pod path latency (p50/p99): pod create → bound,
     through the full scheduler on the fake backend — config parse, batched
@@ -978,6 +1018,15 @@ def main() -> None:
          f"({len(jax.devices())} device(s))"
          + (" [smoke]" if smoke else ""))
 
+    # NHD_JOURNAL=1 turns on record/replay capture for the whole run —
+    # the A/B the ≤2% capture-cost bound is measured against
+    # (docs/bench/BENCH_DIFF_r18.md): same legs, journal on vs off
+    from nhd_tpu.obs.journal import enable_journal_from_env
+
+    jnl = enable_journal_from_env(identity="bench")
+    if jnl is not None:
+        _log(f"bench: journal capture on -> {jnl.path}")
+
     configs = {}
     cold_dt = bench_cold_start()
     # first-bind probes run in subprocesses (fresh jit caches). In the
@@ -1029,6 +1078,10 @@ def main() -> None:
         # fleet, and the preemption micro-cell must evict — both gated
         # by tools/bench_diff.py's hetero gates on every `make check`
         configs["policy-smoke"] = bench_hetero(smoke=True)
+        # record/replay determinism gate (ISSUE 18): seconds-scale, so
+        # every `make check` proves recorded traffic still replays
+        # decision-for-decision
+        configs["cfg-replay"] = bench_replay()
 
     if not smoke:
         # cfg3: NIC-saturated contention shape (places ~4k of 10k — the
@@ -1092,6 +1145,10 @@ def main() -> None:
         # aggregate placed throughput gated by bench_diff's hetero gates
         configs["cfg8:hetero"] = bench_hetero(smoke=False)
 
+        # cfg-replay: same determinism gate as the smoke leg (same name,
+        # so bench_diff gates across smoke and full artifacts alike)
+        configs["cfg-replay"] = bench_replay()
+
     headline = {
         # the smoke leg's headline is cfg2 under its own metric name, so
         # bench_diff never compares a smoke headline against a full one
@@ -1122,7 +1179,8 @@ def main() -> None:
                     phases=r["phases"], p99_bind_ms=r["p99_bind_ms"],
                     extra={
                         k: r[k]
-                        for k in ("churn", "hetero", "spmd") if k in r
+                        for k in ("churn", "hetero", "spmd", "replay")
+                        if k in r
                     } or None,
                 )
                 for name, r in configs.items()
@@ -1145,6 +1203,11 @@ def main() -> None:
             _log(f"bench artifact -> {path}")
         except (OSError, ValueError) as exc:
             _log(f"bench artifact write failed (run unaffected): {exc}")
+
+    if jnl is not None:
+        from nhd_tpu.obs.journal import disable_journal
+
+        _log(f"bench: journal finalized -> {disable_journal()}")
 
     print(json.dumps(headline))
 

@@ -274,6 +274,17 @@ def main(argv=None) -> int:
                              "trace JSON here (dump triggers: clean exit, "
                              "and GET /trace?save=1 on the metrics port; "
                              "ring size via NHD_TRACE_CAPACITY)")
+    parser.add_argument("--journal", metavar="DIR", default=None,
+                        help="record the lossless event journal here for "
+                             "deterministic replay (also via NHD_JOURNAL=1 "
+                             "+ NHD_JOURNAL_DIR; finalized on clean exit — "
+                             "docs/OBSERVABILITY.md 'Record/replay')")
+    parser.add_argument("--replay", metavar="JOURNAL[,JOURNAL...]",
+                        default=None,
+                        help="replay recorded journal(s) against the real "
+                             "scheduling path on a sim clock, print the "
+                             "divergence diff, and exit (non-zero on "
+                             "divergence; full CLI: tools/trace_replay.py)")
     args = parser.parse_args(argv)
 
     logger = get_logger(__name__)
@@ -310,6 +321,31 @@ def main(argv=None) -> int:
 
         obs.enable(capacity=trace_capacity)
         logger.warning(f"flight recorder on; traces → {args.trace_out}")
+
+    if args.replay:
+        from nhd_tpu.sim.replay import replay_journal
+
+        paths = [p.strip() for p in args.replay.split(",") if p.strip()]
+        try:
+            result = replay_journal(paths)
+        except (OSError, ValueError) as exc:
+            print(f"replay failed: {exc}")
+            return 1
+        out_dir = args.journal or os.environ.get(
+            "NHD_JOURNAL_DIR", "artifacts/journal"
+        )
+        report = result.write_report(out_dir)
+        print(f"replayed {len(result.replayed)} decisions against "
+              f"{len(result.recorded)} recorded; "
+              f"{len(result.divergences)} divergence(s); report → {report}")
+        if result.knob_drift:
+            print(f"knob drift vs recorded genesis: "
+                  + ", ".join(sorted(result.knob_drift)))
+        first = result.first_divergence
+        if first is not None:
+            print(f"first divergence: corr={first.get('corr')} "
+                  f"pod={first['ns']}/{first['pod']} {first['kind']}")
+        return 1 if result.diverged else 0
 
     if args.explain or args.explain_pod:
         return explain_main(args)
@@ -361,6 +397,29 @@ def main(argv=None) -> int:
         )
     elif args.ha:
         logger.warning(f"HA mode: competing for the lease as {ha_identity}")
+
+    # record/replay journal (obs/journal.py): enabled by --journal or
+    # NHD_JOURNAL=1; genesis snapshots the backend's node inventory +
+    # knob registry before any thread starts, so the recording is
+    # self-contained from its first line
+    jnl = None
+    if args.journal:
+        from nhd_tpu.obs.journal import enable_journal
+
+        tag = ha_identity or str(os.getpid())
+        jnl = enable_journal(
+            os.path.join(args.journal, f"nhd-{tag}.journal.jsonl"),
+            identity=ha_identity or "",
+        )
+    else:
+        from nhd_tpu.obs.journal import enable_journal_from_env
+
+        jnl = enable_journal_from_env(identity=ha_identity or "")
+    if jnl is not None:
+        from nhd_tpu.obs.journal import genesis_nodes
+
+        jnl.genesis(genesis_nodes(backend), mode="cli", respect_busy=True)
+        logger.warning(f"journal recording → {jnl.path}")
 
     on_demote = None
     if args.trace_out and (args.ha or args.shards > 1):
@@ -423,6 +482,13 @@ def main(argv=None) -> int:
             path = obs.dump_chrome_trace(rec, args.trace_out)
             print(f"trace written to {path}")
 
+    def finalize_journal() -> None:
+        from nhd_tpu.obs.journal import disable_journal
+
+        path = disable_journal()
+        if path:
+            print(f"journal written to {path}")
+
     def release_leadership() -> None:
         """Clean exits hand the lease over NOW: without the voluntary
         release the standby waits out the full TTL (the handover bound
@@ -456,6 +522,7 @@ def main(argv=None) -> int:
                           f"bound across {snap['nodes']} nodes")
                 release_leadership()
                 dump_trace()
+                finalize_journal()
                 return 0
     except KeyboardInterrupt:
         # Ctrl-C on a run-forever daemon is the other "clean exit" the
@@ -463,6 +530,7 @@ def main(argv=None) -> int:
         logger.warning("interrupted; shutting down")
         release_leadership()
         dump_trace()
+        finalize_journal()
         return 0
 
 

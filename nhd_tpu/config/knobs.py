@@ -295,6 +295,18 @@ KNOBS: Tuple[Knob, ...] = (
          "budget the `nhd_slo_bind_burn_rate` windows burn against"),
     Knob("NHD_FLEET_DIR", "`artifacts/fleet`",
          "where ChaosSim's violation-triggered fleet artifacts land"),
+    # -- record/replay journal ---------------------------------------------
+    Knob("NHD_JOURNAL", "0",
+         "1 → record the lossless event journal (genesis, watch stream, "
+         "decisions, commits) for deterministic replay "
+         "(docs/OBSERVABILITY.md \"Record/replay\")"),
+    Knob("NHD_JOURNAL_DIR", "`artifacts/journal`",
+         "where journal files land "
+         "(`nhd-<identity or pid>.journal.jsonl`)"),
+    Knob("NHD_JOURNAL_FLUSH", "64",
+         "journal events buffered between streaming flushes to the "
+         "`.part` file (bounds capture memory; lower = smaller loss "
+         "window on crash)"),
     # -- policy engine -----------------------------------------------------
     Knob("NHD_POLICY", "0",
          "scheduling-policy engine master switch "

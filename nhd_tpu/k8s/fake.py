@@ -93,6 +93,12 @@ class FakeClusterBackend(ClusterBackend):
         self._uid = itertools.count(1)
         self.fail_bind_for: set = set()      # (ns, pod) forced bind failures
         self.bind_count = 0
+        # record/replay scenario sink (obs/journal.py): when set, every
+        # simulation-control mutation below reports (op, kwargs) so a
+        # journal can script the exact cluster timeline for replay.
+        # Deliberately NOT set on replay's own backend — re-driving a
+        # journal must not journal itself.
+        self.scenario_sink = None
         # coordination leases (leader election, k8s/lease.py). The clock
         # is injectable so chaos runs drive lease expiry deterministically
         # off the sim's step clock instead of wall time.
@@ -118,6 +124,22 @@ class FakeClusterBackend(ClusterBackend):
     # ------------------------------------------------------------------
     # simulation controls (test-facing, not part of ClusterBackend)
     # ------------------------------------------------------------------
+
+    def _scenario(self, op: str, payload: dict) -> None:
+        """Report one simulation-control mutation to the scenario sink
+        (called OUTSIDE self._lock — the sink does its own locking and
+        may do file I/O)."""
+        sink = self.scenario_sink
+        if sink is not None:
+            sink(op, payload)
+
+    def arm_bind_failure(self, ns: str, pod: str) -> None:
+        """Force the next bind attempt of (ns, pod) to fail — the
+        scenario-visible counterpart of mutating ``fail_bind_for``
+        directly, so chaos-armed bind failures land in the journal."""
+        with self._lock:
+            self.fail_bind_for.add((ns, pod))
+        self._scenario("arm_bind_failure", {"ns": ns, "pod": pod})
 
     def snapshot_stats(self) -> Dict[str, int]:
         """Consistent point-in-time counts while scheduler/controller
@@ -146,7 +168,12 @@ class FakeClusterBackend(ClusterBackend):
                     WatchEvent(kind="node_add", name=name,
                                labels=dict(node.labels))
                 )
-            return node
+        self._scenario("add_node", {
+            "name": name, "labels": dict(labels),
+            "hugepages_gb": hugepages_gb, "addr": node.addr,
+            "emit_watch": emit_watch,
+        })
+        return node
 
     def remove_node(self, name: str, *, emit_watch: bool = True) -> None:
         """Drop a node from the inventory (decommission/scale-down).
@@ -159,6 +186,10 @@ class FakeClusterBackend(ClusterBackend):
                     WatchEvent(kind="node_delete", name=name,
                                labels=dict(node.labels))
                 )
+        if node is not None:
+            self._scenario("remove_node", {
+                "name": name, "emit_watch": emit_watch,
+            })
 
     def create_pod(
         self,
@@ -196,7 +227,14 @@ class FakeClusterBackend(ClusterBackend):
                                annotations=dict(pod.annotations), uid=uid,
                                scheduler_name=pod.scheduler_name)
                 )
-            return pod
+        self._scenario("create_pod", {
+            "name": name, "ns": ns, "cfg_text": cfg_text,
+            "cfg_type": cfg_type, "groups": groups,
+            "resources": dict(resources or {}),
+            "scheduler_name": scheduler_name,
+            "emit_watch": emit_watch, "tier": tier,
+        })
+        return pod
 
     def delete_pod(self, name: str, ns: str = "default",
                    emit_watch: bool = True) -> None:
@@ -209,6 +247,10 @@ class FakeClusterBackend(ClusterBackend):
                                scheduler_name=pod.scheduler_name,
                                node=pod.node or "")
                 )
+        if pod is not None:
+            self._scenario("delete_pod", {
+                "name": name, "ns": ns, "emit_watch": emit_watch,
+            })
 
     def set_pod_phase(self, name: str, ns: str, phase: str) -> None:
         with self._lock:
@@ -225,6 +267,7 @@ class FakeClusterBackend(ClusterBackend):
                            unschedulable=cordon, was_unschedulable=was,
                            taints=list(node.taints), old_taints=list(node.taints))
             )
+        self._scenario("cordon_node", {"name": name, "cordon": cordon})
 
     def update_node_labels(self, name: str, new_labels: Dict[str, Optional[str]]) -> None:
         """Merge label changes; a value of None removes the label."""
@@ -243,6 +286,9 @@ class FakeClusterBackend(ClusterBackend):
                            was_unschedulable=node.unschedulable,
                            taints=list(node.taints), old_taints=list(node.taints))
             )
+        self._scenario("update_node_labels", {
+            "name": name, "new_labels": dict(new_labels),
+        })
 
     def add_triadset(self, name: str, ns: str, replicas: int,
                      service_name: str, cfg_text: str) -> None:

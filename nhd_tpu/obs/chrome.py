@@ -200,6 +200,40 @@ def journey_replicas(
     return seen
 
 
+def journey_view(corr: str) -> Dict[str, object]:
+    """The one-pod journey payload the HTTP ``/journey?corr=`` endpoint
+    serves (one definition, like decisions_view, so transports cannot
+    drift): the corr's spans from the live ring rendered as trace
+    events, its decision records, and — when a journal is recording —
+    the journal line seqs indexed for it, so an operator can jump from
+    a live journey straight to the replayable evidence."""
+    from nhd_tpu.obs.journal import get_journal
+    from nhd_tpu.obs.recorder import get_recorder
+
+    rec = get_recorder()
+    out: Dict[str, object] = {
+        "corr": corr,
+        "enabled": rec is not None,
+        "spans": [],
+        "decisions": [],
+        "journal": None,
+    }
+    if rec is not None:
+        out["spans"] = pod_journeys(chrome_trace(rec)).get(corr, [])
+        decisions = [
+            d for d in rec.recent_decisions(rec.decision_capacity)
+            if d.get("corr") == corr
+        ]
+        decisions.reverse()  # recent_decisions is newest-first
+        out["decisions"] = decisions
+    jnl = get_journal()
+    if jnl is not None:
+        out["journal"] = {
+            "path": jnl.path, "seqs": jnl.corr_seqs(corr),
+        }
+    return out
+
+
 def validate_chrome_trace(trace: object) -> List[str]:
     """Schema check for an exported trace; returns a list of problems
     (empty = valid). Shared by the test suite and ``make trace-demo`` so

@@ -249,7 +249,13 @@ class FlightRecorder:
             return self._dropped
 
     def clear(self) -> None:
+        global _DROPPED_BANKED
         with self._lock:
+            # the process-global ring's drop count banks into the
+            # monotonic total before it resets — dropped_total() is a
+            # Prometheus counter and must never move backwards
+            if _RECORDER is self:
+                _DROPPED_BANKED += self._dropped
             self._spans.clear()
             self._decisions.clear()
             self._dropped = 0
@@ -260,6 +266,14 @@ class FlightRecorder:
 # ---------------------------------------------------------------------------
 
 _RECORDER: Optional[FlightRecorder] = None
+
+# spans dropped by process-global rings that have since been replaced
+# (enable), torn down (disable), or cleared — the live ring's count adds
+# on top in dropped_total(). Without this bank the exported
+# nhd_trace_ring_dropped_total reset on every enable()/clear(), which a
+# Prometheus counter must never do (rate() reads a reset as a huge
+# negative spike and drops the window).
+_DROPPED_BANKED = 0
 
 
 def get_recorder() -> Optional[FlightRecorder]:
@@ -277,7 +291,9 @@ def enable(
     ``identity`` names this replica in every span it records — set it
     under HA/federation so merged cross-replica journeys attribute each
     leg (chrome.merge_chrome_traces)."""
-    global _RECORDER
+    global _RECORDER, _DROPPED_BANKED
+    if _RECORDER is not None:
+        _DROPPED_BANKED += _RECORDER.dropped()
     _RECORDER = FlightRecorder(
         capacity, decision_capacity, identity=identity
     )
@@ -285,8 +301,19 @@ def enable(
 
 
 def disable() -> None:
-    global _RECORDER
+    global _RECORDER, _DROPPED_BANKED
+    if _RECORDER is not None:
+        _DROPPED_BANKED += _RECORDER.dropped()
     _RECORDER = None
+
+
+def dropped_total() -> int:
+    """Monotonic count of spans the process-global ring has EVER
+    dropped, across enable()/disable()/clear() generations — the value
+    nhd_trace_ring_dropped_total exports (a true counter, unlike the
+    live ring's dropped() snapshot, which resets with the ring)."""
+    rec = _RECORDER
+    return _DROPPED_BANKED + (rec.dropped() if rec is not None else 0)
 
 
 def decisions_view(n: int = 50) -> Dict[str, object]:

@@ -12,6 +12,8 @@ to run last before a scrape. The same server also exposes the flight
 recorder (obs/):
 
     GET /decisions?n=50      recent per-pod decisions (JSON)
+    GET /journey?corr=ID     one pod's journey: spans + decisions +
+                             journal refs for a correlation ID (JSON)
     GET /explain?pod=ns/name unschedulability diagnosis (JSON, via the
                              scheduler thread — solver/explain.py)
     GET /trace[?save=1]      Chrome trace JSON of the span ring; save=1
@@ -192,7 +194,12 @@ def render_metrics(
 
     lines += SLO.render()
 
-    # flight-recorder ring state
+    # flight-recorder ring state. The dropped counter reads the banked
+    # total (obs/recorder.dropped_total), not the live ring's snapshot:
+    # a counter that reset on every enable()/clear() made rate() read a
+    # negative spike and drop the window.
+    from nhd_tpu.obs.recorder import dropped_total
+
     rec = get_recorder()
     for name, kind, help_text, value in (
         ("trace_enabled", "gauge", "Flight recorder active",
@@ -202,14 +209,39 @@ def render_metrics(
         ("trace_ring_capacity", "gauge", "Trace ring capacity",
          rec.capacity if rec else 0),
         ("trace_ring_dropped_total", "counter",
-         "Spans evicted from the trace ring",
-         rec.dropped() if rec else 0),
+         "Spans evicted from the trace ring (monotonic across ring "
+         "generations)",
+         dropped_total()),
     ):
         lines += [
             f"# HELP nhd_{name} {help_text}",
             f"# TYPE nhd_{name} {kind}",
             f"nhd_{name} {value}",
         ]
+
+    # record/replay journal state (obs/journal.py)
+    from nhd_tpu.obs.journal import journal_view
+
+    jv = journal_view()
+    lines += [
+        "# HELP nhd_journal_enabled Record/replay journal active",
+        "# TYPE nhd_journal_enabled gauge",
+        f"nhd_journal_enabled {int(bool(jv.get('enabled')))}",
+    ]
+    if jv.get("enabled"):
+        lines += [
+            "# HELP nhd_journal_bytes_total Bytes written to the journal "
+            "(header + flushed events)",
+            "# TYPE nhd_journal_bytes_total counter",
+            f"nhd_journal_bytes_total {jv.get('bytes', 0)}",
+            "# HELP nhd_journal_events_total Journal events captured, "
+            "by event kind",
+            "# TYPE nhd_journal_events_total counter",
+        ]
+        for ev_kind, count in sorted((jv.get("counts") or {}).items()):
+            lines.append(
+                f'nhd_journal_events_total{{ev="{ev_kind}"}} {count}'
+            )
 
     lines += [
         "# HELP nhd_node_free_cpus Free logical CPU cores per node",
@@ -276,6 +308,9 @@ class MetricsServer(threading.Thread):
                         )
                     elif path == "/decisions":
                         self._reply_json(200, outer._decisions(q))
+                    elif path == "/journey":
+                        status, body = outer._journey(q)
+                        self._reply_json(status, body)
                     elif path == "/explain":
                         status, body = outer._explain(q)
                         self._reply_json(status, body)
@@ -323,6 +358,20 @@ class MetricsServer(threading.Thread):
         except ValueError:
             n = 50
         return decisions_view(n)
+
+    def _journey(self, q: dict) -> tuple:
+        corr = q.get("corr", [""])[0]
+        if not corr:
+            return 400, {"error": "missing ?corr=<correlation id>"}
+        from nhd_tpu.obs.chrome import journey_view
+
+        body = journey_view(corr)
+        if not body["enabled"] and body["journal"] is None:
+            return 404, {
+                "error": "flight recorder and journal both disabled "
+                "(start with --trace-out or NHD_JOURNAL=1)"
+            }
+        return 200, body
 
     def _explain(self, q: dict) -> tuple:
         raw = q.get("pod", [""])[0]

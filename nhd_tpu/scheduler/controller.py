@@ -27,6 +27,7 @@ from nhd_tpu.k8s.interface import (
 )
 from nhd_tpu.core.node import HostNode
 from nhd_tpu.k8s.retry import API_COUNTERS
+from nhd_tpu.obs.journal import get_journal
 from nhd_tpu.obs.recorder import get_recorder, new_corr_id
 from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
 from nhd_tpu.utils import get_logger
@@ -140,6 +141,11 @@ class Controller(threading.Thread):
         # scoped by replica identity so N processes' dumps merge cleanly
         rec = self._recorder if self._recorder is not None else get_recorder()
         corr = new_corr_id(rec.identity if rec is not None else "")
+        jnl = get_journal()
+        if jnl is not None:
+            # the journal recorded this event at _dispatch entry; attach
+            # the corr minted for it (best-effort back-annotation)
+            jnl.note_corr(corr)
         t_recv = time.monotonic()
         if rec is not None:
             rec.record(
@@ -216,6 +222,14 @@ class Controller(threading.Thread):
     # ------------------------------------------------------------------
 
     def _dispatch(self, ev: WatchEvent) -> None:
+        # journal capture at receipt (obs/journal.py), BEFORE translation:
+        # a poisoned event that crashes a translator below is still
+        # recorded, so replay reproduces the crash-and-isolate behavior;
+        # fault-dropped events never reach here, so replay re-drives the
+        # post-drop stream exactly. One module-global read when off.
+        jnl = get_journal()
+        if jnl is not None:
+            jnl.watch_event(ev)
         if ev.kind == "node_update":
             self.handle_node_update(ev)
         elif ev.kind in ("pod_create", "pod_delete"):

@@ -38,6 +38,7 @@ from nhd_tpu.k8s.lease import LeaderElector, ShardedElector, shard_for_groups
 from nhd_tpu.k8s.retry import API_COUNTERS
 from nhd_tpu.obs import histo as obs_histo
 from nhd_tpu.obs import slo as obs_slo
+from nhd_tpu.obs.journal import get_journal
 from nhd_tpu.obs.recorder import (
     FlightRecorder,
     correlate,
@@ -657,6 +658,12 @@ class Scheduler(threading.Thread):
         # tier read gated on the policy switch: with it off the request
         # is built exactly as before (no extra annotation read per pod)
         tier = self.backend.get_pod_tier(pod, ns) if _policy.enabled() else 0
+        jnl = get_journal()
+        if jnl is not None:
+            # the one point where the pod's config text is in hand: a
+            # journal recorded from a live cluster stays self-contained
+            # (replay reconstructs the configmap from this event)
+            jnl.pod_spec(ns, pod, cfg_text, groups=groups, tier=tier)
         req = PodRequest.from_topology(top, node_groups=groups, tier=tier)
         return parser, BatchItem((ns, pod), req, top)
 
@@ -808,8 +815,8 @@ class Scheduler(threading.Thread):
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
                 }
                 self.failed_schedule_count += 1
-                if rec is not None:
-                    rec.record_decision(self._decision(
+                if rec is not None or get_journal() is not None:
+                    self._publish_decision(rec, self._decision(
                         pod, ns, corrs[(ns, pod)], "config-parse-failed",
                     ))
                 continue
@@ -943,7 +950,7 @@ class Scheduler(threading.Thread):
                 self.pod_state[(ns, pod)] = {
                     "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
                 }
-                if rec is not None:
+                if rec is not None or get_journal() is not None:
                     d = self._decision(
                         pod, ns, corrs.get(item.key), "unschedulable",
                         queue_wait=waits.get(item.key), stats=bstats,
@@ -956,7 +963,7 @@ class Scheduler(threading.Thread):
                         # rejection reason from the explainer (per-node
                         # first failing predicate)
                         d["reasons"] = self._explain_summary(item, nodes_view)
-                    rec.record_decision(d)
+                    self._publish_decision(rec, d)
             else:
                 winners.append((parser, item, result))
 
@@ -1030,6 +1037,12 @@ class Scheduler(threading.Thread):
         Returns True when the pod ended up bound."""
         ns, pod = item.key
         rec = self._rec()
+        jnl = get_journal()
+        if jnl is not None:
+            # every commit outcome — OK, RETRY (incl. fenced rejections,
+            # StaleLeaseError classifies transient) and terminal FAILED —
+            # lands in the journal at the drain point
+            jnl.commit(pod, ns, corr, outcome.name, node=result.node)
         # the commit may have drained after the node left the mirror
         # (async pipeline + NODE_REMOVE): its claims died with the node,
         # so unwind becomes a no-op but the state machine still runs
@@ -1059,8 +1072,8 @@ class Scheduler(threading.Thread):
                 "uid": uid, "tier": item.request.tier, "corr": corr,
                 "node": result.node, "bound_at": time.monotonic(),
             }
-            if rec is not None:
-                rec.record_decision(self._decision(
+            if rec is not None or jnl is not None:
+                self._publish_decision(rec, self._decision(
                     pod, ns, corr, "scheduled", node=result.node,
                     queue_wait=wait, stats=bstats,
                     bind=max(t_done - t_adm, 0.0),
@@ -1070,8 +1083,8 @@ class Scheduler(threading.Thread):
             pod, ns, uid, node, item, corr=corr,
         ):
             # claim unwound, pod back on the queue
-            if rec is not None:
-                rec.record_decision(self._decision(
+            if rec is not None or jnl is not None:
+                self._publish_decision(rec, self._decision(
                     pod, ns, corr, "requeued", node=result.node,
                     queue_wait=wait, stats=bstats,
                 ))
@@ -1082,8 +1095,8 @@ class Scheduler(threading.Thread):
         self.pod_state[(ns, pod)] = {
             "state": PodStatus.FAILED, "time": time.time(), "uid": "0"
         }
-        if rec is not None:
-            rec.record_decision(self._decision(
+        if rec is not None or jnl is not None:
+            self._publish_decision(rec, self._decision(
                 pod, ns, corr, "commit-failed", node=result.node,
                 queue_wait=wait, stats=bstats,
             ))
@@ -1171,6 +1184,21 @@ class Scheduler(threading.Thread):
             "pod": pod, "ns": ns, "corr": corr, "outcome": outcome,
             "node": node, "phases": phases, "time": time.time(),
         }
+
+    def _publish_decision(
+        self, rec: Optional[FlightRecorder], decision: dict
+    ) -> None:
+        """Fan one decision record out to both consumers: the flight
+        recorder's bounded ring (when tracing is on) and the lossless
+        journal (when recording is on, obs/journal.py — the divergence
+        diff's ground truth). Callers guard on
+        ``rec is not None or get_journal() is not None`` so the
+        everything-off hot path still costs one module-global read."""
+        if rec is not None:
+            rec.record_decision(decision)
+        jnl = get_journal()
+        if jnl is not None:
+            jnl.decision(decision)
 
     def _explain_summary(
         self, item: BatchItem, nodes: Optional[Dict[str, HostNode]] = None
@@ -1530,7 +1558,10 @@ class Scheduler(threading.Thread):
                 shard=fence_shard,
                 epoch=self.sharded.fencing_epoch_for(fence_shard),
             )
-            rec_sink.record_decision(self._decision(pod, ns, corr, outcome))
+        if rec_sink is not None or get_journal() is not None:
+            self._publish_decision(
+                rec_sink, self._decision(pod, ns, corr, outcome)
+            )
 
     def _declare_shards_exhausted(
         self, pod: str, ns: str, fence_shard: int, *, aged_out: bool
@@ -1700,12 +1731,12 @@ class Scheduler(threading.Thread):
         if plan is None:
             if why == "budget-exhausted":
                 API_COUNTERS.inc("policy_preempt_budget_exhausted_total")
-                if rec is not None:
+                if rec is not None or get_journal() is not None:
                     d = self._decision(
                         pod, ns, corr, "preempt-budget-exhausted",
                     )
                     d["budget"] = budget.state()
-                    rec.record_decision(d)
+                    self._publish_decision(rec, d)
             return False
 
         # execute: fenced evictions first (cluster truth moves before
@@ -1776,12 +1807,12 @@ class Scheduler(threading.Thread):
                 f"Preempted from {plan.node} by higher-tier pod "
                 f"{ns}/{pod} (tier {tier} > {vtier})",
             )
-            if rec is not None:
+            if rec is not None or get_journal() is not None:
                 d = self._decision(
                     vpod, vns, vcorr, "preempted", node=plan.node,
                 )
                 d["preemptor"] = f"{ns}/{pod}"
-                rec.record_decision(d)
+                self._publish_decision(rec, d)
             # requeue the victim under its ORIGINAL corr ID: the flight
             # recorder's journey view shows preempt→rebind as one trace
             self.nqueue.put(WatchItem(
@@ -1807,6 +1838,7 @@ class Scheduler(threading.Thread):
                     "budget": budget.state(),
                 },
             )
+        if rec is not None or get_journal() is not None:
             d = self._decision(
                 pod, ns, corr, "preempt-requeued", node=plan.node,
             )
@@ -1814,7 +1846,7 @@ class Scheduler(threading.Thread):
                 {"pod": f"{v[0]}/{v[1]}", "tier": v[2]} for v in evicted
             ]
             d["budget"] = budget.state()
-            rec.record_decision(d)
+            self._publish_decision(rec, d)
         return True
 
     # ------------------------------------------------------------------

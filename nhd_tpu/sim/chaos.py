@@ -47,6 +47,7 @@ placed or explicitly declared unschedulable within a bounded age).
 from __future__ import annotations
 
 import json
+import math
 import os
 import queue
 import random
@@ -347,6 +348,7 @@ class ChaosSim:
         tracing: Optional[bool] = None,
         policy: Optional[str] = None,
         policy_off: bool = False,
+        journey: Optional[str] = None,
     ):
         if ha and federation:
             raise ValueError("ha=True and federation=S are exclusive modes")
@@ -464,24 +466,60 @@ class ChaosSim:
             self.backend = base
         if self.federation:
             self.group_pool = _fed_group_pool(self.federation)
-        for i in range(n_nodes):
-            spec = SynthNodeSpec(name=f"node{i}")
-            if self.federation:
-                # spread node groups so every shard lease fronts nodes
-                spec.groups = self.group_pool[i % len(self.group_pool)]
-            if self.policy:
-                # mixed-generation fleet: classes cycle so every storm
-                # exercises scoring across generations — and nodes are
-                # SMALL (a couple of pods each), so the storm actually
-                # saturates and preemption pressure is real, not
-                # vacuous (a fleet that never fills never preempts)
-                spec.node_class = POLICY_CLASSES[i % len(POLICY_CLASSES)]
-                spec.phys_cores = 8
-                spec.gpus_per_numa = 1
-                spec.hugepages_gb = 8
-            self.backend.add_node(
-                spec.name, make_node_labels(spec), hugepages_gb=spec.hugepages_gb
+        # journey input mode (record/replay, obs/journal.py): a recorded
+        # journal replaces the synthetic genesis AND the rng action draw
+        # — recorded traffic shapes run under this cell's fault profile
+        # with every existing invariant. Solo mode only (journals are
+        # per-process, like the recorder).
+        self.journey = journey
+        self._journey_steps: Dict[int, List[dict]] = {}
+        journey_genesis: Optional[dict] = None
+        if journey is not None:
+            if ha or federation:
+                raise ValueError("journey input mode runs solo mode only")
+            from nhd_tpu.obs.journal import load_journal
+
+            _header, j_events = load_journal(journey)
+            journey_genesis = next(
+                (e for e in j_events if e["ev"] == "genesis"), None
             )
+            if journey_genesis is None:
+                raise ValueError(f"{journey}: journal has no genesis event")
+            t0 = j_events[0]["t"]
+            for e in j_events:
+                if e["ev"] != "cluster":
+                    continue
+                # events landing in ((k-1)·STEP, k·STEP] apply at step k
+                rel = max(e["t"] - t0, 0.0)
+                step_bin = max(int(math.ceil(rel / STEP_SEC - 1e-9)), 1)
+                self._journey_steps.setdefault(step_bin, []).append(e)
+        if journey_genesis is not None:
+            for nd in journey_genesis["nodes"]:
+                self.backend.add_node(
+                    nd["name"], dict(nd["labels"]),
+                    hugepages_gb=int(nd.get("hugepages_gb") or 64),
+                    addr=nd.get("addr", ""),
+                )
+        else:
+            for i in range(n_nodes):
+                spec = SynthNodeSpec(name=f"node{i}")
+                if self.federation:
+                    # spread node groups so every shard lease fronts nodes
+                    spec.groups = self.group_pool[i % len(self.group_pool)]
+                if self.policy:
+                    # mixed-generation fleet: classes cycle so every storm
+                    # exercises scoring across generations — and nodes are
+                    # SMALL (a couple of pods each), so the storm actually
+                    # saturates and preemption pressure is real, not
+                    # vacuous (a fleet that never fills never preempts)
+                    spec.node_class = POLICY_CLASSES[i % len(POLICY_CLASSES)]
+                    spec.phys_cores = 8
+                    spec.gpus_per_numa = 1
+                    spec.hugepages_gb = 8
+                self.backend.add_node(
+                    spec.name, make_node_labels(spec),
+                    hugepages_gb=spec.hugepages_gb,
+                )
         self.stats = ChaosStats()
         self._pod_seq = 0
         self._node_seq = 0
@@ -505,6 +543,25 @@ class ChaosSim:
             ]
         else:
             self._fresh_scheduler()
+        # record/replay capture (obs/journal.py): when a process-global
+        # journal is active, solo storms record into it — the sim clock
+        # stamps events, genesis snapshots the post-setup inventory, and
+        # the scenario/fault sinks script every later cluster mutation.
+        # Wired AFTER the initial add_node loop so the genesis inventory
+        # is not double-recorded as cluster events.
+        if not self.ha and not self.federation:
+            from nhd_tpu.obs.journal import genesis_nodes, get_journal
+
+            jnl = get_journal()
+            if jnl is not None:
+                jnl.clock = self.sim_clock
+                jnl.genesis(
+                    genesis_nodes(self.base), seed=seed, mode="chaos",
+                    respect_busy=False,
+                )
+                self.base.scenario_sink = jnl.cluster_event
+                if isinstance(self.backend, FaultyBackend):
+                    self.backend.fault_sink = jnl.fault_event
 
     def sim_clock(self) -> float:
         return self._now
@@ -796,7 +853,9 @@ class ChaosSim:
         pending = [p for p in self.backend.pods.values() if p.node is None]
         if pending:
             victim = self.rng.choice(pending)
-            self.backend.fail_bind_for.add((victim.namespace, victim.name))
+            # route through the backend method (not the raw set) so the
+            # journal's scenario sink scripts the armed failure for replay
+            self.backend.arm_bind_failure(victim.namespace, victim.name)
             self.stats.bind_failures += 1
 
     # -- restart + state-equivalence ------------------------------------
@@ -897,6 +956,11 @@ class ChaosSim:
         else:
             pre_claims = self._claims_map(self.sched)
             pre_snap = self._mirror_snapshot(self.sched)
+            if self.base.scenario_sink is not None:
+                # the restart is a scenario input (not derivable from any
+                # watch event) — script it so replay rebuilds its stack
+                # at the same point in the storm
+                self.base.scenario_sink("sched_restart", {})
             self._fresh_scheduler()
             self._check_restart_equivalence(pre_claims, pre_snap, self.sched)
         self.stats.restarts += 1
@@ -1126,6 +1190,90 @@ class ChaosSim:
             if r.dead_for == 0:
                 r.elector.tick()
 
+    def _apply_journey_op(self, event: dict) -> None:
+        """Re-apply one recorded cluster mutation (journey input mode).
+
+        Ops mirror the scenario-sink chokepoints in FakeClusterBackend
+        plus the storm-level ``sched_restart`` marker. A malformed event
+        (missing field, unknown node) becomes a recorded violation, not
+        a crash — journey journals are user-supplied input."""
+        op = event.get("op", "")
+        p = event.get("args") or {}
+        try:
+            if op == "create_pod":
+                self.backend.create_pod(
+                    p["name"], p.get("ns", "default"),
+                    cfg_text=p.get("cfg_text"),
+                    cfg_type=p.get("cfg_type", "triad"),
+                    groups=p.get("groups"),
+                    resources=p.get("resources") or None,
+                    scheduler_name=p.get(
+                        "scheduler_name", "nhd-scheduler"
+                    ),
+                    emit_watch=bool(p.get("emit_watch", True)),
+                    tier=int(p.get("tier", 0)),
+                )
+                self.stats.created += 1
+            elif op == "delete_pod":
+                silent = not p.get("emit_watch", True)
+                self.backend.delete_pod(
+                    p["name"], p.get("ns", "default"),
+                    emit_watch=not silent,
+                )
+                if silent:
+                    self.stats.silent_deletes += 1
+                else:
+                    self.stats.deleted += 1
+            elif op == "add_node":
+                self.backend.add_node(
+                    p["name"], dict(p.get("labels") or {}),
+                    hugepages_gb=int(p.get("hugepages_gb") or 64),
+                    addr=p.get("addr", ""),
+                    emit_watch=bool(p.get("emit_watch", False)),
+                )
+                self.stats.node_flaps += 1
+            elif op == "remove_node":
+                bound_nodes = {
+                    pd.node for pd in self.backend.pods.values() if pd.node
+                }
+                if p["name"] in bound_nodes:
+                    # the recorded removal targeted an empty node; if the
+                    # replayed schedule placed pods there, skip rather
+                    # than orphan them (divergence shows up in the diff)
+                    return
+                self.backend.remove_node(
+                    p["name"],
+                    emit_watch=bool(p.get("emit_watch", True)),
+                )
+                self.stats.node_flaps += 1
+            elif op == "cordon_node":
+                self.backend.cordon_node(
+                    p["name"], bool(p.get("cordon", True))
+                )
+                self.stats.cordons += 1
+            elif op == "update_node_labels":
+                labels = dict(p.get("new_labels") or {})
+                self.backend.update_node_labels(p["name"], labels)
+                if "sigproc.viasat.io/maintenance" in labels:
+                    self.stats.maint_flips += 1
+                else:
+                    self.stats.group_moves += 1
+            elif op == "arm_bind_failure":
+                self.backend.arm_bind_failure(p["ns"], p["pod"])
+                self.stats.bind_failures += 1
+            elif op == "sched_restart":
+                pre_claims = self._claims_map(self.sched)
+                pre_snap = self._mirror_snapshot(self.sched)
+                self._fresh_scheduler()
+                self._check_restart_equivalence(
+                    pre_claims, pre_snap, self.sched
+                )
+                self.stats.restarts += 1
+        except KeyError as exc:
+            self.stats.violations.append(
+                f"step {self.stats.steps}: journey op {op!r} missing {exc}"
+            )
+
     def step(self) -> None:
         self.stats.steps += 1
         self._now += STEP_SEC
@@ -1153,11 +1301,18 @@ class ChaosSim:
         if self.federation:
             actions.append(self._act_kill_wave)
             weights.append(4)
-        action = self.rng.choices(actions, weights=weights)[0]
-        action()
+        if self.journey is not None:
+            # journey replay: the recorded scenario script IS the action
+            # source — no rng draws, no flap roll; every cluster mutation
+            # the recorded storm made at this step is re-applied verbatim
+            for e in self._journey_steps.get(self.stats.steps, []):
+                self._apply_journey_op(e)
+        else:
+            action = self.rng.choices(actions, weights=weights)[0]
+            action()
         if self.policy == "maint-wave":
             self._policy_wave_step()
-        if not self.federation and not self.ha and (
+        if self.journey is None and not self.federation and not self.ha and (
             self._flap_rng.random() < 0.08
         ):
             # solo mode drives the incremental-state path: structural

@@ -378,9 +378,20 @@ class FaultyBackend(ClusterBackend):
         }
         self._bind_faulted: set = set()
         self._annotate_faulted: set = set()
+        # record/replay fault sink (obs/journal.py): when set, every
+        # injected transient write fault reports (op, ns, pod) so replay
+        # can re-inject it at the same call site (sim/replay.py). Watch
+        # drops/poisons need no sink — the journal captures watch events
+        # at controller receipt, i.e. post-filter, so they replay free.
+        self.fault_sink = None
 
     def _roll(self, p: float) -> bool:
         return self.enabled and p > 0 and self.rng.random() < p
+
+    def _fault(self, op: str, ns: str, pod: str) -> None:
+        sink = self.fault_sink
+        if sink is not None:
+            sink(op, ns, pod)
 
     def __getattr__(self, name: str):
         return getattr(self.inner, name)
@@ -461,6 +472,7 @@ class FaultyBackend(ClusterBackend):
         ):
             self._annotate_faulted.add(key)
             self.fault_stats["transient_annotates"] += 1
+            self._fault("annotate", ns, pod)
             raise TransientBackendError(
                 f"injected transient annotate failure for {ns}/{pod}"
             )
@@ -486,6 +498,7 @@ class FaultyBackend(ClusterBackend):
         ):
             self._annotate_faulted.add(fk)
             self.fault_stats["transient_annotates"] += 1
+            self._fault("meta", ns, pod)
             raise TransientBackendError(
                 f"injected transient meta-annotate failure for {ns}/{pod}"
             )
@@ -503,6 +516,7 @@ class FaultyBackend(ClusterBackend):
         ):
             self._annotate_faulted.add(fk)
             self.fault_stats["transient_annotates"] += 1
+            self._fault("claim", ns, pod)
             raise TransientBackendError(
                 f"injected transient spillover-claim failure for {ns}/{pod}"
             )
@@ -520,6 +534,7 @@ class FaultyBackend(ClusterBackend):
         ):
             self._bind_faulted.add(key)
             self.fault_stats["transient_binds"] += 1
+            self._fault("bind", ns, pod)
             raise TransientBackendError(
                 f"injected transient bind failure for {ns}/{pod}"
             )

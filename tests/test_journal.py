@@ -1,0 +1,433 @@
+"""Record/replay journal tests: writer semantics + atomic finalize,
+schema validation (including defect detection), the golden-journal
+deterministic replay pin, divergence negative controls (dropped node,
+flipped knob), capture-under-chaos round trip, journey input mode, and
+the /journey + journal metrics surfaces."""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from nhd_tpu.obs import journal as journal_mod
+from nhd_tpu.obs.journal import (
+    JournalWriter,
+    disable_journal,
+    enable_journal,
+    enable_journal_from_env,
+    genesis_nodes,
+    get_journal,
+    journal_view,
+    load_journal,
+    merge_journals,
+    read_journal,
+    validate_journal,
+)
+from nhd_tpu.k8s.interface import WatchEvent
+
+GOLDEN = (
+    Path(__file__).resolve().parent
+    / "fixtures" / "journal" / "golden_churn.journal.jsonl"
+)
+
+
+@pytest.fixture(autouse=True)
+def _journal_off():
+    """Every test starts and ends with the process-global journal off."""
+    disable_journal(finalize=False)
+    yield
+    disable_journal(finalize=False)
+
+
+def _fill(jnl: JournalWriter) -> None:
+    jnl.genesis(
+        [{"name": "n0", "labels": {"a": "1"}, "hugepages_gb": 64,
+          "addr": "10.0.0.1"}],
+        seed=7, mode="test", respect_busy=False,
+    )
+    jnl.watch_event(
+        WatchEvent(kind="pod_create", name="p0", namespace="default"),
+    )
+    jnl.note_corr("c42")
+    jnl.pod_spec("default", "p0", "cfg-text", groups=("g1",), tier=1)
+    jnl.cluster_event("cordon_node", {"name": "n0", "cordon": True})
+    jnl.fault_event("bind", "default", "p0")
+    jnl.decision({
+        "pod": "p0", "ns": "default", "corr": "c42",
+        "outcome": "scheduled", "node": "n0", "phases": {}, "time": 1.0,
+    })
+    jnl.commit("p0", "default", "c42", "bound", node="n0")
+
+
+# ---------------------------------------------------------------------------
+# writer semantics
+# ---------------------------------------------------------------------------
+
+def test_writer_roundtrip_validates(tmp_path):
+    path = str(tmp_path / "t.journal.jsonl")
+    jnl = JournalWriter(path, identity="t", seed=7)
+    _fill(jnl)
+    assert jnl.finalize() == path
+    header, events = load_journal(path)
+    assert validate_journal(header, events) == []
+    kinds = [e["ev"] for e in events]
+    assert kinds == [
+        "genesis", "watch", "pod_spec", "cluster", "fault", "decision",
+        "commit",
+    ]
+    assert [e["seq"] for e in events] == list(range(1, 8))
+    g = events[0]
+    assert g["nodes"][0]["name"] == "n0"
+    assert g["respect_busy"] is False
+    assert "NHD_JOURNAL" in g["knobs"]
+    # cluster op kwargs land under "args" (replay + journey read them)
+    assert events[3]["op"] == "cordon_node"
+    assert events[3]["args"] == {"name": "n0", "cordon": True}
+    # note_corr back-annotated the buffered watch event
+    assert events[1]["corr"] == "c42"
+
+
+def test_finalize_is_atomic(tmp_path):
+    path = str(tmp_path / "t.journal.jsonl")
+    jnl = JournalWriter(path, identity="t")
+    _fill(jnl)
+    # until finalize, only the .part file exists
+    assert not os.path.exists(path) and os.path.exists(path + ".part")
+    jnl.finalize()
+    assert os.path.exists(path) and not os.path.exists(path + ".part")
+    n_events = len(read_journal(path)[1])
+    # post-finalize captures are silent no-ops, not corruption
+    jnl.decision({"pod": "late", "ns": "d", "outcome": "scheduled"})
+    jnl.flush()
+    assert len(read_journal(path)[1]) == n_events
+
+
+def test_streaming_flush_bounds_memory(tmp_path):
+    path = str(tmp_path / "t.journal.jsonl")
+    jnl = JournalWriter(path, flush_every=4)
+    for i in range(10):
+        jnl.cluster_event("create_pod", {"name": f"p{i}"})
+    # 8 of 10 events flushed to disk before finalize, buffer ≤ 4
+    assert len(read_journal(path + ".part")[1]) == 8
+    jnl.finalize()
+    assert len(read_journal(path)[1]) == 10
+
+
+def test_pod_spec_dedup_and_corr_index(tmp_path):
+    path = str(tmp_path / "t.journal.jsonl")
+    jnl = JournalWriter(path)
+    jnl.pod_spec("d", "p", "cfg-a")
+    jnl.pod_spec("d", "p", "cfg-a")   # same digest: deduped
+    jnl.pod_spec("d", "p", "cfg-b")   # changed spec: recorded again
+    jnl.watch_event(
+        WatchEvent(kind="pod_create", name="p", namespace="d"), corr="c1",
+    )
+    assert jnl.corr_seqs("c1") == [3]  # deduped spec consumed no seq
+    jnl.finalize()
+    _, events = load_journal(path)
+    assert [e["ev"] for e in events] == ["pod_spec", "pod_spec", "watch"]
+
+
+# ---------------------------------------------------------------------------
+# validator defects
+# ---------------------------------------------------------------------------
+
+def _valid_journal(tmp_path):
+    path = str(tmp_path / "v.journal.jsonl")
+    jnl = JournalWriter(path, identity="v", seed=1)
+    _fill(jnl)
+    jnl.finalize()
+    return read_journal(path)
+
+
+def test_validator_rejects_seq_regression(tmp_path):
+    header, events = _valid_journal(tmp_path)
+    events[3]["seq"] = 1
+    assert any("seq" in e for e in validate_journal(header, events))
+
+
+def test_validator_rejects_unknown_kind(tmp_path):
+    header, events = _valid_journal(tmp_path)
+    events[2]["ev"] = "telepathy"
+    assert any("telepathy" in e for e in validate_journal(header, events))
+
+
+def test_validator_rejects_double_genesis(tmp_path):
+    header, events = _valid_journal(tmp_path)
+    events.append(dict(events[0], seq=events[-1]["seq"] + 1))
+    assert any("genesis" in e for e in validate_journal(header, events))
+
+
+def test_validator_rejects_foreign_envelope(tmp_path):
+    header, events = _valid_journal(tmp_path)
+    bad = dict(header, kind="chrome-trace")
+    assert validate_journal(bad, events)
+    bad = dict(header)
+    bad["payload"] = dict(header["payload"], body="csv")
+    assert any("body" in e for e in validate_journal(bad, events))
+
+
+def test_load_journal_fails_loud_on_defect(tmp_path):
+    path = str(tmp_path / "v.journal.jsonl")
+    jnl = JournalWriter(path)
+    _fill(jnl)
+    jnl.finalize()
+    lines = Path(path).read_text().splitlines()
+    lines.append(json.dumps({"seq": 1, "t": 0.0, "ev": "watch"}))
+    Path(path).write_text("\n".join(lines) + "\n")
+    with pytest.raises(ValueError):
+        load_journal(path)
+
+
+# ---------------------------------------------------------------------------
+# process-global lifecycle + env gate
+# ---------------------------------------------------------------------------
+
+def test_enable_disable_and_view(tmp_path):
+    path = str(tmp_path / "g.journal.jsonl")
+    assert get_journal() is None
+    assert journal_view() == {"enabled": False}
+    jnl = enable_journal(path, identity="g")
+    assert get_journal() is jnl
+    jnl.cluster_event("create_pod", {"name": "p"})
+    view = journal_view()
+    assert view["enabled"] is True and view["path"] == path
+    assert view["counts"]["cluster"] == 1
+    assert disable_journal() == path
+    assert get_journal() is None
+
+
+def test_enable_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("NHD_JOURNAL", raising=False)
+    assert enable_journal_from_env() is None
+    monkeypatch.setenv("NHD_JOURNAL", "1")
+    monkeypatch.setenv("NHD_JOURNAL_DIR", str(tmp_path))
+    jnl = enable_journal_from_env(identity="envtest")
+    assert jnl is not None
+    assert jnl.path == str(tmp_path / "nhd-envtest.journal.jsonl")
+    disable_journal()
+
+
+def test_genesis_nodes_duck_typed():
+    from tests.test_scheduler import make_backend
+
+    backend = make_backend(n_nodes=2)
+    nodes = genesis_nodes(backend)
+    assert [n["name"] for n in nodes] == sorted(backend.get_nodes())
+    assert all(
+        isinstance(n["hugepages_gb"], int) and n["labels"] for n in nodes
+    )
+
+
+def test_merge_journals_interleaves(tmp_path):
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    ja = JournalWriter(pa, identity="a", created=100.0, clock=lambda: 0.0)
+    ja.cluster_event("create_pod", {"name": "pa"})
+    ja.finalize()
+    jb = JournalWriter(pb, identity="b", created=100.5, clock=lambda: 0.0)
+    jb.cluster_event("create_pod", {"name": "pb"})
+    jb.finalize()
+    headers, merged = merge_journals([pa, pb])
+    assert [h["payload"]["identity"] for h in headers] == ["a", "b"]
+    assert [e["args"]["name"] for e in merged] == ["pa", "pb"]
+    assert [e["origin"] for e in merged] == [0, 1]
+    assert merged[0]["t"] < merged[1]["t"]
+
+
+# ---------------------------------------------------------------------------
+# golden journal: deterministic replay pin + divergence controls
+# ---------------------------------------------------------------------------
+
+def test_golden_journal_is_valid():
+    header, events = load_journal(str(GOLDEN))
+    assert validate_journal(header, events) == []
+    assert header["git_rev"] == "golden"
+    g = next(e for e in events if e["ev"] == "genesis")
+    assert g["mode"] == "chaos" and len(g["nodes"]) == 6
+    kinds = {e["ev"] for e in events}
+    assert {"genesis", "cluster", "watch", "decision", "commit"} <= kinds
+
+
+def test_golden_replay_pin_deterministic():
+    """THE replay pin: the committed churn journal re-drives the real
+    scheduler with zero divergence, twice, bit-identically."""
+    from nhd_tpu.sim.replay import _decision_sig, replay_journal
+
+    r1 = replay_journal([str(GOLDEN)])
+    assert r1.recorded, "golden journal recorded no decisions"
+    assert not r1.diverged, r1.first_divergence
+    assert r1.knob_drift == {}, r1.knob_drift
+    r2 = replay_journal([str(GOLDEN)])
+    sig = lambda r: [  # noqa: E731
+        (d.get("ns"), d.get("pod"), _decision_sig(d)) for d in r.replayed
+    ]
+    assert sig(r1) == sig(r2)
+
+
+def test_golden_replay_drop_node_diverges(tmp_path):
+    """Negative control: perturbing genesis (node0 gone) must produce a
+    divergence report naming the first divergent corr and the delta."""
+    from nhd_tpu.sim.replay import replay_journal
+
+    r = replay_journal([str(GOLDEN)], drop_nodes=["node0"])
+    assert r.diverged
+    assert r.dropped_nodes == ["node0"]
+    fd = r.first_divergence
+    assert fd["corr"] and fd["kind"] in (
+        "decision-mismatch", "missing-decision", "extra-decision",
+    )
+    if fd["kind"] == "decision-mismatch":
+        assert fd["recorded"] != fd["replayed"]
+    out = r.write_report(str(tmp_path))
+    report = json.loads(Path(out).read_text())
+    assert report["kind"] == "replay-divergence"
+    assert report["payload"]["divergences"][0]["corr"] == fd["corr"]
+
+
+def test_golden_replay_knob_drift_named(monkeypatch):
+    """Negative control: a flipped knob must be reported by name even
+    before anyone inspects decisions."""
+    from nhd_tpu.sim.replay import knob_drift
+
+    genesis = next(
+        e for e in load_journal(str(GOLDEN))[1] if e["ev"] == "genesis"
+    )
+    monkeypatch.setenv("NHD_POLICY", "flipped")
+    drift = knob_drift(genesis["knobs"])
+    assert drift["NHD_POLICY"] == {"recorded": None, "current": "flipped"}
+    # the journal apparatus itself is exempt (it always differs)
+    monkeypatch.setenv("NHD_JOURNAL", "1")
+    assert "NHD_JOURNAL" not in knob_drift(genesis["knobs"])
+
+
+# ---------------------------------------------------------------------------
+# capture under chaos + journey input mode
+# ---------------------------------------------------------------------------
+
+def _run_churn(path, seed=99, steps=12, n_nodes=4, faults=False):
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import PROFILES
+
+    enable_journal(path, identity="t", seed=seed)
+    try:
+        sim = ChaosSim(
+            seed=seed, n_nodes=n_nodes,
+            api_faults=PROFILES["churn"] if faults else None,
+        )
+        for _ in range(steps):
+            sim.step()
+        assert sim.stats.violations == []
+        return sim
+    finally:
+        disable_journal()
+
+
+def test_capture_under_chaos_replays_clean(tmp_path):
+    """A journal captured under an API-fault storm replays with zero
+    divergence — injected faults are scripted back at the same recorded
+    instants."""
+    from nhd_tpu.sim.replay import replay_journal
+
+    path = str(tmp_path / "churn.journal.jsonl")
+    _run_churn(path, faults=True)
+    header, events = load_journal(path)
+    assert validate_journal(header, events) == []
+    r = replay_journal([path])
+    assert r.recorded and not r.diverged, r.first_divergence
+    assert r.faults_armed == sum(1 for e in events if e["ev"] == "fault")
+
+
+def test_journey_mode_reproduces_storm(tmp_path):
+    """ChaosSim(journey=...) re-drives a recorded storm: same pods
+    created/deleted, same final bound set."""
+    from nhd_tpu.sim.chaos import ChaosSim
+
+    path = str(tmp_path / "src.journal.jsonl")
+    src = _run_churn(path, steps=10)
+    replayed = ChaosSim(seed=0, journey=path)
+    for _ in range(10):
+        replayed.step()
+    assert replayed.stats.violations == []
+    assert replayed.stats.created == src.stats.created
+    assert replayed.stats.deleted == src.stats.deleted
+
+    def bound(sim):
+        return {key: pod.node for key, pod in sim.base.pods.items()}
+
+    assert bound(replayed) == bound(src)
+
+
+# ---------------------------------------------------------------------------
+# /journey view + journal metrics + monotonic dropped counter
+# ---------------------------------------------------------------------------
+
+def test_journey_view_joins_ring_and_journal(tmp_path):
+    import nhd_tpu.obs as obs
+    from nhd_tpu.obs import journey_view
+
+    assert journey_view("c1")["enabled"] is False
+    rec = obs.enable(capacity=64)
+    jnl = enable_journal(str(tmp_path / "j.jsonl"))
+    try:
+        with obs.correlate("cJV"):
+            with obs.span("solve"):
+                pass
+        rec.record_decision({"pod": "p", "ns": "d", "corr": "cJV",
+                             "outcome": "scheduled", "node": "n0"})
+        jnl.watch_event(
+            WatchEvent(kind="pod_create", name="p", namespace="d"),
+            corr="cJV",
+        )
+        view = journey_view("cJV")
+        assert view["enabled"] is True
+        assert [s["name"] for s in view["spans"]] == ["solve"]
+        assert view["decisions"][0]["outcome"] == "scheduled"
+        assert view["journal"]["seqs"] == [1]
+        assert view["journal"]["path"] == jnl.path
+    finally:
+        obs.disable()
+
+
+def test_metrics_render_journal_families(tmp_path):
+    from nhd_tpu.rpc.metrics import render_metrics
+
+    out = render_metrics([], 0, api_stats={})
+    assert "nhd_journal_enabled 0" in out
+    assert "nhd_journal_events_total" not in out
+    jnl = enable_journal(str(tmp_path / "m.jsonl"))
+    jnl.cluster_event("create_pod", {"name": "p"})
+    out = render_metrics([], 0, api_stats={})
+    assert "nhd_journal_enabled 1" in out
+    assert 'nhd_journal_events_total{ev="cluster"} 1' in out
+    assert "nhd_journal_bytes_total" in out
+
+
+def test_dropped_total_is_monotonic_across_generations():
+    import nhd_tpu.obs as obs
+    from nhd_tpu.obs.recorder import dropped_total
+
+    base = dropped_total()
+    rec = obs.enable(capacity=2)
+    try:
+        for i in range(5):
+            rec.record(f"s{i}", float(i), 0.1)
+        assert dropped_total() == base + 3
+        rec.clear()  # ring wiped, but the monotonic total keeps the 3
+        assert dropped_total() == base + 3
+        for i in range(4):
+            rec.record(f"r{i}", float(i), 0.1)
+        assert dropped_total() == base + 5
+    finally:
+        obs.disable()
+    assert dropped_total() == base + 5  # banked at disable
+
+
+def test_journal_module_has_no_heavy_imports():
+    """journal.py must stay import-light: producers import it on the
+    hot path with journaling off."""
+    import importlib
+
+    mod = importlib.reload(journal_mod)
+    assert not hasattr(mod, "jax")
+    assert not hasattr(mod, "numpy")

@@ -201,6 +201,55 @@ def _hetero_gates(
                 )
 
 
+def _replay_gates(
+    name: str, o: dict, n: dict, threshold: float, lines, regressions
+) -> None:
+    """Record/replay configs (a ``replay`` section in the NEW record —
+    cfg-replay, ISSUE 18): zero divergences is a hard gate (the golden
+    journal must replay decision-for-decision; a nonzero count means a
+    scheduler change altered decisions for recorded traffic without the
+    golden being regenerated), as is an empty replay (a journal that
+    yields no decisions gates nothing). Replay decision throughput
+    gates relatively when both sides carry the section (doubled
+    threshold: the leg is seconds-scale and host-jitter heavy)."""
+    nc = n.get("replay")
+    if not isinstance(nc, dict):
+        return
+    div = int(nc.get("divergences", 0) or 0)
+    if div > 0:
+        lines.append(
+            f"{name:>24} divergences: {div} <-- REGRESSION"
+        )
+        regressions.append(
+            f"{name} golden-journal replay diverged ({div} divergence(s); "
+            "decisions changed for recorded traffic — fix the scheduler "
+            "or regenerate the golden via tools/trace_replay.py "
+            "--regen-golden with the change called out)"
+        )
+    if int(nc.get("replayed", 0) or 0) <= 0:
+        lines.append(f"{name:>24} replayed: 0 <-- REGRESSION")
+        regressions.append(
+            f"{name} replayed zero decisions (the replay gate went dead)"
+        )
+    oc = o.get("replay")
+    if isinstance(oc, dict):
+        ov = float(oc.get("decisions_per_sec", 0.0) or 0.0)
+        nv = float(nc.get("decisions_per_sec", 0.0) or 0.0)
+        if ov > 0:
+            d = _pct(ov, nv)
+            fatal = -d > threshold * 2
+            mark = " <-- REGRESSION" if fatal else ""
+            lines.append(
+                f"{name:>24} replay dps: {ov:8.1f} -> {nv:8.1f} "
+                f"({d:+.1%}){mark}"
+            )
+            if fatal:
+                regressions.append(
+                    f"{name} replay decision throughput dropped {d:+.1%} "
+                    f"({ov:.1f} -> {nv:.1f}, threshold {threshold * 2:.0%})"
+                )
+
+
 #: a wall regression is fatal only when BOTH the relative threshold and
 #: this absolute growth (seconds) are exceeded: at small scales the
 #: figure is scheduler fixed overhead + host jitter (a 3 ms blip on a
@@ -250,6 +299,7 @@ def diff_artifacts(
             _churn_gates(name, o, n, threshold, lines, regressions)
         _spmd_gates(name, o, n, threshold, lines, regressions)
         _hetero_gates(name, o, n, threshold, lines, regressions)
+        _replay_gates(name, o, n, threshold, lines, regressions)
         cfg_threshold = (
             threshold * 2 if name in LATENCY_CONFIGS else threshold
         )
@@ -283,7 +333,11 @@ def diff_artifacts(
         ow, nw = float(o.get("wall_seconds", 0.0)), float(
             n.get("wall_seconds", 0.0)
         )
-        if ow >= floor and name not in LATENCY_CONFIGS and not churn:
+        replay_leg = isinstance(n.get("replay"), dict)
+        # replay legs gate on decision throughput (above); the wall gate
+        # would double-count the same seconds-scale, jitter-heavy figure
+        if (ow >= floor and name not in LATENCY_CONFIGS and not churn
+                and not replay_leg):
             d = _pct(ow, nw)
             fatal = d > threshold and (nw - ow) >= wall_floor
             mark = " <-- REGRESSION" if fatal else (

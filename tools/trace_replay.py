@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Record/replay journal driver: replay journals, diff decisions, and
+run the end-to-end demo gate (`make replay-demo`).
+
+Default mode replays one or more journal files through the real
+scheduler/controller stack (nhd_tpu/sim/replay.py) and diffs the
+replayed decisions against the recorded ones:
+
+    python tools/trace_replay.py run.journal.jsonl
+    python tools/trace_replay.py a.jsonl b.jsonl --speed 10 \\
+        --drop-node node0 --json-out /tmp/diff.json
+
+Exits non-zero when the replay diverges, so CI can gate on it.
+
+``--demo`` is the self-contained proof loop: record a seeded chaos
+churn storm, replay it (must NOT diverge), replay it again (must be
+bit-identical), then replay with a dropped node and a flipped knob
+(both MUST diverge, and the report must name the first divergent corr
+and the drifted knob). Any unexpected outcome exits non-zero.
+
+``--regen-golden`` rewrites tests/fixtures/journal/
+golden_churn.journal.jsonl — the committed golden journal the replay
+pin in tests/test_journal.py and the bench cfg-replay leg consume —
+with a byte-stable envelope (fixed rev/created). Run it only to accept
+a deliberate capture-format change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = __import__("pathlib").Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+GOLDEN = ROOT / "tests" / "fixtures" / "journal" / "golden_churn.journal.jsonl"
+DEMO_SEED = 1234
+DEMO_NODES = 6
+DEMO_STEPS = 20
+
+
+def _record_churn(path: str, *, seed: int = DEMO_SEED,
+                  rev=None, created=None) -> None:
+    """Record one seeded chaos churn storm into ``path``."""
+    from nhd_tpu.obs.journal import disable_journal, enable_journal
+    from nhd_tpu.sim.chaos import ChaosSim
+    from nhd_tpu.sim.faults import PROFILES
+
+    enable_journal(
+        path, identity="golden", seed=seed, rev=rev, created=created,
+    )
+    try:
+        sim = ChaosSim(
+            seed=seed, n_nodes=DEMO_NODES, api_faults=PROFILES["churn"],
+        )
+        for _ in range(DEMO_STEPS):
+            sim.step()
+    finally:
+        disable_journal()
+
+
+def _summarize(result, label: str) -> None:
+    print(
+        f"trace-replay: {label}: {len(result.replayed)} replayed vs "
+        f"{len(result.recorded)} recorded decisions, "
+        f"{len(result.divergences)} divergence(s), "
+        f"{len(result.knob_drift)} knob drift(s)"
+    )
+    fd = result.first_divergence
+    if fd is not None:
+        print(
+            f"trace-replay:   first divergence: corr={fd.get('corr')} "
+            f"pod={fd['ns']}/{fd['pod']} kind={fd['kind']} "
+            f"recorded={fd.get('recorded')} replayed={fd.get('replayed')}"
+        )
+    for name, drift in sorted(result.knob_drift.items()):
+        print(
+            f"trace-replay:   knob drift: {name} recorded="
+            f"{drift['recorded']!r} current={drift['current']!r}"
+        )
+
+
+def demo() -> int:
+    """The four-act replay gate; see the module docstring."""
+    import tempfile
+
+    from nhd_tpu.sim.replay import _decision_sig, replay_journal
+
+    path = os.path.join(tempfile.mkdtemp(prefix="nhd-replay-demo-"),
+                        "churn.journal.jsonl")
+    _record_churn(path)
+    print(f"trace-replay: recorded {path}")
+
+    r1 = replay_journal([path])
+    _summarize(r1, "act 1 (faithful replay)")
+    if r1.diverged or not r1.recorded:
+        print("trace-replay: FAIL: faithful replay diverged (or recorded "
+              "no decisions)")
+        return 1
+
+    r2 = replay_journal([path])
+    sig = lambda r: [  # noqa: E731 (one-shot comparator)
+        (d.get("ns"), d.get("pod"), _decision_sig(d)) for d in r.replayed
+    ]
+    if sig(r1) != sig(r2):
+        print("trace-replay: FAIL: two replays of one journal differ "
+              "(determinism broken)")
+        return 1
+    print(f"trace-replay: act 2: double replay bit-identical "
+          f"({len(r2.replayed)} decisions)")
+
+    r3 = replay_journal([path], drop_nodes=["node0"])
+    _summarize(r3, "act 3 (negative control: node0 dropped)")
+    if not r3.diverged or r3.first_divergence.get("corr") is None:
+        print("trace-replay: FAIL: dropped node was not detected as a "
+              "named divergence")
+        return 1
+
+    # knob-drift negative control: a replay under a different knob
+    # environment must report the drift by name even when decisions
+    # happen to agree
+    knob, flipped = "NHD_MIN_BUSY_SECS", "31"
+    prior = os.environ.get(knob)
+    os.environ[knob] = flipped
+    try:
+        r4 = replay_journal([path])
+    finally:
+        if prior is None:
+            del os.environ[knob]
+        else:
+            os.environ[knob] = prior
+    _summarize(r4, f"act 4 (negative control: {knob}={flipped})")
+    if knob not in r4.knob_drift:
+        print(f"trace-replay: FAIL: flipped knob {knob} not reported "
+              "as drift")
+        return 1
+
+    print("trace-replay: demo PASS")
+    return 0
+
+
+def regen_golden() -> int:
+    _record_churn(str(GOLDEN), rev="golden", created=0.0)
+    from nhd_tpu.obs.journal import load_journal, validate_journal
+
+    header, events = load_journal(str(GOLDEN))
+    errs = validate_journal(header, events)
+    if errs:
+        for e in errs:
+            print(f"trace-replay: golden invalid: {e}")
+        return 1
+    print(f"trace-replay: golden regenerated → {GOLDEN} "
+          f"({len(events)} events)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="replay nhd_tpu journals and diff decisions"
+    )
+    parser.add_argument("journals", nargs="*",
+                        help="journal file(s); several are merged by "
+                             "recorded timestamp")
+    parser.add_argument("--speed", type=float, default=1.0,
+                        help="time compression for the replay clock")
+    parser.add_argument("--drop-node", action="append", default=[],
+                        metavar="NODE",
+                        help="drop NODE from genesis (repeatable) — "
+                             "perturbation probe")
+    parser.add_argument("--json-out", default=None,
+                        help="write the divergence report JSON here")
+    parser.add_argument("--demo", action="store_true",
+                        help="record + replay + perturb a seeded churn "
+                             "storm; exit non-zero on any surprise")
+    parser.add_argument("--regen-golden", action="store_true",
+                        help=f"rewrite {GOLDEN.relative_to(ROOT)}")
+    args = parser.parse_args(argv)
+
+    if args.demo:
+        return demo()
+    if args.regen_golden:
+        return regen_golden()
+    if not args.journals:
+        parser.error("no journal files given (or use --demo)")
+
+    from nhd_tpu.sim.replay import replay_journal
+
+    try:
+        result = replay_journal(
+            args.journals, speed=args.speed, drop_nodes=args.drop_node,
+        )
+    except (OSError, ValueError) as exc:
+        print(f"trace-replay: cannot replay: {exc}")
+        return 2
+    _summarize(result, "replay")
+    if args.json_out:
+        payload = result.report_payload()
+        with open(args.json_out, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"trace-replay: report → {args.json_out}")
+    return 1 if result.diverged else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
