@@ -73,8 +73,13 @@ knobs-check:
 # instrumented locks; test_pipeline.py puts the r14 overlapped-commit
 # pipeline (scheduler/commitpipe.py condition + worker) and the
 # round-pipelining parity cells under them too.
+# NHD_RACE=1 layers the Eraser-style race detector (nhdrace,
+# nhd_tpu/sanitizer/races.py) on top: watched shared fields
+# (Scheduler.last_heartbeat, CommitPipeline._running/_stopped, kube
+# watch cursors) run under per-field lockset intersection; any
+# unsuppressed race witness fails the session in conftest teardown.
 sanitize:
-	NHD_SAN=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
+	NHD_SAN=1 NHD_RACE=1 python -m pytest tests/test_sanitizer.py tests/test_chaos.py \
 		tests/test_streaming.py tests/test_faults.py tests/test_ha.py \
 		tests/test_fleet.py tests/test_guard.py tests/test_pipeline.py \
 		tests/test_policy.py -q
@@ -149,7 +154,7 @@ soak:
 # fault-storm matrix: chaos WITH API-layer fault injection, seeds x
 # profiles (docs/RESILIENCE.md; CI runs the fast cell in tests/test_faults.py)
 chaos:
-	NHD_PIPELINE=1 python tools/chaos_storm.py --seeds $(CHAOS_SEEDS) --steps $(CHAOS_STEPS)
+	NHD_PIPELINE=1 NHD_RACE=1 python tools/chaos_storm.py --seeds $(CHAOS_SEEDS) --steps $(CHAOS_STEPS)
 
 # split-brain matrix: TWO scheduler replicas under leader election share
 # each cell's cluster, lease-renewal faults force leadership churn; zero
@@ -157,7 +162,7 @@ chaos:
 # (docs/RESILIENCE.md "HA & fencing"; CI runs the 3-seed subset in
 # tests/test_ha.py)
 ha-chaos:
-	NHD_PIPELINE=1 python tools/chaos_storm.py --ha --profiles ha-light,ha-storm \
+	NHD_PIPELINE=1 NHD_RACE=1 python tools/chaos_storm.py --ha --profiles ha-light,ha-storm \
 		--seeds $(HA_SEEDS) --steps $(HA_STEPS) \
 		--json-out artifacts/chaos/ha_chaos.json
 
@@ -170,7 +175,7 @@ ha-chaos:
 # under NHD_SAN=1 via the fed-light fast cell). The JSON artifact makes
 # runs diffable in CI instead of log-scrape-only.
 fed-chaos:
-	python tools/chaos_storm.py --federation $(FED_SHARDS) \
+	NHD_RACE=1 python tools/chaos_storm.py --federation $(FED_SHARDS) \
 		--replicas $(FED_REPLICAS) --profiles fed-light,fed-storm \
 		--seeds $(FED_SEEDS) --steps $(FED_STEPS) --nodes 6 \
 		--json-out artifacts/chaos/fed_chaos.json \
@@ -187,7 +192,7 @@ fed-chaos:
 # (docs/RESILIENCE.md "Layer 8"; CI runs the fast cell in
 # tests/test_guard.py). Artifact per cell via --json-out.
 device-chaos:
-	NHD_PIPELINE=1 python tools/chaos_storm.py --profiles device-faults --device-plane \
+	NHD_PIPELINE=1 NHD_RACE=1 python tools/chaos_storm.py --profiles device-faults --device-plane \
 		--bind-parity --seeds $(DEV_SEEDS) --steps $(DEV_STEPS) \
 		--json-out artifacts/chaos/device_chaos.json
 

@@ -126,6 +126,11 @@ def _pack_contract(modules):
     return check_project(modules)
 
 
+def _pack_races(modules):
+    from nhd_tpu.analysis.rules_races import check_project
+    return check_project(modules)
+
+
 # project packs: check_project(modules: Sequence[ModuleSource]) -> findings.
 # They run over the whole analyzed path set at once (analyze_file hands
 # them a one-module project, so EXPECT fixtures keep working unchanged).
@@ -133,6 +138,7 @@ PROJECT_PACKS: Dict[str, Callable] = {
     "lockgraph": _pack_lockgraph,
     "metrics": _pack_metrics,
     "contract": _pack_contract,
+    "races": _pack_races,
 }
 
 ALL_PACK_NAMES: Tuple[str, ...] = (*PACKS, *PROJECT_PACKS)
@@ -265,6 +271,23 @@ RULES: Dict[str, Tuple[str, str]] = {
                "from the nhd_tpu/config/knobs.py KNOBS registry — the "
                "OPERATIONS.md tunables table is generated from the "
                "registry, so the knob is undocumented"),
+    "NHD810": ("races",
+               "unsynchronized write to a field shared between thread "
+               "roots: no single lock is held across every access — "
+               "guard all accesses with one lock or declare the owning "
+               "thread in the ownership registry"),
+    "NHD811": ("races",
+               "write to declared single-writer state from a non-owner "
+               "thread root: readers tolerate staleness, a second writer "
+               "corrupts — route the update through the owner thread"),
+    "NHD812": ("races",
+               "non-atomic read-modify-write (x += 1, check-then-set) on "
+               "a shared field with no lock held: interleaved load/store "
+               "drops an update (lost counter, double-initialized cache)"),
+    "NHD813": ("races",
+               "mutable structure handed raw to a new thread "
+               "(Thread/Timer/submit) while the publisher keeps writing "
+               "it — pass a copy or guard both sides with one lock"),
 }
 
 

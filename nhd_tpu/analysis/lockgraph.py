@@ -403,6 +403,11 @@ class _FuncWalker:
 # ---------------------------------------------------------------------------
 
 class LockGraphAnalysis:
+    # subclasses (ownership.py) swap in a richer walker that records
+    # field accesses alongside the acquire/call/block events; every
+    # consumer loop here dispatches on ev.kind, so extra kinds are inert
+    walker_cls = _FuncWalker
+
     def __init__(self, modules: Sequence[ModuleSource]):
         self.modules = [_Module(m) for m in modules]
         self.locks: Dict[str, Lock] = {}
@@ -441,7 +446,7 @@ class LockGraphAnalysis:
             else:
                 parent.nested[fn.name] = func  # type: ignore[attr-defined]
             self.funcs[func.qual] = func
-            _FuncWalker(mod, func).walk(fn)
+            self.walker_cls(mod, func).walk(fn)
             # closures: the streaming tile workers (the shape of the real
             # deadlock) are nested defs — they need their own summaries
             for sub in _direct_nested_defs(fn):

@@ -371,6 +371,23 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("NHD_SAN_REPORT", "`/tmp/nhd_san_report.json`",
          "where the sanitizer session fixture writes its JSON witness "
          "report", scope="test"),
+    Knob("NHD_RACE", "unset",
+         "1 → conftest/chaos_storm install the Eraser-style race "
+         "detector (nhd_tpu/sanitizer/races.py) on top of nhdsan: "
+         "watched shared fields run under per-field candidate-lockset "
+         "intersection and an unsuppressed race witness fails the run",
+         scope="test"),
+    Knob("NHD_RACE_INJECT", "unset",
+         "1 → install_races() runs the injected-race negative control "
+         "(two unsynchronized writers on a watched dummy); the run MUST "
+         "then fail with a race report — proof the detector fires",
+         scope="test"),
+    Knob("NHD_RACE_ALLOW", "unset",
+         "comma-separated fnmatch globs of `mod/label:Class.attr` field "
+         "keys whose race witnesses are recorded as suppressed instead "
+         "of failing the run (pair every entry with a written "
+         "justification, like a static-pack inline suppression)",
+         scope="test"),
 )
 
 

@@ -37,6 +37,7 @@ from nhd_tpu.k8s.interface import (
     render_spill_record,
 )
 from nhd_tpu.k8s.retry import API_COUNTERS, RetryPolicy, RetryingApi, retryable
+from nhd_tpu.sanitizer.races import maybe_watch
 from nhd_tpu.utils import get_logger
 
 # Periodic full-relist resync cadence (seconds; 0 disables). A dropped
@@ -216,6 +217,10 @@ class KubeClusterBackend(ClusterBackend):
                 self._watch_kwargs = {
                     "_request_timeout": (30.0, _WATCH_READ_TIMEOUT)
                 }
+        # dynamic race layer (NHD_RACE=1): the watch/resync sequence
+        # fields are written by three watcher threads, always under
+        # _state_lock — registered before the watchers spawn
+        maybe_watch(self, ("_watch_seq", "_relist_floor"))
         if start_watches:
             self._start_watches()
 

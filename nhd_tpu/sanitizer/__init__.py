@@ -1,4 +1,5 @@
-"""nhdsan — runtime deadlock sanitizer (see runtime.py for the design).
+"""nhdsan — runtime deadlock sanitizer (see runtime.py for the design)
+plus nhdrace, the Eraser-style race layer on top (races.py).
 
 Quick use::
 
@@ -16,8 +17,24 @@ or process-wide (the tests/conftest.py NHD_SAN=1 path)::
     ...                        # + queue.get / Thread.join / Event.wait
     san.report()               # cycles, hold-while-blocking, lock stats
     uninstall()
+
+Race layer (the NHD_RACE=1 path)::
+
+    from nhd_tpu.sanitizer import install_races, uninstall_races
+    rs = install_races()       # wraps __setattr__ of watched classes
+    ...                        # product __init__s call maybe_watch(...)
+    rs.report()                # races keyed like the static NHD81x pack
+    uninstall_races()
 """
 
+from nhd_tpu.sanitizer.races import (
+    RaceSanitizer,
+    field_key,
+    get_race_sanitizer,
+    install_races,
+    maybe_watch,
+    uninstall_races,
+)
 from nhd_tpu.sanitizer.runtime import (
     DeadlockError,
     SanLock,
@@ -29,9 +46,15 @@ from nhd_tpu.sanitizer.runtime import (
 
 __all__ = [
     "DeadlockError",
+    "RaceSanitizer",
     "SanLock",
     "Sanitizer",
+    "field_key",
+    "get_race_sanitizer",
     "get_sanitizer",
     "install",
+    "install_races",
+    "maybe_watch",
     "uninstall",
+    "uninstall_races",
 ]

@@ -223,6 +223,20 @@ class Sanitizer:
         if w is not None:
             self._emit_span(w)
 
+    # -- race-sanitizer hook -------------------------------------------
+
+    def held_snapshot(self, ident: Optional[int] = None) -> Tuple[Tuple[int, str], ...]:
+        """(uid, 'kind@site') of every instrumented lock the thread
+        holds right now — the per-access lockset the race layer
+        (races.py) intersects, Eraser-style."""
+        if ident is None:
+            ident = threading.get_ident()
+        with self._reg:
+            return tuple(
+                (u, f"{self._locks[u].kind}@{self._locks[u].site}")
+                for u in self._held.get(ident, ())
+            )
+
     # -- report ---------------------------------------------------------
 
     def witnesses(self, kind: Optional[str] = None) -> List[dict]:

@@ -43,6 +43,7 @@ import time
 from collections import deque
 from typing import Any, Callable, List, Optional, Set, Tuple
 
+from nhd_tpu.sanitizer.races import maybe_watch
 from nhd_tpu.utils import get_logger
 
 
@@ -81,6 +82,10 @@ class CommitPipeline:
         self._inflight_keys: Set[Tuple[str, str]] = set()
         self._running = 0                   # units the worker holds
         self._stopped = False
+        # dynamic race layer (NHD_RACE=1): _running/_stopped are written
+        # by the scheduler thread and the worker, always under _cond —
+        # registered before the worker starts so its writes are tracked
+        maybe_watch(self, ("_running", "_stopped"))
         self._worker = threading.Thread(
             target=self._run, name=name, daemon=True
         )

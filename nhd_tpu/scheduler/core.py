@@ -45,6 +45,7 @@ from nhd_tpu.obs.recorder import (
     get_recorder,
     new_corr_id,
 )
+from nhd_tpu.sanitizer.races import maybe_watch
 from nhd_tpu.scheduler.events import WatchItem, WatchQueue, WatchType
 from nhd_tpu.solver.batch import BatchItem, BatchScheduler
 from nhd_tpu.utils import get_logger
@@ -314,6 +315,10 @@ class Scheduler(threading.Thread):
         # run_once turn — the same turn the flight-recorder spans and
         # histograms are fed from, so a wedged loop goes silent on both
         self.last_heartbeat = time.monotonic()
+        # _beat() runs on the loop thread AND on the commitpipe worker
+        # (per-drain heartbeat callback) — two unsynchronized writers
+        # until this lock (NHD811; see docs/STATIC_ANALYSIS.md)
+        self._hb_lock = threading.Lock()
         self.nqueue = watch_queue or WatchQueue()
         self.rpcq = rpc_queue or queue.Queue(maxsize=128)
         self.sched_name = sched_name
@@ -408,6 +413,10 @@ class Scheduler(threading.Thread):
         }
         self.t_started = time.monotonic()
         self._stop_event = threading.Event()
+        # dynamic race layer (NHD_RACE=1): last_heartbeat is written by
+        # the loop thread AND the commitpipe worker (both under
+        # _hb_lock) — registered post-init so construction stays exempt
+        maybe_watch(self, ("last_heartbeat",))
 
     # ------------------------------------------------------------------
     # startup / node inventory
@@ -2161,8 +2170,13 @@ class Scheduler(threading.Thread):
         turn AND at intra-turn progress points (batch admission, solve
         completion, each commit outcome, replay phases), so the stall
         watchdog measures 'no progress', not 'one long turn' — a
-        legitimate big batch never trips it, a wedged solve still does."""
-        self.last_heartbeat = time.monotonic()
+        legitimate big batch never trips it, a wedged solve still does.
+
+        Runs on the loop thread and on the commitpipe worker (the
+        heartbeat= ctor callback), so the write is locked: a monotonic
+        refresh can never be lost to an interleaved stale store."""
+        with self._hb_lock:
+            self.last_heartbeat = time.monotonic()
 
     def startup(self) -> None:
         """Initialization sequence (reference: NHDScheduler.py:443-464).

@@ -65,6 +65,17 @@ if os.environ.get("NHD_SAN") == "1":
 
     _nhd_san_install()
 
+# NHD_RACE=1 layers the Eraser-style race sanitizer on top (installing
+# nhdsan implicitly — locksets come from its instrumented locks). Same
+# import-time rule: product objects constructed during collection get
+# their maybe_watch() registrations instrumented. NHD_RACE_INJECT=1
+# makes install run the injected-race negative control, so this session
+# MUST then fail the race assertion below — the detection proof.
+if os.environ.get("NHD_RACE") == "1":
+    from nhd_tpu.sanitizer import install_races as _nhd_race_install
+
+    _nhd_race_install()
+
 
 @pytest.fixture(autouse=True, scope="session")
 def nhd_san_session():
@@ -74,23 +85,34 @@ def nhd_san_session():
     session if any wait-for-graph cycle was observed — a deadlock the
     per-test layer converted into a DeadlockError, or one recorded by a
     thread whose test had already moved on."""
-    if os.environ.get("NHD_SAN") != "1":
+    if os.environ.get("NHD_SAN") != "1" and os.environ.get("NHD_RACE") != "1":
         yield
         return
-    from nhd_tpu.sanitizer import get_sanitizer, uninstall
+    from nhd_tpu.sanitizer import (
+        get_race_sanitizer,
+        get_sanitizer,
+        uninstall,
+        uninstall_races,
+    )
 
     san = get_sanitizer()
-    assert san is not None, "NHD_SAN=1 but install did not run at import"
+    assert san is not None, "NHD_SAN/NHD_RACE set but install did not run"
+    race_san = get_race_sanitizer()
     try:
         yield
     finally:
+        race_report = None
+        if race_san is not None:
+            uninstall_races()
+            race_report = race_san.report()
         uninstall()
         report = san.report()
         out = os.environ.get("NHD_SAN_REPORT", "/tmp/nhd_san_report.json")
         try:
             with open(out, "w") as fh:
                 json.dump(
-                    {"report": report, "trace": san.chrome_trace()},
+                    {"report": report, "races": race_report,
+                     "trace": san.chrome_trace()},
                     fh, indent=2,
                 )
         except OSError:
@@ -99,3 +121,11 @@ def nhd_san_session():
         f"nhdsan observed {len(report['cycles'])} wait-for-graph "
         f"cycle(s); full witnesses in {out}"
     )
+    if race_report is not None:
+        assert not race_report["races"], (
+            f"nhdrace observed {len(race_report['races'])} unsuppressed "
+            f"data race(s) on watched shared state "
+            f"({[r['key'] for r in race_report['races']]}); full report "
+            f"in {out} — fix the race or allowlist the key via "
+            f"NHD_RACE_ALLOW with a written justification"
+        )

@@ -1,0 +1,87 @@
+"""Clean counterparts to races_pos.py — the pack must stay silent.
+
+Each class is one exoneration path: a consistent lockset, declared
+single-writer discipline honored, copy-on-publish, init-only writes,
+and main-thread-only code (no root reaches it).
+"""
+import threading
+
+
+class LockedPipeline:
+    """Every access under one lock: consistent lockset, clean."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.status = "idle"
+        self.counter = 0
+        self.t1 = None
+        self.t2 = None
+
+    def start(self):
+        self.t1 = threading.Thread(target=self._producer)
+        self.t2 = threading.Thread(target=self._consumer)
+        self.t1.start()
+        self.t2.start()
+
+    def _producer(self):
+        with self.lock:
+            self.status = "busy"
+            self.counter += 1
+
+    def _consumer(self):
+        with self.lock:
+            if self.status == "busy":
+                self.counter += 1
+
+
+class OwnedMirror:
+    """Single-writer discipline honored: only the owner writes; the
+    other root just reads (staleness-tolerant by declaration)."""
+
+    _NHD_RACE_OWNER = {"epoch": "*races_neg:OwnedMirror._tick"}
+
+    def __init__(self):
+        self.epoch = 0
+        self.t = None
+        self.w = None
+
+    def start(self):
+        self.t = threading.Thread(target=self._tick)
+        self.w = threading.Thread(target=self._reader)
+        self.t.start()
+        self.w.start()
+
+    def _tick(self):
+        self.epoch += 1
+
+    def _reader(self):
+        return self.epoch
+
+
+class CopyPublisher:
+    """Mutable state handed to the worker as a copy, not the live ref."""
+
+    def __init__(self):
+        self.items = []
+        self.t = None
+
+    def start(self):
+        self.t = threading.Thread(target=self._work, args=(list(self.items),))
+        self.t.start()
+
+    def _work(self, snapshot):
+        self.items = snapshot       # single root: no sharing
+        return len(snapshot)
+
+
+class MainThreadOnly:
+    """No thread root ever reaches these accesses: not shared."""
+
+    def __init__(self):
+        self.hits = 0
+
+    def bump(self):
+        self.hits += 1
+
+    def read(self):
+        return self.hits

@@ -1,0 +1,67 @@
+"""Deliberate NHD81x violations — every flagged line carries EXPECT.
+
+The 'nhd_tpu' fixture directory puts these in the races pack's path
+scope (production packages only); races_out_of_scope.py at the fixtures
+root holds the same shapes and must stay silent.
+"""
+import threading
+
+
+class Pipeline:
+    """Two spawned workers sharing unguarded instance state."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.status = "idle"        # init writes are exempt (pre-publish)
+        self.counter = 0
+        self.cache = None
+        self.items = []
+        self.t1 = None
+        self.t2 = None
+        self.t3 = None
+
+    def start(self):
+        self.t1 = threading.Thread(target=self._producer)
+        self.t2 = threading.Thread(target=self._consumer)
+        self.t3 = threading.Thread(target=self._indexer, args=(self.items,))  # EXPECT[NHD813]
+        self.t1.start()
+        self.t2.start()
+        self.t3.start()
+
+    def _producer(self):
+        self.status = "busy"        # EXPECT[NHD810]
+        self.counter += 1           # EXPECT[NHD812]
+        if self.cache is None:
+            self.cache = {"warm": True}  # EXPECT[NHD812]
+        self.items.append(1)
+
+    def _consumer(self):
+        if self.status == "busy":
+            self.counter += 1       # EXPECT[NHD812]
+        return self.cache
+
+    def _indexer(self, items):
+        return len(items)
+
+
+class Mirror:
+    """Declared single-writer state written from a second root."""
+
+    _NHD_RACE_OWNER = {"epoch": "*races_pos:Mirror._tick"}
+
+    def __init__(self):
+        self.epoch = 0
+        self.t = None
+        self.w = None
+
+    def start(self):
+        self.t = threading.Thread(target=self._tick)
+        self.w = threading.Thread(target=self._poker)
+        self.t.start()
+        self.w.start()
+
+    def _tick(self):
+        self.epoch += 1             # owner's own write: the discipline
+
+    def _poker(self):
+        self.epoch = 99             # EXPECT[NHD811]

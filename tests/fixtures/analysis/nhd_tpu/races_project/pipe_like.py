@@ -1,0 +1,28 @@
+"""Races project fixture, commit-pipe module: a worker thread that
+invokes the heartbeat callback bound at construction (keyword-only, like
+the real CommitPipeline) — the cross-module ctor-callable edge the
+ownership model must resolve.
+"""
+import threading
+
+from stats_like import bump, set_status
+
+
+class Pipe:
+    def __init__(self, *, heartbeat=None):
+        self._hb = heartbeat
+        self.lock = threading.Lock()
+        self.outcomes = []
+        self.w = None
+
+    def start(self):
+        self.w = threading.Thread(target=self._run)
+        self.w.start()
+
+    def _run(self):
+        if self._hb is not None:
+            self._hb()
+        with self.lock:
+            self.outcomes.append("ok")
+        bump()
+        set_status("drain")

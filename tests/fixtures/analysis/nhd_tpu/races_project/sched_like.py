@@ -1,0 +1,47 @@
+"""Races project fixture, scheduler-loop module — consistent as
+shipped; test_race_analysis.py injects one defect at a time.
+
+Mirrors the live architecture in miniature: a loop thread owning mirror
+state, a heartbeat callback handed to the pipe's constructor (so the
+pipe worker becomes a second caller of _beat), and a janitor thread
+that receives a *copy* of the mutable table.
+"""
+import threading
+
+from pipe_like import Pipe
+from stats_like import bump, set_status
+
+_NHD_RACE_OWNER = {"Loop.mirror_epoch": "*sched_like:Loop.run"}
+
+
+class Loop:
+    def __init__(self):
+        self.hb_lock = threading.Lock()
+        self.last_beat = 0.0
+        self.mirror_epoch = 0
+        self.table = {}
+        self.pipe = Pipe(heartbeat=self._beat)
+        self.t = None
+        self.j = None
+
+    def _beat(self):
+        with self.hb_lock:
+            self.last_beat += 1.0
+        # owner-only bookkeeping advances here
+
+    def start(self):
+        self.t = threading.Thread(target=self.run)
+        self.j = threading.Thread(target=self._janitor,
+                                  args=(dict(self.table),))
+        self.t.start()
+        self.j.start()
+
+    def run(self):
+        self._beat()
+        self.mirror_epoch += 1
+        self.table["epoch"] = self.mirror_epoch
+        bump()
+        set_status("loop")
+
+    def _janitor(self, snapshot):
+        return len(snapshot)

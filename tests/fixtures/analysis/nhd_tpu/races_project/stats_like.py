@@ -1,0 +1,21 @@
+"""Races project fixture, shared-counters module: module globals
+reached from every root, guarded by one lock — the consistent-lockset
+exoneration path for globals (cf. class fields in sched/pipe).
+"""
+import threading
+
+LOCK = threading.Lock()
+HITS = 0
+LAST_STATUS = ""
+
+
+def bump():
+    global HITS
+    with LOCK:
+        HITS += 1
+
+
+def set_status(status):
+    global LAST_STATUS
+    with LOCK:
+        LAST_STATUS = status

@@ -1,0 +1,11 @@
+"""Races project fixture, HTTP-views module: per-connection handler
+threads are roots, but their own instance state (close_connection) is
+thread-local by construction and must not read as shared.
+"""
+import stats_like
+
+
+class StatsHandler:
+    def do_GET(self):
+        stats_like.bump()
+        self.close_connection = True

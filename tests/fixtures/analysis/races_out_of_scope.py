@@ -1,0 +1,35 @@
+"""Same shapes as nhd_tpu/races_pos.py, but outside the races pack's
+path scope (no nhd_tpu path component): must produce zero findings —
+tools/ and tests/ harnesses spawn threads around fixtures freely.
+"""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self.status = "idle"
+        self.counter = 0
+        self.items = []
+        self.t1 = None
+        self.t2 = None
+        self.t3 = None
+
+    def start(self):
+        self.t1 = threading.Thread(target=self._producer)
+        self.t2 = threading.Thread(target=self._consumer)
+        self.t3 = threading.Thread(target=self._indexer, args=(self.items,))
+        self.t1.start()
+        self.t2.start()
+        self.t3.start()
+
+    def _producer(self):
+        self.status = "busy"
+        self.counter += 1
+        self.items.append(1)
+
+    def _consumer(self):
+        if self.status == "busy":
+            self.counter += 1
+
+    def _indexer(self, items):
+        return len(items)

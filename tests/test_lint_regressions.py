@@ -9,7 +9,12 @@
   never stopped (leaked stream); a watcher whose stop() raised aborted
   the sweep for every later watcher (k8s/kube.py, NHD201/NHD302);
 * MetricsServer.stop() raced start(): the plain-bool handshake could skip
-  shutdown() and leave the serve loop running forever (rpc/metrics.py).
+  shutdown() and leave the serve loop running forever (rpc/metrics.py);
+* Scheduler.last_heartbeat was written by the loop thread AND the
+  commitpipe worker (the ``heartbeat=`` ctor callback) with no common
+  lock (scheduler/core.py, NHD811 via the races pack) — an interleaved
+  stale store could roll the watchdog's liveness clock backwards; now
+  every ``_beat()`` write holds ``_hb_lock``.
 """
 
 from __future__ import annotations
@@ -172,3 +177,91 @@ def test_metrics_stop_idempotent_under_concurrency():
         t.join(timeout=5)
     server.join(timeout=5)
     assert not server.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler heartbeat: loop thread vs commitpipe worker (NHD811)
+# ---------------------------------------------------------------------------
+
+def _bare_scheduler():
+    """A Scheduler with only the heartbeat plane — the constructor wants
+    a backend; _beat() only needs the lock and the field."""
+    import time as _time
+
+    from nhd_tpu.scheduler.core import Scheduler
+
+    sched = Scheduler.__new__(Scheduler)
+    sched.last_heartbeat = _time.monotonic()
+    sched._hb_lock = threading.Lock()
+    return sched
+
+
+def test_heartbeat_concurrent_beats_run_race_free():
+    """The fixed shape under the dynamic detector: two threads driving
+    _beat() — the loop thread and the commitpipe worker's per-drain
+    callback — produce ZERO race witnesses because every write holds
+    _hb_lock. Uses a private sanitizer pair so the check also runs (and
+    stays meaningful) outside NHD_RACE=1 sessions."""
+    from nhd_tpu.sanitizer import RaceSanitizer, Sanitizer
+
+    san = Sanitizer(poll_interval=0.01)
+    rs = RaceSanitizer(san)
+    sched = _bare_scheduler()
+    # the lock must be one of THIS sanitizer's instrumented locks, or
+    # held_snapshot can't see it in the writers' locksets
+    sched._hb_lock = san.Lock()
+    rs.watch(sched, ("last_heartbeat",))
+    gate = threading.Barrier(2)
+
+    def hammer():
+        gate.wait(timeout=10)
+        for _ in range(200):
+            sched._beat()
+
+    try:
+        threads = [
+            threading.Thread(target=hammer, name=f"hb-{i}") for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        rs.unpatch_all()
+    rep = rs.report()
+    assert rep["races"] == [] and rep["suppressed"] == []
+    assert "scheduler/core:Scheduler.last_heartbeat" in rep["watched_fields"]
+
+
+def test_heartbeat_prefix_shape_would_be_caught():
+    """Counterfactual pin: the PRE-fix shape (raw unlocked stores to
+    last_heartbeat from two threads) trips the detector — proof this
+    regression test would fail if the lock were ever removed."""
+    from nhd_tpu.sanitizer import RaceSanitizer, Sanitizer, field_key
+    from nhd_tpu.scheduler.core import Scheduler
+
+    san = Sanitizer(poll_interval=0.01)
+    rs = RaceSanitizer(san)
+    sched = _bare_scheduler()
+    rs.watch(sched, ("last_heartbeat",))
+    gate = threading.Barrier(2)
+
+    def raw_beat():     # what _beat() was before _hb_lock
+        import time as _time
+
+        gate.wait(timeout=10)
+        for _ in range(200):
+            sched.last_heartbeat = _time.monotonic()
+
+    try:
+        threads = [threading.Thread(target=raw_beat) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        rs.unpatch_all()
+    rep = rs.report()
+    assert [r["key"] for r in rep["races"]] == [
+        field_key(Scheduler, "last_heartbeat")
+    ]

@@ -1,0 +1,200 @@
+"""nhdrace static pack (NHD81x): project-level behaviors.
+
+Complements tests/test_static_analysis.py (which owns the per-fixture
+EXPECT comparisons and the tier-1 gate): the tests here mutate one
+module of a consistent multi-module fixture project and assert the
+finding blames the right field, root, and rule — including the
+cross-module ctor-callable edge (heartbeat bound at construction) that
+makes the pipe worker a second writer of loop state. The live-tree
+tests pin the model facts the heartbeat fix (Scheduler._hb_lock) and
+the dynamic sanitizer's witness join depend on.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List
+
+from nhd_tpu.analysis.core import ModuleSource
+from nhd_tpu.analysis.ownership import build_model
+from nhd_tpu.analysis.rules_races import check_project
+
+REPO = Path(__file__).resolve().parent.parent
+PROJECT = Path(__file__).resolve().parent / "fixtures" / "analysis" \
+    / "nhd_tpu" / "races_project"
+
+
+def _load_project(overrides: Dict[str, tuple] | None = None) -> List[ModuleSource]:
+    """The races fixture project, optionally with per-file text
+    replacements applied (old -> new, must hit exactly once)."""
+    overrides = overrides or {}
+    modules = []
+    for path in sorted(PROJECT.glob("*.py")):
+        src = path.read_text()
+        if path.name in overrides:
+            old, new = overrides[path.name]
+            assert src.count(old) == 1, f"ambiguous mutation in {path.name}"
+            src = src.replace(old, new)
+        modules.append(ModuleSource(path.as_posix(), src, ast.parse(src)))
+    return modules
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def test_project_is_consistent_as_shipped():
+    assert check_project(_load_project()) == []
+
+
+# ---------------------------------------------------------------------------
+# model facts the rules build on
+# ---------------------------------------------------------------------------
+
+def test_model_resolves_ctor_bound_heartbeat_across_modules():
+    """Pipe(heartbeat=self._beat) + self._hb() on the worker thread must
+    make Loop._beat reachable from the pipe root — the exact shape of
+    the real CommitPipeline binding."""
+    model = build_model(_load_project())
+    beat = next(q for q in model.analysis.funcs if q.endswith("Loop._beat"))
+    roots = model.roots_of[beat]
+    assert any(r.endswith("pipe_like:Pipe._run") for r in roots), roots
+    assert any(r.endswith("sched_like:Loop.run") for r in roots), roots
+
+
+def test_model_inventories_all_roots():
+    model = build_model(_load_project())
+    kinds = {rid: r.kind for rid, r in model.roots.items()}
+    assert any(k.endswith("Loop.run") for k in kinds)
+    assert any(k.endswith("Loop._janitor") for k in kinds)
+    assert any(k.endswith("Pipe._run") for k in kinds)
+    assert any(k.endswith("StatsHandler.do_GET") for k in kinds)
+
+
+def test_handler_instance_state_is_not_shared():
+    """do_GET runs once per connection on its own handler instance:
+    close_connection must not enter the shared-field registry (the
+    apistub false positive, pinned)."""
+    model = build_model(_load_project())
+    assert not any(
+        k.endswith("StatsHandler.close_connection")
+        for k in model.shared_fields()
+    )
+
+
+def test_locked_globals_share_a_consistent_lockset():
+    model = build_model(_load_project())
+    shared = model.shared_fields()
+    hits = next(k for k in shared if k.endswith("stats_like:HITS"))
+    held = [a.held for a in shared[hits]]
+    assert all(held), held          # every access under stats LOCK
+
+
+# ---------------------------------------------------------------------------
+# one injected defect at a time, each blaming the right site
+# ---------------------------------------------------------------------------
+
+def test_unlocking_the_heartbeat_is_nhd812_naming_the_pipe_root():
+    findings = check_project(_load_project({
+        "sched_like.py": (
+            "        with self.hb_lock:\n            self.last_beat += 1.0",
+            "        self.last_beat += 1.0",
+        ),
+    }))
+    hits = _only(findings, "NHD812")
+    assert len(hits) == 1, findings
+    assert "Loop.last_beat" in hits[0].message
+    assert "Pipe._run" in hits[0].message
+
+
+def test_non_owner_write_is_nhd811():
+    findings = check_project(_load_project({
+        "sched_like.py": (
+            "        # owner-only bookkeeping advances here",
+            "        self.mirror_epoch = 0",
+        ),
+    }))
+    hits = _only(findings, "NHD811")
+    assert len(hits) == 1, findings
+    assert "Loop.mirror_epoch" in hits[0].message
+    assert "Pipe._run" in hits[0].message
+    # the owner's own unlocked write in run() stays exempt
+    assert not _only(findings, "NHD810")
+
+
+def test_unlocked_global_write_is_nhd810():
+    findings = check_project(_load_project({
+        "stats_like.py": (
+            "    with LOCK:\n        LAST_STATUS = status",
+            "    LAST_STATUS = status",
+        ),
+    }))
+    hits = _only(findings, "NHD810")
+    assert len(hits) == 1, findings
+    assert "stats_like:LAST_STATUS" in hits[0].message
+
+
+def test_unlocked_global_increment_is_nhd812():
+    findings = check_project(_load_project({
+        "stats_like.py": (
+            "    with LOCK:\n        HITS += 1",
+            "    HITS += 1",
+        ),
+    }))
+    hits = _only(findings, "NHD812")
+    assert len(hits) == 1, findings
+    assert "stats_like:HITS" in hits[0].message
+
+
+def test_raw_publish_of_mutable_table_is_nhd813():
+    findings = check_project(_load_project({
+        "sched_like.py": (
+            "args=(dict(self.table),)",
+            "args=(self.table,)",
+        ),
+    }))
+    hits = _only(findings, "NHD813")
+    assert len(hits) == 1, findings
+    assert "Loop.table" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# live tree: the model facts behind the shipped fix and the runtime join
+# ---------------------------------------------------------------------------
+
+def _load_live() -> List[ModuleSource]:
+    modules = []
+    for path in sorted((REPO / "nhd_tpu").rglob("*.py")):
+        src = path.read_text()
+        modules.append(ModuleSource(path.as_posix(), src, ast.parse(src)))
+    return modules
+
+
+def test_live_tree_heartbeat_facts():
+    """Pins the chain behind the Scheduler._hb_lock fix: the commitpipe
+    worker reaches _beat through the ctor binding, last_heartbeat is in
+    the shared registry, and every write now holds the lock (regressing
+    any of these reopens the NHD811)."""
+    model = build_model(_load_live())
+    beat = "scheduler/core:Scheduler._beat"
+    assert "scheduler/commitpipe:CommitPipeline._run" in model.roots_of[beat]
+    key = "scheduler/core:Scheduler.last_heartbeat"
+    shared = model.shared_fields()
+    assert key in shared
+    writes = [a for a in shared[key] if a.flavor != "read"]
+    assert writes and all(
+        any(h.endswith("Scheduler._hb_lock") for h in a.held) for a in writes
+    ), writes
+
+
+def test_live_tree_field_keys_join_runtime_keys():
+    """Static field keys are 'mod/label:Class.attr' — the exact strings
+    nhd_tpu.sanitizer.races.field_key() emits, so a runtime witness
+    names its static registry entry."""
+    from nhd_tpu.sanitizer.races import field_key
+    from nhd_tpu.scheduler.core import Scheduler
+    model = build_model(_load_live())
+    key = field_key(Scheduler, "last_heartbeat")
+    assert key == "scheduler/core:Scheduler.last_heartbeat"
+    assert key in model.fields

@@ -91,6 +91,9 @@ def found_of(path: Path, packs=None) -> set:
     ("solver/donate_neg.py", ["contract"]),
     ("knobs_pos.py", ["contract"]),
     ("knobs_neg.py", ["contract"]),
+    ("nhd_tpu/races_pos.py", ["races"]),
+    ("nhd_tpu/races_neg.py", ["races"]),
+    ("races_out_of_scope.py", ["races"]),
 ])
 def test_fixture_exact_findings(name, packs):
     path = FIXTURES / name
@@ -101,7 +104,8 @@ _POS_FIXTURES = ("tracing_pos.py", "locks_pos.py", "excepts_pos.py",
                  "solver/det_pos.py", "scheduler/fence_pos.py",
                  "lockgraph_pos.py", "metrics_pos.py",
                  "solver/contract_pos.py", "solver/contract_fp_pos.py",
-                 "solver/donate_pos.py", "knobs_pos.py")
+                 "solver/donate_pos.py", "knobs_pos.py",
+                 "nhd_tpu/races_pos.py")
 
 
 def test_fixtures_have_positive_coverage_for_every_pack():
