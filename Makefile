@@ -18,8 +18,10 @@ DEV_SEEDS ?= 3
 DEV_STEPS ?= 40
 POLICY_SEEDS ?= 3
 POLICY_STEPS ?= 40
+TENANT_SEEDS ?= 2
+TENANT_STEPS ?= 40
 
-.PHONY: test lint lint-diff knobs-check sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos policy-chaos trace-demo replay-demo fleet-demo docker docker-smoke release
+.PHONY: test lint lint-diff knobs-check sanitize proto bench bench-smoke bench-diff wheel clean native soak chaos ha-chaos fed-chaos device-chaos policy-chaos tenant-chaos trace-demo replay-demo fleet-demo docker docker-smoke release
 
 # C++ physical-assignment core, loaded via ctypes (nhd_tpu/native/__init__.py
 # auto-builds it on first import too)
@@ -95,6 +97,7 @@ check: lint lint-diff knobs-check test
 	$(MAKE) replay-demo
 	$(MAKE) device-chaos
 	$(MAKE) policy-chaos
+	$(MAKE) tenant-chaos
 
 # Regenerate protobuf message bindings. Service stubs are hand-written in
 # nhd_tpu/rpc/server.py (no grpc_python_plugin needed).
@@ -208,6 +211,21 @@ policy-chaos:
 	python tools/chaos_storm.py --policy \
 		--seeds $(POLICY_SEEDS) --steps $(POLICY_STEPS) \
 		--json-out artifacts/chaos/policy_chaos.json
+
+# tenant-isolation matrix (ISSUE 20): three cells per seed — CALM
+# (admission on, no abuse: the victim tenant's p99 time-to-bind
+# baseline), STORM (one abusive tenant at 10x the victim's rate; the
+# victim's p99 must stay within 10% of calm, the ladder must actually
+# shed AND re-admit, and every refusal must carry its AdmissionShed
+# event + decision record — exact accounting), and a NHD_ADMIT=0
+# CONTROL that must demonstrably VIOLATE the isolation bound (a
+# negative control: if FIFO passes too, the invariant is unfalsifiable)
+# (docs/RESILIENCE.md "Layer 9"; CI runs the fast cell in
+# tests/test_ingress.py).
+tenant-chaos:
+	python tools/chaos_storm.py --tenant \
+		--seeds $(TENANT_SEEDS) --steps $(TENANT_STEPS) \
+		--json-out artifacts/chaos/tenant_chaos.json
 
 # flight-recorder demo: run the sim with tracing on, dump the Chrome
 # trace, validate its schema + per-pod span pipeline (docs/OBSERVABILITY.md)

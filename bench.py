@@ -443,6 +443,175 @@ def bench_churn(name, *, n_nodes, events_per_sec, sim_seconds,
     return rec
 
 
+def bench_ingress(name, *, n_pods, n_nodes, waves=20, seed=11):
+    """Ingress admission leg (cfg9, ISSUE 20): a pre-generated
+    multi-tenant create stream — one abusive tenant at ~70% of the
+    volume, three behaved tenants sharing the rest — pushed through the
+    REAL front door: controller batched decode → AdmissionQueue (lanes,
+    DRR, shed ladder) → the scheduler's batched admitted drain, on the
+    fake backend with a deliberately scarce per-wave drain so the ladder
+    actually escalates.
+
+    Reports (a) the batched-decode micro-figure — µs per watch event
+    through Controller.decode_batch, the satellite pin for the
+    fold-N-events-per-wakeup decode path — and (b) the ladder economy:
+    admitted/deferred/readmitted/shed counts and rates plus binds/s
+    through the admitted path. ``verdictless_sheds`` (refusals without
+    an AdmissionShed event) must be ZERO — tools/bench_diff.py gates it
+    hard, alongside relative gates on decode cost and the rates.
+
+    Full scale is NHD_SPMD_PODS/NODES-parameterized like cfg6; the
+    smoke variant (ingress-smoke) runs a CPU-degraded fixed shape on
+    every `make check`.
+    """
+    import queue as queue_mod
+    import random
+
+    from nhd_tpu.ingress import AdmissionQueue
+    from nhd_tpu.k8s.fake import FakeClusterBackend
+    from nhd_tpu.scheduler.controller import Controller
+    from nhd_tpu.scheduler.core import Scheduler
+    from nhd_tpu.sim import SynthNodeSpec, make_node_labels, make_triad_config
+
+    #: the leg's overload posture (cf. chaos_storm._TENANT_CELL_ENV):
+    #: shallow lanes + a low sustained rate, so the stream exercises
+    #: every rung instead of admitting everything
+    leg_env = {
+        "NHD_ADMIT": "1",
+        "NHD_ADMIT_BATCH": "8",
+        "NHD_ADMIT_TENANT_CAP": "32",
+        "NHD_ADMIT_RATE": "2",
+    }
+    prior = {k: os.environ.get(k) for k in leg_env}
+    os.environ.update(leg_env)
+    try:
+        rng = random.Random(seed)
+        backend = FakeClusterBackend()
+        for i in range(n_nodes):
+            spec = SynthNodeSpec(name=f"ing-node{i:04d}")
+            backend.add_node(spec.name, make_node_labels(spec),
+                             hugepages_gb=spec.hugepages_gb)
+        simt = [0.0]
+        q = AdmissionQueue(clock=lambda: simt[0])
+        sched = Scheduler(backend, q, queue_mod.Queue(), respect_busy=False)
+        sched.build_initial_node_list()
+        controller = Controller(backend, q)
+        # one fixed request shape: the solver dedupes identical requests
+        # into one type, so the leg measures ingress + drain economy,
+        # not recompiles (and the warm-up bind below pays the one trace)
+        cfg = make_triad_config(
+            n_groups=1, gpus_per_group=0, cpu_workers=1, hugepages_gb=2
+        )
+        backend.create_pod("ing-warm", cfg_text=cfg)
+        controller.decode_batch(list(backend.poll_watch_events()))
+        while not q.empty():
+            sched.run_once()
+        backend.delete_pod("ing-warm")
+        controller.decode_batch(list(backend.poll_watch_events()))
+        while not q.empty():
+            sched.run_once()
+
+        base_stats = dict(q.stats)  # warm-up traffic doesn't ride the rates
+        tenants = ["tenant-abuse", "tenant-a", "tenant-b", "tenant-c"]
+        per_wave = max(n_pods // waves, 4)
+        pod_seq = 0
+        events_total = 0
+        decode_wall = 0.0
+        drain_wall = 0.0
+        for _ in range(waves):
+            simt[0] += 1.0
+            for _ in range(per_wave):
+                pod_seq += 1
+                ns = (tenants[0] if rng.random() < 0.7
+                      else rng.choice(tenants[1:]))
+                backend.create_pod(
+                    f"ing-{pod_seq}", ns, cfg_text=cfg,
+                    tier=1 if rng.random() < 0.1 else 0,
+                )
+            events = list(backend.poll_watch_events())
+            events_total += len(events)
+            t0 = time.perf_counter()
+            controller.decode_batch(events)
+            decode_wall += time.perf_counter() - t0
+            # scarce drain: ONE scheduler turn per wave (folding up to
+            # batch_limit() creates) — arrivals outpace it, which is
+            # what walks the stream up the ladder
+            t0 = time.perf_counter()
+            if not q.empty():
+                sched.run_once()
+            sched._publish_shed_verdicts()
+            drain_wall += time.perf_counter() - t0
+            # short jobs between waves (untimed): bound pods complete
+            # and their DELETE events drain through the control lane, so
+            # capacity stays free and the leg measures queue economy,
+            # not cluster saturation
+            for p in [p for p in backend.pods.values() if p.node]:
+                backend.delete_pod(p.name, p.namespace)
+            controller.decode_batch(list(backend.poll_watch_events()))
+            while q.depths()["control"] > 0:
+                sched.run_once()
+        # post-storm recovery (timed as drain): pressure falls, deferred
+        # pods re-admit, the backlog drains
+        t0 = time.perf_counter()
+        simt[0] += 30.0
+        while not q.empty():
+            sched.run_once()
+        sched._publish_shed_verdicts()
+        drain_wall += time.perf_counter() - t0
+
+        binds = sum(
+            1 for (ns, _p, _u, _n, _e, _l) in backend.bind_log
+            if ns.startswith("tenant-")
+        )
+        shed_events = sum(
+            1 for e in backend.events if e.reason == "AdmissionShed"
+        )
+        stats = {k: v - base_stats.get(k, 0) for k, v in q.stats.items()}
+        verdictless = stats["shed"] - shed_events
+        wall = decode_wall + drain_wall
+        decode_us = (decode_wall / events_total * 1e6) if events_total else 0.0
+        _log(
+            f"bench[{name}]: {pod_seq} creates over {len(tenants)} tenants "
+            f"({waves} waves, {n_nodes} nodes) -> decode "
+            f"{decode_us:.1f}us/event ({events_total} events), "
+            f"{binds} binds ({binds / drain_wall:.0f}/s drain), ladder: "
+            f"{stats['admitted']} admitted / {stats['deferred']} deferred "
+            f"(+{stats['readmitted']} readmitted) / {stats['shed']} shed "
+            f"({verdictless} verdictless)"
+        )
+        return {
+            "wall": wall, "placed": binds, "speedup": 0.0, "rounds": waves,
+            "phases": {
+                "ingress_decode_per_event": (
+                    decode_wall / events_total if events_total else 0.0
+                ),
+                "ingress_drain_mean": drain_wall / max(waves, 1),
+            },
+            "p99_bind_ms": 0.0,
+            "ingress": {
+                "creates_total": pod_seq,
+                "events_total": events_total,
+                "decode_us_per_event": round(decode_us, 2),
+                "binds_per_sec": (
+                    round(binds / drain_wall, 1) if drain_wall > 0 else 0.0
+                ),
+                "admitted": stats["admitted"],
+                "deferred": stats["deferred"],
+                "readmitted": stats["readmitted"],
+                "shed": stats["shed"],
+                "shed_rate": round(stats["shed"] / max(pod_seq, 1), 3),
+                "admit_rate": round(stats["admitted"] / max(pod_seq, 1), 3),
+                "verdictless_sheds": verdictless,
+            },
+        }
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def bench_config(name, n_pods, n_nodes, groups, baseline_sample=40,
                  cluster_fn=None, runner=run_batch):
     from nhd_tpu.sim.workloads import bench_cluster, workload_mix
@@ -1082,6 +1251,13 @@ def main() -> None:
         # every `make check` proves recorded traffic still replays
         # decision-for-decision
         configs["cfg-replay"] = bench_replay()
+        # seconds-scale ingress smoke (ISSUE 20): batched-decode cost per
+        # event + the shed ladder's economy under a CPU-degraded
+        # multi-tenant storm; verdictless sheds gated to zero by
+        # tools/bench_diff.py's ingress gates
+        configs["ingress-smoke"] = bench_ingress(
+            "ingress-smoke", n_pods=240, n_nodes=32, waves=20,
+        )
 
     if not smoke:
         # cfg3: NIC-saturated contention shape (places ~4k of 10k — the
@@ -1149,6 +1325,18 @@ def main() -> None:
         # so bench_diff gates across smoke and full artifacts alike)
         configs["cfg-replay"] = bench_replay()
 
+        # cfg9: the ingress streaming leg (ISSUE 20) — the multi-tenant
+        # create storm through the real front door (batched decode →
+        # admission lanes → DRR drain), NHD_SPMD_PODS/NODES-parameterized
+        # for the tunnel like cfg6; decode µs/event, binds/s and the
+        # admit/defer/shed rates ride the artifact for bench_diff
+        configs["cfg9:ingress-stream"] = bench_ingress(
+            "cfg9:ingress-stream",
+            n_pods=int(os.environ.get("NHD_SPMD_PODS", "2048")),
+            n_nodes=int(os.environ.get("NHD_SPMD_NODES", "128")),
+            waves=40,
+        )
+
     headline = {
         # the smoke leg's headline is cfg2 under its own metric name, so
         # bench_diff never compares a smoke headline against a full one
@@ -1179,7 +1367,8 @@ def main() -> None:
                     phases=r["phases"], p99_bind_ms=r["p99_bind_ms"],
                     extra={
                         k: r[k]
-                        for k in ("churn", "hetero", "spmd", "replay")
+                        for k in ("churn", "hetero", "spmd", "replay",
+                                  "ingress")
                         if k in r
                     } or None,
                 )

@@ -21,7 +21,6 @@ import time
 from nhd_tpu import __version__
 from nhd_tpu.scheduler.controller import Controller
 from nhd_tpu.scheduler.core import Scheduler
-from nhd_tpu.scheduler.events import WatchQueue
 from nhd_tpu.utils import get_logger
 
 
@@ -54,7 +53,13 @@ def build_threads(
     commit is fenced by the epoch of the shard owning the target node,
     and pods no owned shard can place spill to the untried shards
     (docs/RESILIENCE.md "Federation")."""
-    watch_q = WatchQueue()
+    from nhd_tpu.ingress import AdmissionQueue
+
+    # the daemon's watch plane runs behind the admission front door
+    # (nhd_tpu/ingress/): per-tenant bounded lanes, weighted fair
+    # dequeue, and the NHD_ADMIT_* load-shed ladder. NHD_ADMIT=0 keeps
+    # it a pass-through FIFO.
+    watch_q = AdmissionQueue()
     rpc_q: queue.Queue = queue.Queue(maxsize=128)  # reference: bin/nhd:21
 
     elector = None

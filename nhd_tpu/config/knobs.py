@@ -329,6 +329,37 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("NHD_POLICY_PREEMPT_ATTEMPTS", "2",
          "preemption attempts per pod before it takes the plain "
          "unschedulable verdict (the livelock bound)"),
+    # -- ingress admission -------------------------------------------------
+    Knob("NHD_ADMIT", "1",
+         "admission front door master switch "
+         "(docs/RESILIENCE.md \"Layer 9\"): per-tenant bounded lanes, "
+         "weighted fair dequeue, load-shed ladder. `0` → pass-through "
+         "FIFO (batched dequeue only, no fairness, no shedding)"),
+    Knob("NHD_ADMIT_BATCH", "8",
+         "max pod creates one scheduling batch folds from the front "
+         "door; halved at the defer rung, floored to 1 at the shed "
+         "rung"),
+    Knob("NHD_ADMIT_TENANT_CAP", "256",
+         "hard bound on one tenant's queued creates (live + deferred); "
+         "arrivals past it are shed with a verdict"),
+    Knob("NHD_ADMIT_RATE", "0",
+         "sustained per-tenant admission rate, creates/s (token "
+         "bucket); `0` disables rate limiting — the ladder then acts "
+         "on lane fill and commit-pipeline pressure alone"),
+    Knob("NHD_ADMIT_BURST", "max(rate, 1)",
+         "token-bucket burst: creates a tenant may submit at once "
+         "before the sustained rate applies"),
+    Knob("NHD_ADMIT_WEIGHTS", "unset",
+         "per-tenant dequeue weights as `ns=w,ns=w` (deficit round "
+         "robin); unregistered tenants weigh 1"),
+    Knob("NHD_ADMIT_DEFER_FILL", "0.5",
+         "pressure fraction (fullest live lane fill, joined with "
+         "commit-pipeline occupancy) at which over-rate tier-0 creates "
+         "park in the deferred lane"),
+    Knob("NHD_ADMIT_SHED_FILL", "0.85",
+         "pressure fraction at which over-rate creates are refused "
+         "outright (decision record + journal event + /explain "
+         "reason)"),
     # -- bench -------------------------------------------------------------
     Knob("NHD_SPMD_PODS", "4096",
          "pods in the cfg6 SPMD bench leg (`spmd-smoke` uses 512); "

@@ -242,6 +242,19 @@ class ApiCounters:
         "shard_spillover_orphan_age_max_seconds":
             ("gauge", "High-water mark of spillover record age (the "
                       "bounded-orphan-window observable)"),
+        # ingress admission plane (nhd_tpu/ingress/admission.py,
+        # docs/RESILIENCE.md "Layer 9 — Overload & admission")
+        "admission_admitted_total":
+            ("counter", "Pod creates admitted into a tenant lane"),
+        "admission_deferred_total":
+            ("counter", "Over-rate creates parked at the defer rung"),
+        "admission_readmitted_total":
+            ("counter", "Deferred creates re-admitted after recovery"),
+        "admission_shed_total":
+            ("counter", "Creates refused by the shed ladder (every one "
+                        "gets a decision record + journal event)"),
+        "admission_requeue_refusals_total":
+            ("counter", "Scheduler requeues refused at the hard lane cap"),
     }
 
     def __init__(self) -> None:

@@ -150,6 +150,20 @@ def scrape_replica(base_url: str, *, timeout: float = 5.0) -> dict:
             samples = metrics.get(name, [])
             return samples[0][1] if samples else 0.0
 
+        # per-tenant views (obs/slo.py tenant families): the scraped
+        # shape mirrors SloTracker.snapshot()["tenants"], so the payload
+        # builder merges both producer paths identically
+        tenants: Dict[str, dict] = {}
+        for fam, field in (
+            ("nhd_slo_tenant_observations_total", "observations_total"),
+            ("nhd_slo_tenant_breaches_total", "breaches_total"),
+            ("nhd_slo_tenant_max_seconds", "max_seconds"),
+            ("nhd_slo_tenant_p99_seconds", "p99_seconds"),
+        ):
+            for labels, value in metrics.get(fam, []):
+                tenants.setdefault(labels.get("tenant", "?"), {})[
+                    field
+                ] = value
         slo_snapshot = {
             "target_sec": _scalar("nhd_slo_bind_target_seconds"),
             "good_fraction": _scalar("nhd_slo_bind_good_fraction"),
@@ -159,6 +173,7 @@ def scrape_replica(base_url: str, *, timeout: float = 5.0) -> dict:
             "breaches_total": int(_scalar("nhd_slo_bind_breaches_total")),
             "max_seconds": _scalar("nhd_slo_bind_max_seconds"),
             "burn_rates": burn,
+            "tenants": tenants,
         }
     return {
         "replica": base_url,
@@ -284,6 +299,22 @@ def build_fleet_payload(
     for snap in slo_reps.values():
         for window, rate in (snap.get("burn_rates") or {}).items():
             worst_burn[window] = max(worst_burn.get(window, 0.0), rate)
+    # per-tenant fleet roll-up: totals sum, p99 is worst-of — one
+    # tenant's p99 on fire on any replica is that tenant's fleet answer
+    tenant_agg: Dict[str, dict] = {}
+    for snap in slo_reps.values():
+        for t, view in (snap.get("tenants") or {}).items():
+            agg = tenant_agg.setdefault(t, {
+                "observations_total": 0, "breaches_total": 0,
+                "worst_p99_seconds": 0.0,
+            })
+            agg["observations_total"] += int(
+                view.get("observations_total", 0)
+            )
+            agg["breaches_total"] += int(view.get("breaches_total", 0))
+            agg["worst_p99_seconds"] = max(
+                agg["worst_p99_seconds"], float(view.get("p99_seconds", 0.0))
+            )
     slo_summary = {
         "replicas": slo_reps,
         "observations_total": sum(
@@ -297,6 +328,7 @@ def build_fleet_payload(
             default=0.0,
         ),
         "worst_burn_rates": worst_burn,
+        "tenants": tenant_agg,
     }
 
     counters = dict(counters or {})
@@ -327,6 +359,11 @@ def build_fleet_payload(
             "guard_repairs_total",
             "policy_preemptions_total",
             "policy_preempt_budget_exhausted_total",
+            "admission_admitted_total",
+            "admission_deferred_total",
+            "admission_readmitted_total",
+            "admission_shed_total",
+            "admission_requeue_refusals_total",
         ):
             total, seen = 0.0, False
             for v in views:
@@ -412,6 +449,32 @@ def build_fleet_payload(
         "score_mode": int(counters.get("policy_score_mode", 0)),
     }
 
+    # ingress admission (nhd_tpu/ingress/): the fleet-wide front-door
+    # ledger plus per-replica queue-depth gauges sourced from the SAME
+    # exposition families /metrics serves — one backlog number, both
+    # surfaces (ISSUE 20 gauge-consistency satellite)
+    queue_depth: Dict[str, int] = {}
+    queue_depth_max_tenant: Dict[str, int] = {}
+    for v in views:
+        fams = v.get("metrics") or {}
+        for _labels, value in fams.get("nhd_event_queue_depth", []):
+            queue_depth[v["replica"]] = int(value)
+        for _labels, value in fams.get(
+            "nhd_event_queue_depth_max_tenant", []
+        ):
+            queue_depth_max_tenant[v["replica"]] = int(value)
+    ingress = {
+        "admitted_total": counters.get("admission_admitted_total", 0),
+        "deferred_total": counters.get("admission_deferred_total", 0),
+        "readmitted_total": counters.get("admission_readmitted_total", 0),
+        "shed_total": counters.get("admission_shed_total", 0),
+        "requeue_refusals_total": counters.get(
+            "admission_requeue_refusals_total", 0
+        ),
+        "queue_depth": queue_depth,
+        "queue_depth_max_tenant": queue_depth_max_tenant,
+    }
+
     shard_epochs: Dict[str, int] = {}
     for v in views:
         for shard, epoch in (v.get("shards") or {}).items():
@@ -441,6 +504,7 @@ def build_fleet_payload(
         "fencing": fencing,
         "device_state": device_state,
         "policy": policy,
+        "ingress": ingress,
         "leadership": lead,
         "violations": list(violations or []),
         "journeys": {

@@ -31,7 +31,10 @@ from __future__ import annotations
 import os
 import threading
 import time
+from bisect import bisect_left
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from nhd_tpu.obs.histo import DEFAULT_BUCKETS, quantile_from_buckets
 
 #: default objective: this fraction of pods bind within the target
 SLO_BIND_TARGET_SEC = float(os.environ.get("NHD_SLO_BIND_SEC", "30"))
@@ -49,7 +52,18 @@ METRIC_FAMILIES = (
     "slo_bind_breaches_total",
     "slo_bind_max_seconds",
     "slo_bind_burn_rate",
+    "slo_tenant_observations_total",
+    "slo_tenant_breaches_total",
+    "slo_tenant_max_seconds",
+    "slo_tenant_p99_seconds",
 )
+
+#: cap on distinct tenant labels (NHD603: label sets must be bounded by
+#: construction — namespaces are operator-created but not bounded, so
+#: past the cap new tenants aggregate under "other" instead of growing
+#: the family per namespace)
+TENANT_LABEL_MAX = 32
+TENANT_OVERFLOW = "other"
 
 
 class SloTracker:
@@ -83,12 +97,25 @@ class SloTracker:
         self._total = 0
         self._breaches = 0
         self._max_seen = 0.0
+        # per-tenant views (ISSUE 20): tenant → [count, breaches, max,
+        # latency bucket counts] over the shared DEFAULT_BUCKETS edges —
+        # p99 comes from the same interpolated-quantile estimate every
+        # scrape-side percentile uses (obs/histo.quantile_from_buckets).
+        # Bounded at TENANT_LABEL_MAX; overflow aggregates as "other".
+        self._tenant_edges = DEFAULT_BUCKETS
+        self._tenants: Dict[str, list] = {}
 
     # -- producers ------------------------------------------------------
 
-    def observe(self, tt_bind: float, now: Optional[float] = None) -> bool:
+    def observe(
+        self,
+        tt_bind: float,
+        now: Optional[float] = None,
+        tenant: Optional[str] = None,
+    ) -> bool:
         """One bound pod's creation→bind seconds; returns whether it
-        breached the target."""
+        breached the target. ``tenant`` (the pod's namespace) feeds the
+        per-tenant view the tenant-storm isolation invariant gates on."""
         now = self._clock() if now is None else now
         breached = tt_bind > self.target_sec
         with self._lock:
@@ -110,7 +137,25 @@ class SloTracker:
                 self._buckets = {
                     k: v for k, v in self._buckets.items() if k >= floor_key
                 }
+            if tenant is not None:
+                self._observe_tenant_locked(tenant, tt_bind, breached)
         return breached
+
+    def _observe_tenant_locked(
+        self, tenant: str, tt_bind: float, breached: bool
+    ) -> None:
+        if tenant not in self._tenants and len(self._tenants) >= TENANT_LABEL_MAX:
+            tenant = TENANT_OVERFLOW
+        state = self._tenants.get(tenant)
+        if state is None:
+            state = [0, 0, 0.0, [0] * (len(self._tenant_edges) + 1)]
+            # _locked suffix contract: observe() holds _lock here
+            self._tenants[tenant] = state  # nhdlint: ignore[NHD201]
+        state[0] += 1
+        if breached:
+            state[1] += 1
+        state[2] = max(state[2], tt_bind)
+        state[3][bisect_left(self._tenant_edges, tt_bind)] += 1
 
     # -- consumers ------------------------------------------------------
 
@@ -131,11 +176,42 @@ class SloTracker:
             return 0.0
         return (bad / total) / (1.0 - self.good_fraction)
 
+    def tenant_p99(self, tenant: str) -> float:
+        """Interpolated p99 time-to-bind for one tenant (0.0 when the
+        tenant never bound a pod) — the tenant-storm isolation
+        invariant's measured quantity."""
+        with self._lock:
+            state = self._tenants.get(tenant)
+            if state is None:
+                return 0.0
+            counts = list(state[3])
+        return self._p99_from_counts(counts)
+
+    def _p99_from_counts(self, counts: List[int]) -> float:
+        pairs = []
+        running = 0
+        for edge, c in zip(self._tenant_edges, counts):
+            running += c
+            pairs.append((edge, running))
+        pairs.append((float("inf"), running + counts[-1]))
+        return quantile_from_buckets(pairs, 0.99)
+
     def snapshot(self, now: Optional[float] = None) -> dict:
         now = self._clock() if now is None else now
         with self._lock:
             total, breaches = self._total, self._breaches
             max_seen = self._max_seen
+            tenants = {
+                name: {
+                    "observations_total": st[0],
+                    "breaches_total": st[1],
+                    "max_seconds": st[2],
+                    "counts": list(st[3]),
+                }
+                for name, st in self._tenants.items()
+            }
+        for view in tenants.values():
+            view["p99_seconds"] = self._p99_from_counts(view.pop("counts"))
         return {
             "target_sec": self.target_sec,
             "good_fraction": self.good_fraction,
@@ -146,6 +222,7 @@ class SloTracker:
                 label: self.burn_rate(width, now)
                 for label, width in self.windows
             },
+            "tenants": tenants,
         }
 
     def render(self, prefix: str = "nhd_") -> List[str]:
@@ -183,6 +260,30 @@ class SloTracker:
             lines.append(
                 f'{prefix}slo_bind_burn_rate{{window="{label}"}} {rate}'
             )
+        if snap["tenants"]:
+            for name, kind, help_text, field in (
+                ("slo_tenant_observations_total", "counter",
+                 "Binds measured per tenant (namespace, bounded set)",
+                 "observations_total"),
+                ("slo_tenant_breaches_total", "counter",
+                 "Per-tenant binds that exceeded the SLO target",
+                 "breaches_total"),
+                ("slo_tenant_max_seconds", "gauge",
+                 "Per-tenant largest creation-to-bind seconds",
+                 "max_seconds"),
+                ("slo_tenant_p99_seconds", "gauge",
+                 "Per-tenant interpolated p99 creation-to-bind seconds",
+                 "p99_seconds"),
+            ):
+                lines += [
+                    f"# HELP {prefix}{name} {help_text}",
+                    f"# TYPE {prefix}{name} {kind}",
+                ]
+                for tenant in sorted(snap["tenants"]):
+                    lines.append(
+                        f'{prefix}{name}{{tenant="{tenant}"}} '
+                        f'{snap["tenants"][tenant][field]}'
+                    )
         return lines
 
     def reset(self) -> None:
@@ -191,6 +292,7 @@ class SloTracker:
             self._total = 0
             self._breaches = 0
             self._max_seen = 0.0
+            self._tenants.clear()
 
 
 #: process-global tracker (one replica per process in production; chaos

@@ -83,7 +83,14 @@ def render_metrics(
         ("assign_seconds_total", "counter",
          "Seconds in physical ID assignment"),
         ("event_queue_depth", "gauge",
-         "Watch events waiting for the scheduler thread"),
+         "Watch events waiting for the scheduler thread (under "
+         "admission: control + all tenant lanes, deferred included)"),
+        ("event_queue_depth_max_tenant", "gauge",
+         "Deepest single tenant lane at the admission front door"),
+        ("event_queue_deferred", "gauge",
+         "Creates parked at the admission defer rung"),
+        ("admission_rung", "gauge",
+         "Load-shed ladder rung (0 admit / 1 defer / 2 shed)"),
         ("uptime_seconds", "gauge", "Seconds since the scheduler started"),
     ):
         if perf is None or name not in perf:

@@ -161,6 +161,15 @@ class CommitPipeline:
         with self._cond:
             return set(self._inflight_keys)
 
+    def occupancy(self) -> float:
+        """In-flight depth as a fraction of the bound (0..1) — the
+        backpressure signal the ingress admission ladder joins with its
+        own lane fill (nhd_tpu/ingress/admission.py): a commit pipeline
+        running near its depth escalates shedding at the front door
+        instead of letting submit() become the only brake."""
+        with self._cond:
+            return min(self._inflight_depth() / float(self.depth), 1.0)
+
     def stop(self, flush: bool = True) -> None:
         """Stop the worker; with ``flush`` (default) drain the queue
         first so no accepted commit is silently dropped."""

@@ -15,6 +15,7 @@ import os
 import queue
 import threading
 import time
+from collections import OrderedDict
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -52,6 +53,11 @@ from nhd_tpu.utils import get_logger
 
 IDLE_CNT_THRESH = 60        # reference: NHDScheduler.py:24
 Q_BLOCK_TIME_SEC = 0.5      # reference: NHDScheduler.py:25
+
+# bound on the recently-shed /explain map (ns, pod) → reason: old
+# refusals age out FIFO once the map is full — /explain answers for the
+# overload in progress, not for history (the journal keeps that)
+SHED_RECENT_MAX = 512
 
 # above this node count the scheduler solves through the streaming tiler
 # (solver/streaming.py) instead of one whole-cluster batch — bounded
@@ -320,6 +326,26 @@ class Scheduler(threading.Thread):
         # until this lock (NHD811; see docs/STATIC_ANALYSIS.md)
         self._hb_lock = threading.Lock()
         self.nqueue = watch_queue or WatchQueue()
+        # ingress admission (nhd_tpu/ingress/): detected by duck-typing
+        # so every plain-WatchQueue construction (tests, legacy wiring)
+        # keeps the exact pre-admission single-get behavior. With an
+        # AdmissionQueue wired, the loop switches to batched DRR drain,
+        # publishes shed verdicts, and couples the queue's ladder to the
+        # commit pipeline's occupancy (docs/RESILIENCE.md "Layer 9").
+        self._admission = (
+            self.nqueue if hasattr(self.nqueue, "get_creates") else None
+        )
+        if (
+            self._admission is not None
+            and self._admission.pressure_fn is None
+        ):
+            self._admission.pressure_fn = self._commit_pressure
+        # /explain reasons for recently shed pods: bounded (ns, pod) →
+        # reason map fed by _publish_shed_verdicts, read by
+        # explain_request — a refused pod answers "why" without a trace
+        self._shed_recent: "OrderedDict[Tuple[str, str], str]" = (
+            OrderedDict()
+        )
         self.rpcq = rpc_queue or queue.Queue(maxsize=128)
         self.sched_name = sched_name
         self.nodes: Dict[str, HostNode] = {}
@@ -757,7 +783,9 @@ class Scheduler(threading.Thread):
         # domains: on a fake backend (monotonic clock) vs the global
         # tracker (wall clock) every burn-rate gauge would read 0
         # forever. Chaos stays exact: its trackers run on the sim clock.
-        self._slo_tracker().observe(tt)
+        # The namespace rides along as the tenant label: the per-tenant
+        # p99 view is what the tenant-storm isolation invariant gates on
+        self._slo_tracker().observe(tt, tenant=ns)
 
     def attempt_scheduling_batch(
         self,
@@ -1167,6 +1195,15 @@ class Scheduler(threading.Thread):
         ):
             self._drain_commits(block=True)
 
+    def _commit_pressure(self) -> float:
+        """Bind-pipeline backpressure (0..1) for the admission ladder:
+        the commit pipeline's occupancy when async commit is live, else
+        0 — synchronous commits apply their own backpressure by blocking
+        the loop. Called from producer threads (controller put paths),
+        so it reads only the lazily-built pipe reference."""
+        pipe = self._commitpipe
+        return pipe.occupancy() if pipe is not None else 0.0
+
     def _decision(
         self,
         pod: str,
@@ -1262,6 +1299,18 @@ class Scheduler(threading.Thread):
             )
         return outcome, t_done
 
+    def _requeue_put(self, item: WatchItem) -> None:
+        """Enqueue a scheduler-originated requeue (transient-bind retry,
+        preemptor, victim): with admission wired it takes the requeue
+        lane — rate/defer exempt (the pod's first admission already
+        paid them) but still hard-capped, and a refusal yields exactly
+        one shed verdict; a plain WatchQueue keeps plain put."""
+        put = getattr(self.nqueue, "put_requeue", None)
+        if put is not None:
+            put(item)
+        else:
+            self.nqueue.put(item)
+
     def _requeue_pod(
         self, pod: str, ns: str, uid: str, node: Optional[HostNode],
         item: BatchItem, *, corr: Optional[str] = None,
@@ -1291,7 +1340,7 @@ class Scheduler(threading.Thread):
             f"{ns}/{pod}: transient commit failure; requeued "
             f"(attempt {attempts}/{REQUEUE_MAX})"
         )
-        self.nqueue.put(WatchItem(
+        self._requeue_put(WatchItem(
             WatchType.TRIAD_POD_CREATE,
             pod={"ns": ns, "name": pod, "uid": uid, "cfg": "", "node": ""},
             corr=corr,
@@ -1778,7 +1827,7 @@ class Scheduler(threading.Thread):
         # the end-to-end cell; tests/test_policy.py pins the order)
         self._preempt_attempts[key] = attempts + 1
         self.pod_state.pop(key, None)
-        self.nqueue.put(WatchItem(
+        self._requeue_put(WatchItem(
             WatchType.TRIAD_POD_CREATE,
             pod={"ns": ns, "name": pod, "uid": uid, "cfg": "", "node": ""},
             corr=corr,
@@ -1824,7 +1873,7 @@ class Scheduler(threading.Thread):
                 self._publish_decision(rec, d)
             # requeue the victim under its ORIGINAL corr ID: the flight
             # recorder's journey view shows preempt→rebind as one trace
-            self.nqueue.put(WatchItem(
+            self._requeue_put(WatchItem(
                 WatchType.TRIAD_POD_CREATE,
                 pod={"ns": vns, "name": vpod, "uid": vuid, "cfg": "",
                      "node": ""},
@@ -2024,7 +2073,16 @@ class Scheduler(threading.Thread):
             reply_q.put(self.get_pod_stats())
         elif msg_type == RpcMsgType.PERF_INFO:
             perf = dict(self.perf)
+            # TRUE ingress backlog: under admission, qsize() sums the
+            # control lane plus every tenant lane (deferred included) —
+            # the same number depths() reports, so /metrics and the
+            # fleet payload can never disagree about the backlog
             perf["event_queue_depth"] = self.nqueue.qsize()
+            if self._admission is not None:
+                d = self._admission.depths()
+                perf["event_queue_depth_max_tenant"] = d["max_tenant"]
+                perf["event_queue_deferred"] = d["deferred"]
+                perf["admission_rung"] = d["rung"]
             perf["uptime_seconds"] = time.monotonic() - self.t_started
             reply_q.put(perf)
         elif msg_type == RpcMsgType.EXPLAIN_INFO:
@@ -2064,12 +2122,27 @@ class Scheduler(threading.Thread):
                 # policy verdict (NHD_POLICY=1): tier, scoring mode and
                 # the per-schedulable-node score-term breakdown
                 out["policy"] = rep.policy
+            self._attach_admission_explain(out, label)
             return out
         except Exception as exc:
             # a diagnostics query must answer with the failure, not kill
             # the single-writer thread
             self.logger.exception(f"explain failed for {label}")
             return {"error": f"explain failed: {exc}"}
+
+    def _attach_admission_explain(self, out: dict, label: str) -> None:
+        """Decorate an /explain reply with the front door's state: the
+        current rung and lane depths always, plus the shed reason when
+        this pod was recently refused — "why is my pod not scheduling"
+        must answer "admission refused it", never shrug."""
+        if self._admission is None:
+            return
+        adm: Dict[str, Any] = {"depths": self._admission.depths()}
+        ns, _, pod = label.partition("/")
+        reason = self._shed_recent.get((ns, pod))
+        if reason is not None:
+            adm["shed"] = reason
+        out["admission"] = adm
 
     # ------------------------------------------------------------------
     # event handling
@@ -2160,6 +2233,80 @@ class Scheduler(threading.Thread):
             # periodic reconcile net as their deletes surface.
             if item.node and self.nodes.pop(item.node, None) is not None:
                 self._note_node(item.node)
+
+    def _handle_admitted_batch(self, first: WatchItem) -> None:
+        """The admission-queue form of the TRIAD_POD_CREATE path: fold
+        the blocking get's create plus up to batch_limit()-1 more (DRR
+        order across tenant lanes, so the fold itself is fair) into ONE
+        batched solve — the solver amortization the front door feeds.
+        batch_limit() shrinks with the ladder: under pressure the loop
+        takes smaller bites, coupling solve admission to queue and
+        commit-pipeline depth. Each pod still walks the per-pod gates
+        the single-item path walks (commit barrier, shard gate,
+        SCHEDULED dedup)."""
+        items = [first]
+        limit = self._admission.batch_limit() - 1
+        if limit > 0:
+            items.extend(self._admission.get_creates(limit))
+        batch: List[Tuple[str, str, str]] = []
+        meta: Dict[Tuple[str, str], Tuple[Optional[str], float]] = {}
+        for it in items:
+            ns, pod, uid = it.pod["ns"], it.pod["name"], it.pod["uid"]
+            key = (ns, pod)
+            if key in meta:
+                continue  # duplicate create within the fold: one solve
+            self._commit_barrier_for(ns, pod)
+            if self.sharded is not None and not self._gate_pod(
+                pod, ns, self._spill_clock()
+            ):
+                continue  # another shard's owner drives this pod
+            state = self.pod_state.get(key)
+            if state and state["state"] == PodStatus.SCHEDULED:
+                if state["uid"] == uid:
+                    continue  # already scheduled; stale event
+                self.release_pod_resources(pod, ns)
+                self.pod_state.pop(key, None)
+            batch.append((pod, ns, uid))
+            meta[key] = (it.corr, it.t_enqueue)
+        if batch:
+            self.attempt_scheduling_batch(batch, meta=meta)
+
+    def _publish_shed_verdicts(self) -> None:
+        """Turn every pending admission refusal into its explicit
+        verdict — decision record, journal entry, pod event, /explain
+        reason. Runs on the scheduler thread (the single writer) once
+        per loop turn, idle turns included, so a shed pod's verdict
+        lands within one Q_BLOCK_TIME even when nothing else is
+        admitted. drain_shed pops each record exactly once, so a
+        refusal can neither lose its verdict nor double-issue it."""
+        if self._admission is None:
+            return
+        records = self._admission.drain_shed()
+        if not records:
+            return
+        rec = self._rec()
+        for r in records:
+            ns, pod = r["ns"], r["pod"]
+            self._shed_recent[(ns, pod)] = r["reason"]
+            while len(self._shed_recent) > SHED_RECENT_MAX:
+                self._shed_recent.popitem(last=False)
+            try:
+                self.backend.generate_pod_event(
+                    pod, ns, "AdmissionShed", EventType.WARNING,
+                    f"Refused by admission: {r['reason']}",
+                )
+            except Exception:
+                # the event is best-effort decoration; the decision
+                # record and journal entry below must still land
+                self.logger.warning(
+                    f"{ns}/{pod}: AdmissionShed event emit failed"
+                )
+            if rec is not None or get_journal() is not None:
+                d = self._decision(pod, ns, r.get("corr"), "admission-shed")
+                d["reason"] = r["reason"]
+                if r.get("requeued"):
+                    d["requeued"] = True
+                self._publish_decision(rec, d)
 
     # ------------------------------------------------------------------
     # main loop
@@ -2415,6 +2562,11 @@ class Scheduler(threading.Thread):
             return idle_count
         except queue.Empty:
             pass
+        if acting:
+            # admission refusals accrued since the last turn get their
+            # verdicts before any new work — including on turns that go
+            # on to idle out below
+            self._publish_shed_verdicts()
         try:
             item = self.nqueue.get(block=True, timeout=Q_BLOCK_TIME_SEC)
         except queue.Empty:
@@ -2425,9 +2577,20 @@ class Scheduler(threading.Thread):
                     self._guarded("periodic scan", self.check_pending_pods)
             return idle_count
         if acting:
-            self._guarded(
-                f"watch item {item.type.name}", self.handle_watch_item, item
-            )
+            if (
+                self._admission is not None
+                and item.type == WatchType.TRIAD_POD_CREATE
+            ):
+                # front-door mode: fold further admitted creates (DRR
+                # order) into one batched solve
+                self._guarded(
+                    "admitted batch", self._handle_admitted_batch, item
+                )
+            else:
+                self._guarded(
+                    f"watch item {item.type.name}",
+                    self.handle_watch_item, item,
+                )
         else:
             self._handle_standby_item(item)
         return idle_count

@@ -12,7 +12,7 @@ from __future__ import annotations
 import queue
 from dataclasses import dataclass
 from enum import Enum, auto
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 
 class WatchType(Enum):
@@ -57,6 +57,12 @@ class WatchQueue:
 
     def put(self, item: WatchItem) -> None:
         self._q.put(item)
+
+    def put_batch(self, items: List[WatchItem]) -> None:
+        """Batched enqueue seam shared with ingress.AdmissionQueue: the
+        controller hands one decode pass's items over in arrival order."""
+        for item in items:
+            self._q.put(item)
 
     def get(self, block: bool = True, timeout: Optional[float] = None) -> WatchItem:
         return self._q.get(block=block, timeout=timeout)

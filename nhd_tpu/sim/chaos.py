@@ -123,6 +123,39 @@ POLICY_PASSES_PER_STEP = 10
 #:              force rebinds under preemption pressure
 POLICY_PROFILES = ("mixed-gen", "quota-storm", "maint-wave")
 
+# ---------------------------------------------------------------------------
+# tenant-storm profile (ISSUE 20: the ingress admission plane's scenario —
+# one abusive tenant floods creates while a victim tenant trickles; the
+# per-tenant lanes, DRR dequeue and shed ladder must keep the victim's
+# time-to-bind flat). Solo mode only: the admission counters ride the
+# process-global API_COUNTERS bank, like the policy counters.
+# ---------------------------------------------------------------------------
+
+#: the tenant-storm profile names (make tenant-chaos sweeps the seeds)
+TENANT_PROFILES = ("tenant-storm",)
+
+#: the well-behaved tenant: one pod per step, always in-rate — its p99
+#: time-to-bind is the isolation invariant's measured quantity
+TENANT_VICTIM = "tenant-victim"
+
+#: the flooding tenant: ``abuse_rate`` pods per step
+TENANT_ABUSER = "tenant-abuser"
+
+#: CREATE-drain passes one tenant step drives — deliberately scarce
+#: (the generic storm drives 8): the front door only sheds when
+#: arrivals outpace the drain, so the drive is throttled to make
+#: overload real rather than letting the sim drain everything
+TENANT_PASSES_PER_STEP = 3
+
+#: periodic-scan cadence in tenant mode (steps). The reconcile scan
+#: reads pending pods straight off the cluster, BYPASSING the front
+#: door — run every step (the generic storm's cadence) it would
+#: quietly re-admit everything the ladder shed and the isolation test
+#: would measure nothing. Every TENANT_SCAN_EVERY steps matches the
+#: production run loop's occasional-scan posture and doubles as the
+#: shed pods' documented recovery path.
+TENANT_SCAN_EVERY = 10
+
 # kill/restart waves leave a federation replica down for at most this
 # many steps before its fresh incarnation rejoins (crash-only restart)
 KILL_DOWN_MAX_STEPS = 2
@@ -349,6 +382,9 @@ class ChaosSim:
         policy: Optional[str] = None,
         policy_off: bool = False,
         journey: Optional[str] = None,
+        tenant: Optional[str] = None,
+        admit_off: bool = False,
+        abuse_rate: int = 10,
     ):
         if ha and federation:
             raise ValueError("ha=True and federation=S are exclusive modes")
@@ -378,6 +414,51 @@ class ChaosSim:
                 )
             _policy.reset_policy_metrics()
             _scoring.set_matrix(dict(POLICY_TPUT))
+        # tenant storms (TENANT_PROFILES): the ingress admission plane's
+        # overload scenario — solo mode only (the admission counters are
+        # process-global). ``admit_off`` runs the SAME storm with
+        # NHD_ADMIT=0 as the negative control: without the front door
+        # the abusive tenant's backlog must demonstrably starve the
+        # victim (chaos_storm --tenant asserts the violation happens —
+        # an isolation invariant that can't fail proves nothing).
+        if tenant is not None:
+            if tenant not in TENANT_PROFILES:
+                raise ValueError(
+                    f"unknown tenant profile {tenant!r}; "
+                    f"have {TENANT_PROFILES}"
+                )
+            if ha or federation:
+                raise ValueError("tenant storms run solo mode only")
+            if policy is not None or journey is not None:
+                raise ValueError(
+                    "tenant storms are exclusive with policy/journey modes"
+                )
+            admit_env = os.environ.get("NHD_ADMIT", "").lower()
+            if (admit_env in ("1", "true", "on", "")) == admit_off:
+                raise ValueError(
+                    "tenant storm needs NHD_ADMIT="
+                    + ("0 for the control cell" if admit_off else
+                       "1 (make tenant-chaos sets it)")
+                )
+        self.tenant = tenant
+        self.admit_off = admit_off
+        self.abuse_rate = int(abuse_rate)
+        self._tenant_deletes = 0       # control passes owed this step
+        # per-run SLO tracker + flight recorder on the sim clock: the
+        # victim/abuser p99s and the shed-accounting invariant (every
+        # refusal has its decision record) both read from these. Created
+        # ONCE here, not in _fresh_scheduler, so a mid-storm restart
+        # keeps the run's history.
+        self.slo: Optional[SloTracker] = None
+        self.recorder: Optional[FlightRecorder] = None
+        if tenant is not None:
+            self.slo = SloTracker(clock=self.sim_clock)
+            # a deep decision ring: the accounting invariant counts
+            # admission-shed decisions across the WHOLE run, so the ring
+            # must outlast the storm's worst shed tally
+            self.recorder = FlightRecorder(
+                decision_capacity=65536, identity="tenant-chaos"
+            )
         self.policy = policy
         self.policy_off = policy_off
         self._evicts_seen = 0          # per-step eviction-bound cursor
@@ -733,9 +814,22 @@ class ChaosSim:
             self.violation_artifact_path = f"capture failed: {exc}"
 
     def _fresh_scheduler(self) -> None:
-        self.sched = Scheduler(
-            self.backend, WatchQueue(), queue.Queue(), respect_busy=False
-        )
+        if self.tenant is not None:
+            # tenant storms put the REAL front door in the loop: the
+            # AdmissionQueue (on the sim clock, so bucket refills and
+            # shed stamps replay exactly) instead of the plain FIFO,
+            # plus the run's persistent SLO tracker and recorder
+            from nhd_tpu.ingress import AdmissionQueue
+
+            self.sched = Scheduler(
+                self.backend, AdmissionQueue(clock=self.sim_clock),
+                queue.Queue(), respect_busy=False,
+                recorder=self.recorder, slo=self.slo,
+            )
+        else:
+            self.sched = Scheduler(
+                self.backend, WatchQueue(), queue.Queue(), respect_busy=False
+            )
         self.controller = Controller(
             self.backend, self.sched.nqueue, isolate_events=self.hardened
         )
@@ -797,6 +891,39 @@ class ChaosSim:
             groups=groups, tier=tier,
         )
         self.stats.created += 1
+
+    # ------------------------------------------------------------------
+    # tenant-storm traffic (ISSUE 20): deterministic, no rng — the
+    # storm's shape IS the scenario (victim trickle vs abuser flood),
+    # and bit-identical cells are what make the calm/storm/control
+    # comparison meaningful
+    # ------------------------------------------------------------------
+
+    def _tenant_create(self, ns: str, tier: int = 0) -> None:
+        self._pod_seq += 1
+        cfg = make_triad_config(
+            n_groups=1, gpus_per_group=0, cpu_workers=1,
+            hugepages_gb=2, map_type="NUMA",
+        )
+        self.backend.create_pod(
+            f"chaos-{self._pod_seq}", ns, cfg_text=cfg, cfg_type="triad",
+            tier=tier,
+        )
+        self.stats.created += 1
+
+    def _tenant_step(self) -> None:
+        # short jobs: last step's bound pods complete first, freeing
+        # capacity — the storm must measure QUEUE delay, not cluster
+        # saturation (a full fleet would starve the victim in every
+        # cell and prove nothing about the front door)
+        bound = [p for p in self.backend.pods.values() if p.node]
+        self._tenant_deletes += len(bound)
+        for p in bound:
+            self.backend.delete_pod(p.name, p.namespace)
+            self.stats.deleted += 1
+        self._tenant_create(TENANT_VICTIM)
+        for _ in range(self.abuse_rate):
+            self._tenant_create(TENANT_ABUSER)
 
     def _act_group_move(self) -> None:
         from nhd_tpu.scheduler.controller import NHD_GROUP_LABEL
@@ -1307,12 +1434,19 @@ class ChaosSim:
             # the recorded storm made at this step is re-applied verbatim
             for e in self._journey_steps.get(self.stats.steps, []):
                 self._apply_journey_op(e)
+        elif self.tenant is not None:
+            # tenant storm: deterministic victim-trickle/abuser-flood
+            # traffic — no rng action draw, no structural churn; the
+            # overload itself is the fault being injected
+            self._tenant_step()
         else:
             action = self.rng.choices(actions, weights=weights)[0]
             action()
         if self.policy == "maint-wave":
             self._policy_wave_step()
-        if self.journey is None and not self.federation and not self.ha and (
+        if self.journey is None and self.tenant is None and (
+            not self.federation and not self.ha
+        ) and (
             self._flap_rng.random() < 0.08
         ):
             # solo mode drives the incremental-state path: structural
@@ -1362,6 +1496,9 @@ class ChaosSim:
                             r.sched.run_once()
             return
         if not self.ha:
+            if self.tenant is not None:
+                self._drive_tenant(extra_drain)
+                return
             self.controller.run_once(now=self._now)
             for _ in range(8):
                 if self.sched.nqueue.empty():
@@ -1392,6 +1529,38 @@ class ChaosSim:
                 if extra_drain:
                     while not r.sched.nqueue.empty():
                         r.sched.run_once()
+
+    def _drive_tenant(self, extra_drain: bool = False) -> None:
+        """The tenant storm's deliberately scarce drive: the front door
+        only earns its keep when arrivals outpace the drain, so the
+        CREATE budget is TENANT_PASSES_PER_STEP (vs the generic storm's
+        8) and the reconcile scan runs every TENANT_SCAN_EVERY steps
+        (every step it would re-admit what the ladder shed, bypassing
+        the front door entirely). Control traffic (the short jobs'
+        deletes) gets exactly its own passes — it is never shed and in
+        the admission cells always drains first, so the scarcity lands
+        on creates alone."""
+        self.controller.run_once(now=self._now)
+        for _ in range(self._tenant_deletes + TENANT_PASSES_PER_STEP):
+            if self.sched.nqueue.empty():
+                break
+            self.sched.run_once()
+        self._tenant_deletes = 0
+        # a real daemon turn publishes shed verdicts even when its get
+        # idles out; the sim's bounded drive skips empty turns, so drain
+        # explicitly — the accounting invariant (every refusal has its
+        # event + decision) is checked after every step
+        self.sched._guarded(
+            "shed verdicts", self.sched._publish_shed_verdicts
+        )
+        if extra_drain or self.stats.steps % TENANT_SCAN_EVERY == 0:
+            self.sched._guarded("chaos scan", self.sched.check_pending_pods)
+        if extra_drain:
+            while not self.sched.nqueue.empty():
+                self.sched.run_once()
+            self.sched._guarded(
+                "shed verdicts", self.sched._publish_shed_verdicts
+            )
 
     def _track_leadership(self) -> None:
         """The bounded-leadership-gap invariant: the cluster must never
@@ -1574,6 +1743,7 @@ class ChaosSim:
         else:
             self._check_scheduler_invariants(self.sched)
             self._check_policy_invariants()
+            self._check_tenant_invariants()
         self._check_single_epoch_binds()
 
     def _check_single_epoch_binds(self) -> None:
@@ -1627,6 +1797,88 @@ class ChaosSim:
                     f"{snap['max_seconds']:.0f}s exceeds sim elapsed "
                     f"{self._now:.0f}s (clock-domain mix)"
                 )
+
+    def _check_tenant_invariants(self) -> None:
+        """The tenant storm's standing laws, checked after every step:
+
+        **Shed accounting** — never silent, never double-issued: the
+        queue's refusal tally, the cluster's AdmissionShed pod events
+        and the recorder's admission-shed decision records must agree
+        exactly. A lag means a verdict was lost (a shed pod's owner
+        would see nothing); an excess means one refusal was verdicted
+        twice.
+
+        **SLO clock domain** — the per-run tracker rides the sim clock,
+        so no tenant figure can exceed the sim's elapsed time and
+        breaches can't outnumber observations (the same physical law
+        _check_slo_plane holds the HA/federation trackers to).
+
+        The ISOLATION judgment (victim p99 flat under the flood) is
+        deliberately NOT here: it is a cross-cell comparison — storm
+        vs calm, and the admit-off control must FAIL it — made by
+        chaos_storm --tenant over tenant_report()."""
+        if self.tenant is None:
+            return
+        q = self.sched.nqueue
+        stats = getattr(q, "stats", None)
+        if stats is None or self.recorder is None:
+            return
+        shed = stats["shed"]
+        events = sum(
+            1 for e in self.base.events if e.reason == "AdmissionShed"
+        )
+        decisions = sum(
+            1
+            for d in self.recorder.recent_decisions(1 << 30)
+            if d.get("outcome") == "admission-shed"
+        )
+        if not (shed == events == decisions):
+            self.stats.violations.append(
+                f"step {self.stats.steps}: shed accounting diverged — "
+                f"queue refused {shed}, AdmissionShed events {events}, "
+                f"admission-shed decisions {decisions} (a refusal was "
+                "lost or double-verdicted)"
+            )
+        if self.slo is not None:
+            snap = self.slo.snapshot(now=self._now)
+            if snap["breaches_total"] > snap["observations_total"]:
+                self.stats.violations.append(
+                    f"step {self.stats.steps}: tenant SLO breaches "
+                    f"{snap['breaches_total']} > observations "
+                    f"{snap['observations_total']}"
+                )
+            if snap["max_seconds"] > self._now + STEP_SEC:
+                self.stats.violations.append(
+                    f"step {self.stats.steps}: tenant time-to-bind "
+                    f"{snap['max_seconds']:.0f}s exceeds sim elapsed "
+                    f"{self._now:.0f}s (clock-domain mix)"
+                )
+
+    def tenant_report(self) -> dict:
+        """The tenant cell's verdict surface for chaos_storm --tenant:
+        per-tenant p99 time-to-bind plus the front door's ladder tallies
+        and final depths."""
+        if self.tenant is None or self.slo is None:
+            raise ValueError("tenant_report() needs a tenant storm")
+        q = self.sched.nqueue
+        snap = self.slo.snapshot(now=self._now)
+        tenants = snap.get("tenants", {})
+        report = {
+            "victim_p99_seconds": self.slo.tenant_p99(TENANT_VICTIM),
+            "abuser_p99_seconds": self.slo.tenant_p99(TENANT_ABUSER),
+            "victim_observations": tenants.get(TENANT_VICTIM, {}).get(
+                "observations_total", 0
+            ),
+            "abuser_observations": tenants.get(TENANT_ABUSER, {}).get(
+                "observations_total", 0
+            ),
+            "violations": len(self.stats.violations),
+        }
+        stats = getattr(q, "stats", None)
+        if stats is not None:
+            report.update(stats)
+            report["depths"] = q.depths()
+        return report
 
     def _check_spillover_orphans(self) -> None:
         """The bounded-orphan-window invariant: a pod carrying a spill

@@ -17,7 +17,10 @@ Watched per shared config: the solve-phase seconds (the figure the
 ROADMAP's perf arc optimizes) and total wall; sustained-churn configs
 gate their rates + p99 latency class, and SPMD configs (an ``spmd``
 section) additionally gate the parity/prewarm flags, zero wholesale
-mesh uploads and the per-round upload rows. Watched globally: the
+mesh uploads and the per-round upload rows. Ingress configs (an
+``ingress`` section — ingress-smoke / cfg9) hard-gate zero verdictless
+sheds plus live admit and shed paths, and relatively gate the
+batched-decode cost per event and drain binds/s. Watched globally: the
 headline pods/s. Phases below ``--floor`` seconds (default 5 ms) are
 skipped — at that scale the diff measures host jitter, not the solver.
 Configs present in only one artifact are reported but never fatal (the
@@ -250,6 +253,81 @@ def _replay_gates(
                 )
 
 
+def _ingress_gates(
+    name: str, o: dict, n: dict, threshold: float, lines, regressions
+) -> None:
+    """Ingress admission configs (an ``ingress`` section in the NEW
+    record — ingress-smoke / cfg9:ingress-stream, ISSUE 20). Hard gates
+    (promises, not figures): zero verdictless sheds (every refusal must
+    carry its AdmissionShed event — a nonzero count means a pod was
+    dropped silently), a live admitted path, and a live shed ladder (the
+    leg's storm is tuned to escalate; zero sheds means the overload
+    posture went vacuous and the leg gates nothing). Relative gates when
+    both sides carry the section: batched-decode cost per event (a COST
+    — rising is the regression — with a 5 µs absolute floor so host
+    jitter on a ~12 µs figure can't over-fire) and drain binds/s at the
+    doubled latency-class threshold."""
+    nc = n.get("ingress")
+    if not isinstance(nc, dict):
+        return
+    verdictless = int(nc.get("verdictless_sheds", 0) or 0)
+    if verdictless > 0:
+        lines.append(
+            f"{name:>24} verdictless sheds: {verdictless} <-- REGRESSION"
+        )
+        regressions.append(
+            f"{name} shed {verdictless} pod(s) without an AdmissionShed "
+            "verdict (the ladder refused work silently — every refusal "
+            "must carry its event + decision record)"
+        )
+    if int(nc.get("admitted", 0) or 0) <= 0:
+        lines.append(f"{name:>24} admitted: 0 <-- REGRESSION")
+        regressions.append(
+            f"{name} admitted zero creates (the admission path went dead)"
+        )
+    if int(nc.get("shed", 0) or 0) <= 0:
+        lines.append(f"{name:>24} shed: 0 <-- REGRESSION")
+        regressions.append(
+            f"{name} shed zero pods under the storm posture (the leg is "
+            "tuned to escalate the ladder; zero sheds means the overload "
+            "cell went vacuous and gates nothing)"
+        )
+    oc = o.get("ingress")
+    if isinstance(oc, dict):
+        ov = float(oc.get("decode_us_per_event", 0.0) or 0.0)
+        nv = float(nc.get("decode_us_per_event", 0.0) or 0.0)
+        if ov > 0:
+            d = _pct(ov, nv)
+            fatal = d > threshold and (nv - ov) >= 5.0
+            mark = " <-- REGRESSION" if fatal else ""
+            lines.append(
+                f"{name:>24} decode us/ev: {ov:8.2f} -> {nv:8.2f} "
+                f"({d:+.1%}){mark}"
+            )
+            if fatal:
+                regressions.append(
+                    f"{name} batched-decode cost per event regressed "
+                    f"{d:+.1%} ({ov:.2f}us -> {nv:.2f}us, threshold "
+                    f"{threshold:.0%} and +5us)"
+                )
+        ov = float(oc.get("binds_per_sec", 0.0) or 0.0)
+        nv = float(nc.get("binds_per_sec", 0.0) or 0.0)
+        if ov > 0:
+            d = _pct(ov, nv)
+            fatal = -d > threshold * 2
+            mark = " <-- REGRESSION" if fatal else ""
+            lines.append(
+                f"{name:>24} drain binds/s: {ov:8.1f} -> {nv:8.1f} "
+                f"({d:+.1%}){mark}"
+            )
+            if fatal:
+                regressions.append(
+                    f"{name} admitted-drain bind throughput dropped "
+                    f"{d:+.1%} ({ov:.1f} -> {nv:.1f}, threshold "
+                    f"{threshold * 2:.0%})"
+                )
+
+
 #: a wall regression is fatal only when BOTH the relative threshold and
 #: this absolute growth (seconds) are exceeded: at small scales the
 #: figure is scheduler fixed overhead + host jitter (a 3 ms blip on a
@@ -300,6 +378,7 @@ def diff_artifacts(
         _spmd_gates(name, o, n, threshold, lines, regressions)
         _hetero_gates(name, o, n, threshold, lines, regressions)
         _replay_gates(name, o, n, threshold, lines, regressions)
+        _ingress_gates(name, o, n, threshold, lines, regressions)
         cfg_threshold = (
             threshold * 2 if name in LATENCY_CONFIGS else threshold
         )

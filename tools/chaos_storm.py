@@ -12,6 +12,7 @@ tests/test_faults.py.
     make chaos                         # 6 seeds x {light,storm,heavy}
     make ha-chaos                      # split-brain: 2 replicas, 1 lease
     make fed-chaos                     # federation: N replicas, S shards
+    make tenant-chaos                  # admission: calm/flood/no-door cells
     make chaos CHAOS_SEEDS=25          # wider sweep
     python tools/chaos_storm.py --profiles heavy --seeds 50 --steps 120
     python tools/chaos_storm.py --federation 3 --replicas 3 \
@@ -119,10 +120,131 @@ def _run_policy_cell_inner(args, profile: str, seed: int, pol, ChaosSim):
     }
 
 
+#: the tenant-storm cells' overload posture: a scarce drain (small
+#: batches), shallow lanes and a low sustained rate, so the ladder
+#: actually escalates inside a 60-step storm — with the defaults (256
+#: deep lanes, unlimited rate) the front door would never be tested
+_TENANT_CELL_ENV = {
+    "NHD_ADMIT_BATCH": "2",
+    "NHD_ADMIT_TENANT_CAP": "16",
+    "NHD_ADMIT_RATE": "0.2",
+}
+
+#: the isolation invariant's margin: the flooded victim p99 may move at
+#: most this factor over the calm cell's
+_TENANT_P99_MARGIN = 1.10
+
+
+def _run_tenant_cell(args, profile: str, seed: int) -> dict:
+    """One tenant-storm cell (make tenant-chaos): three runs of the SAME
+    deterministic traffic shape —
+
+    * **calm** (admission on, abuse rate 0): the victim tenant alone;
+      its p99 time-to-bind is the baseline.
+    * **storm** (admission on, abusive tenant at ``--abuse-rate`` x):
+      the isolation invariant — the victim's p99 must stay within
+      10% of calm — plus the in-sim shed-accounting invariant (every
+      refusal has its decision record + pod event) and a non-vacuity
+      check (the ladder must actually have shed).
+    * **control** (NHD_ADMIT=0, same flood): the negative control —
+      the victim MUST starve (isolation demonstrably violated), or the
+      storm cell's pass proves nothing about the front door.
+    """
+    from nhd_tpu.sim.chaos import ChaosSim
+
+    # main() is test-callable: the per-cell admission knobs must not
+    # leak into the calling process (they are read at AdmissionQueue
+    # construction, so a leaked NHD_ADMIT=0 would silently disable the
+    # ladder for every later harness in this process)
+    prior = {
+        k: os.environ.get(k)
+        for k in ("NHD_ADMIT", *_TENANT_CELL_ENV)
+    }
+    try:
+        return _run_tenant_cell_inner(args, profile, seed, ChaosSim)
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _run_tenant_cell_inner(args, profile: str, seed: int, ChaosSim) -> dict:
+    os.environ.update(_TENANT_CELL_ENV)
+
+    def one(admit: bool, abuse: int):
+        os.environ["NHD_ADMIT"] = "1" if admit else "0"
+        sim = ChaosSim(
+            seed=seed, n_nodes=args.nodes, tenant=profile,
+            admit_off=not admit, abuse_rate=abuse,
+        )
+        sim.run(steps=args.steps)
+        sim.quiesce()
+        rep = sim.tenant_report()
+        # the control cell legitimately diverges in bulk (that is the
+        # point); cap the sample so --json-out stays readable — the
+        # full count is in rep["violations"]
+        rep["violations_list"] = list(sim.stats.violations)[:8]
+        return rep
+
+    calm = one(True, 0)
+    storm = one(True, args.abuse_rate)
+    control = one(False, args.abuse_rate)
+
+    violations: list = []
+    for name, rep in (("calm", calm), ("storm", storm)):
+        # the standing invariants (shed accounting, SLO clock domain,
+        # mirror conservation) must hold in every admission-on cell
+        violations += [f"{name}: {v}" for v in rep["violations_list"]]
+    bound = calm["victim_p99_seconds"] * _TENANT_P99_MARGIN + 1e-9
+    if storm["victim_p99_seconds"] > bound:
+        violations.append(
+            f"isolation: victim p99 {storm['victim_p99_seconds']:.3f}s "
+            f"under a {args.abuse_rate}x flood exceeds "
+            f"{_TENANT_P99_MARGIN:.2f} x calm "
+            f"({calm['victim_p99_seconds']:.3f}s)"
+        )
+    if storm.get("shed", 0) <= 0:
+        violations.append(
+            "vacuous storm: the flood never pushed the ladder to shed — "
+            "the isolation pass proves nothing (retune the cell knobs)"
+        )
+    if storm.get("readmitted", 0) <= 0:
+        violations.append(
+            "vacuous storm: nothing was deferred and re-admitted — the "
+            "ladder's recovery half went unexercised"
+        )
+    if control["victim_p99_seconds"] <= bound:
+        violations.append(
+            f"negative control: with NHD_ADMIT=0 the victim p99 "
+            f"({control['victim_p99_seconds']:.3f}s) stayed within the "
+            f"isolation bound — the invariant cannot fire, so the storm "
+            f"cell's pass is unfalsifiable"
+        )
+    return {
+        "profile": profile,
+        "seed": seed,
+        "nodes": args.nodes,
+        "steps": args.steps,
+        "mode": "tenant",
+        "ok": not violations,
+        "violations": violations,
+        "stuck_pods": [],
+        "faults_injected": {},
+        "lease_epoch": 0,
+        "max_leader_gap": 0,
+        "abuse_rate": args.abuse_rate,
+        "cells": {"calm": calm, "storm": storm, "control": control},
+    }
+
+
 def _run_cell(args, profile: str, seed: int) -> dict:
     """One (profile, seed) cell → its machine-readable summary record."""
     if getattr(args, "policy", False):
         return _run_policy_cell(args, profile, seed)
+    if getattr(args, "tenant", False):
+        return _run_tenant_cell(args, profile, seed)
     from nhd_tpu.sim.chaos import ChaosSim
     from nhd_tpu.sim.faults import PROFILES
 
@@ -367,6 +489,18 @@ def main(argv=None) -> int:
                          "the NHD_POLICY=1 storm under the preemption-"
                          "bound / no-cascade / tier-inversion / victim-"
                          "rebind invariants")
+    ap.add_argument("--tenant", action="store_true",
+                    help="tenant-storm mode (make tenant-chaos): each "
+                         "cell runs the deterministic victim-trickle/"
+                         "abuser-flood scenario three ways — calm "
+                         "baseline, flooded with the admission ladder "
+                         "on (victim p99 must stay within 10%% of calm, "
+                         "every shed pod must carry its verdict), and "
+                         "the NHD_ADMIT=0 negative control (the victim "
+                         "MUST starve, proving the invariant can fire)")
+    ap.add_argument("--abuse-rate", type=int, default=10,
+                    help="tenant mode: abusive tenant's creates per "
+                         "step; the victim stays at 1 (default 10)")
     ap.add_argument("--bind-parity", action="store_true",
                     help="run a fault-free CONTROL sim per cell (same "
                          "seed, no profile) and fail the cell unless the "
@@ -389,7 +523,21 @@ def main(argv=None) -> int:
     if args.policy and (args.ha or args.federation):
         print("--policy runs solo mode only")
         return 2
-    if args.policy:
+    if args.tenant and (args.ha or args.federation or args.policy):
+        print("--tenant runs solo mode only (and not with --policy)")
+        return 2
+    if args.tenant:
+        from nhd_tpu.sim.chaos import TENANT_PROFILES
+
+        if args.profiles == "light,storm,heavy,churn":  # the default
+            args.profiles = ",".join(TENANT_PROFILES)
+        profiles = [p.strip() for p in args.profiles.split(",") if p.strip()]
+        for p in profiles:
+            if p not in TENANT_PROFILES:
+                print(f"unknown tenant profile {p!r}; "
+                      f"have {sorted(TENANT_PROFILES)}")
+                return 2
+    elif args.policy:
         from nhd_tpu.sim.chaos import POLICY_PROFILES
 
         if args.profiles == "light,storm,heavy,churn":  # the default
@@ -459,7 +607,8 @@ def main(argv=None) -> int:
             "start_seed": args.start_seed,
             "steps": args.steps,
             "nodes": args.nodes,
-            "mode": ("policy" if args.policy
+            "mode": ("tenant" if args.tenant
+                     else "policy" if args.policy
                      else "federation" if args.federation
                      else "ha" if args.ha else "single"),
             "federation_shards": args.federation,
